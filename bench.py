@@ -1,0 +1,73 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Equivalent role to the reference's PerformanceListener samples/sec hook
+(SURVEY.md §6) — the reference publishes no numbers, so this harness *is* the
+baseline (BASELINE.md). Current benchmark: MNIST-MLP training throughput
+(BASELINE config #1 spine); upgraded to LeNet/ResNet-50 as those land.
+
+Runs on whatever backend JAX_PLATFORMS selects (real TPU chip under the driver).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
+    import jax
+
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=1024, activation="relu"),
+            DenseLayer(n_out=1024, activation="relu"),
+            OutputLayer(n_out=10, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(784),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        dtype="bfloat16",
+        seed=42,
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    ds = DataSet(x, y)
+
+    net._train_step = net._build_train_step()
+    for _ in range(warmup):
+        net._fit_batch(ds)
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net._fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch / dt
+    return {
+        "metric": "mlp_mnist_train_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec",
+        # Reference publishes no numbers (BASELINE.md); self-baseline = 1.0
+        "vs_baseline": 1.0,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_mlp_mnist()))

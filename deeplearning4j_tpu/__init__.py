@@ -1,0 +1,71 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+Brand-new JAX/XLA/Pallas/pjit implementation of the capabilities of
+Deeplearning4J 0.7.x (reference: /root/reference, surveyed in SURVEY.md).
+Not a port: layers are pure functions, backprop is autodiff, the cuDNN helper
+tier is XLA, and ParallelWrapper/Spark/Aeron collapse into mesh collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .nn.conf.inputs import InputType
+from .nn.conf.multi_layer import MultiLayerConfiguration
+from .nn.updaters import UpdaterConfig
+from .nn.multilayer import MultiLayerNetwork
+from .nn.layers.base import BaseLayer, register_layer
+from .nn.layers.dense import (
+    DenseLayer,
+    OutputLayer,
+    LossLayer,
+    ActivationLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+)
+from .datasets.iterators import (
+    DataSet,
+    MultiDataSet,
+    DataSetIterator,
+    NumpyDataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+)
+from .eval.evaluation import Evaluation
+from .optimize.listeners import (
+    IterationListener,
+    TrainingListener,
+    ScoreIterationListener,
+    CollectScoresIterationListener,
+    PerformanceListener,
+)
+from .utils.serialization import write_model, restore_model
+
+__all__ = [
+    "InputType",
+    "MultiLayerConfiguration",
+    "UpdaterConfig",
+    "MultiLayerNetwork",
+    "BaseLayer",
+    "register_layer",
+    "DenseLayer",
+    "OutputLayer",
+    "LossLayer",
+    "ActivationLayer",
+    "DropoutLayer",
+    "EmbeddingLayer",
+    "DataSet",
+    "MultiDataSet",
+    "DataSetIterator",
+    "NumpyDataSetIterator",
+    "ListDataSetIterator",
+    "AsyncDataSetIterator",
+    "MultipleEpochsIterator",
+    "Evaluation",
+    "IterationListener",
+    "TrainingListener",
+    "ScoreIterationListener",
+    "CollectScoresIterationListener",
+    "PerformanceListener",
+    "write_model",
+    "restore_model",
+]

@@ -1,0 +1,286 @@
+"""DataSet + iterator framework with async prefetch.
+
+TPU-native equivalent of the reference's dataset tier (SURVEY.md §2.1 "Dataset
+iterator framework"): ND4J ``DataSet``/``DataSetIterator`` +
+``AsyncDataSetIterator`` (deeplearning4j-nn/.../datasets/iterator/
+AsyncDataSetIterator.java:36 — bounded queue + consumer thread, auto-inserted by
+fit at MultiLayerNetwork.java:920-924), plus the composition utilities
+(MultipleEpochsIterator, SamplingDataSetIterator, ExistingDataSetIterator,
+IteratorDataSetIterator, INDArrayDataSetIterator, ListDataSetIterator).
+
+Host-side by design: iterators produce numpy batches; the device boundary is
+crossed once per step inside the jitted train step (or explicitly via sharding
+in the parallel trainer). Static batch shapes are the contract — the final
+short batch can be dropped or padded so XLA never sees a new shape.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    """Features+labels (+masks) minibatch (reference: ND4J DataSet)."""
+
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        def take(sl):
+            return DataSet(
+                self.features[sl],
+                self.labels[sl],
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl],
+            )
+
+        return take(slice(None, n_train)), take(slice(n_train, None))
+
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(
+            self.features[idx],
+            self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+
+@dataclass
+class MultiDataSet:
+    """Multi-input/multi-output batch (reference: ND4J MultiDataSet), for ComputationGraph."""
+
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+class DataSetIterator:
+    """Base iterator (reference: DataSetIterator interface). Iterable + reset."""
+
+    prefetch_supported = True
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of pre-built DataSets (reference: ListDataSetIterator)."""
+
+    def __init__(self, datasets: Sequence[DataSet]):
+        self._data = list(datasets)
+
+    def __iter__(self):
+        return iter(self._data)
+
+    def batch_size(self):
+        return self._data[0].num_examples() if self._data else 0
+
+    def __len__(self):
+        return len(self._data)
+
+
+class NumpyDataSetIterator(DataSetIterator):
+    """Batch up (features, labels) arrays (reference: INDArrayDataSetIterator).
+
+    ``drop_last`` keeps batch shapes static for XLA (a trailing short batch
+    would trigger a recompile).
+    """
+
+    def __init__(self, features, labels, batch: int, drop_last: bool = True,
+                 shuffle: bool = False, seed: int = 0):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.batch = int(batch)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        stop = n - (n % self.batch) if self.drop_last else n
+        for s in range(0, stop, self.batch):
+            sl = idx[s : s + self.batch]
+            yield DataSet(self.features[sl], self.labels[sl])
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self.batch if self.drop_last else -(-n // self.batch)
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any python iterable of DataSets (reference: ExistingDataSetIterator)."""
+
+    def __init__(self, iterable: Iterable[DataSet]):
+        self._iterable = iterable
+
+    def __iter__(self):
+        return iter(self._iterable)
+
+    def batch_size(self):
+        return 0
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay an iterator N times (reference: MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample random minibatches with replacement (reference: SamplingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch: int, total_batches: int, seed: int = 0):
+        self.dataset = dataset
+        self.batch = batch
+        self.total = total_batches
+        self.seed = seed
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        n = self.dataset.num_examples()
+        for _ in range(self.total):
+            idx = rng.integers(0, n, size=self.batch)
+            yield DataSet(self.dataset.features[idx], self.dataset.labels[idx])
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch a stream of single examples (reference: IteratorDataSetIterator)."""
+
+    def __init__(self, examples: Iterable[DataSet], batch: int):
+        self.examples = examples
+        self.batch = batch
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        feats, labs = [], []
+        for ex in self.examples:
+            feats.append(ex.features)
+            labs.append(ex.labels)
+            if len(feats) == self.batch:
+                yield DataSet(np.stack(feats), np.stack(labs))
+                feats, labs = [], []
+
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue.
+
+    Reference: AsyncDataSetIterator.java:36 (consumer thread started at :79,
+    queue capacity default 8). Overlaps host-side batch prep with device
+    compute — the HBM-feeding side of the input pipeline.
+    """
+
+    prefetch_supported = False  # already async; never double-wrap
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 8):
+        self.base = base
+        self.queue_size = queue_size
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        err: List[BaseException] = []
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for ds in self.base:
+                    while not stop.is_set():
+                        try:
+                            q.put(ds, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                try:
+                    q.put_nowait(_SENTINEL)
+                except queue.Full:
+                    pass  # consumer gone; stop flag already set or will be on close
+
+        t = threading.Thread(target=producer, daemon=True, name="async-dataset-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                yield item
+        finally:
+            # Early consumer exit (exception in the train loop, break, GC of the
+            # generator) must not leave the producer blocked on a full queue.
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)
+        if err:
+            raise err[0]
+
+
+def as_iterator(data) -> Iterable[DataSet]:
+    """Normalize fit() input: (x, y) tuple, DataSet, or iterator."""
+    if isinstance(data, DataSet):
+        return ListDataSetIterator([data])
+    if isinstance(data, tuple) and len(data) == 2:
+        return ListDataSetIterator([DataSet(np.asarray(data[0]), np.asarray(data[1]))])
+    return data

@@ -1,0 +1,123 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Reference: eval/Evaluation.java:46 (eval:191, stats:352), ConfusionMatrix.java
+(SURVEY.md §2.1 "Evaluation"). Accumulates over batches host-side (numpy);
+the argmax runs on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Counts[actual][predicted] (reference: eval/ConfusionMatrix.java)."""
+
+    def __init__(self, n_classes: int):
+        self.matrix = np.zeros((n_classes, n_classes), dtype=np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+
+class Evaluation:
+    """Accumulating classification metrics (reference: eval/Evaluation.java)."""
+
+    def __init__(
+        self,
+        n_classes: Optional[int] = None,
+        labels: Optional[List[str]] = None,
+        top_n: int = 1,
+    ):
+        self.n_classes = n_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.examples = 0
+        self.top_n = max(1, top_n)
+        self.top_n_correct = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or n
+            self.confusion = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels, predictions) -> None:
+        """labels: one-hot [B,C] (or int [B]); predictions: prob/score [B,C].
+
+        Reference: Evaluation.eval:191 — row-argmax both sides into the
+        confusion matrix. Time-series [B,T,C] inputs are flattened over time.
+        """
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if predictions.ndim == 3:
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            labels = labels.reshape(-1, labels.shape[-1]) if labels.ndim == 3 else labels
+        self._ensure(predictions.shape[-1])
+        pred_idx = predictions.argmax(-1)
+        act_idx = labels.argmax(-1) if labels.ndim == 2 else labels.astype(np.int64)
+        self.confusion.add(act_idx, pred_idx)
+        self.examples += len(pred_idx)
+        if self.top_n > 1:
+            k = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(predictions, -k, axis=-1)[:, -k:]
+            self.top_n_correct += int((topk == act_idx[:, None]).any(-1).sum())
+
+    # ---- metrics (reference: Evaluation accuracy()/precision()/recall()/f1()) ----
+    def _tp(self) -> np.ndarray:
+        return np.diag(self.confusion.matrix)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        return float(self._tp().sum() / max(m.sum(), 1))
+
+    def top_n_accuracy(self) -> float:
+        """Top-N accuracy (reference: Evaluation topNAccuracy); top-1 == accuracy()."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return self.top_n_correct / max(self.examples, 1)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        col = m.sum(axis=0)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            per = np.where(col > 0, self._tp() / np.maximum(col, 1), 0.0)
+        return float(per[cls]) if cls is not None else float(per[col > 0].mean() if (col > 0).any() else 0.0)
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        row = m.sum(axis=1)
+        per = np.where(row > 0, self._tp() / np.maximum(row, 1), 0.0)
+        return float(per[cls]) if cls is not None else float(per[row > 0].mean() if (row > 0).any() else 0.0)
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        fp = m[:, cls].sum() - m[cls, cls]
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        return float(fp / max(fp + tn, 1))
+
+    def stats(self) -> str:
+        """Printable summary (reference: Evaluation.stats:352)."""
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.n_classes}",
+            f" Examples:        {self.examples}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+            str(self.confusion.matrix),
+            "==================================================================",
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,81 @@
+"""Activation-function catalog.
+
+TPU-native equivalent of the reference's ``IActivation`` catalog (consumed at
+deeplearning4j-nn/.../conf/NeuralNetConfiguration.java:486 and applied per-layer at
+e.g. ConvolutionLayer.java:156). In the reference every activation carries a
+hand-written ``backprop``; here the catalog is pure ``jax.numpy`` functions and
+``jax.grad`` supplies all derivatives — XLA fuses the elementwise op into the
+surrounding matmul so no custom-VJP tier is needed.
+
+Activations are configured by name (a plain string in the JSON config), matching
+the reference's ``Activation`` enum surface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+_REGISTRY: Dict[str, Activation] = {}
+
+
+def register_activation(name: str, fn: Activation) -> None:
+    """Register a custom activation (reference: Updater.CUSTOM-style extension)."""
+    _REGISTRY[name.lower()] = fn
+
+
+def get_activation(name: str) -> Activation:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def _rational_tanh(x):
+    # Rational approximation of tanh (reference: ActivationRationalTanh):
+    # 1.7159 * tanh(2x/3) approximated with a rational function.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a**4)
+    return 1.7159 * jnp.sign(x) * approx
+
+
+def _hard_tanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+_REGISTRY.update(
+    {
+        "identity": lambda x: x,
+        "linear": lambda x: x,
+        "relu": jax.nn.relu,
+        "relu6": jax.nn.relu6,
+        "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+        "rrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.125),
+        "elu": jax.nn.elu,
+        "selu": jax.nn.selu,
+        "gelu": jax.nn.gelu,
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "sigmoid": jax.nn.sigmoid,
+        "hardsigmoid": _hard_sigmoid,
+        "tanh": jnp.tanh,
+        "hardtanh": _hard_tanh,
+        "rationaltanh": _rational_tanh,
+        "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+        "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+        "softplus": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "cube": lambda x: x**3,
+        "exp": jnp.exp,
+    }
+)

@@ -1,0 +1,97 @@
+"""Input typing for shape inference.
+
+TPU-native equivalent of the reference's ``InputType``
+(deeplearning4j-nn/.../nn/conf/inputs/InputType.java — see SURVEY.md §2.1
+"Input typing & preprocessors"). Every layer conf exposes
+``get_output_type(input_type)`` so a whole network's shapes are inferred
+statically at config time — which is exactly what XLA wants: static shapes,
+known before trace time.
+
+Conventions (TPU-first, differs from the reference deliberately):
+- CNN activations are **NHWC** (TPU-native layout; the reference/ND4J is NCHW).
+- RNN activations are **[batch, time, features]** (time-major available via
+  lax.scan internally; the reference is [batch, features, time]).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class InputType:
+    """Shape of one example (no batch dim)."""
+
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat"
+    size: int = 0  # ff: feature count; rnn: feature count
+    timesteps: Optional[int] = None  # rnn: may be None (variable, padded)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    # ---- factories (reference: InputType.feedForward/recurrent/convolutional*) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        """Flattened image vector (reference: InputType.convolutionalFlat)."""
+        return InputType(
+            kind="cnn_flat", height=int(height), width=int(width), channels=int(channels),
+            size=int(height) * int(width) * int(channels),
+        )
+
+    # ---- queries ----
+    def flat_size(self) -> int:
+        if self.kind == "ff":
+            return self.size
+        if self.kind == "rnn":
+            return self.size
+        return self.height * self.width * self.channels
+
+    def example_shape(self) -> Tuple[int, ...]:
+        """Per-example array shape (batch dim excluded)."""
+        if self.kind == "ff":
+            return (self.size,)
+        if self.kind == "rnn":
+            t = self.timesteps if self.timesteps is not None else 1
+            return (t, self.size)
+        if self.kind == "cnn":
+            return (self.height, self.width, self.channels)
+        return (self.size,)
+
+    def batch_shape(self, batch: int) -> Tuple[int, ...]:
+        return (batch,) + self.example_shape()
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        if self.kind in ("ff", "rnn"):
+            d["size"] = self.size
+        if self.kind == "rnn":
+            d["timesteps"] = self.timesteps
+        if self.kind in ("cnn", "cnn_flat"):
+            d.update(height=self.height, width=self.width, channels=self.channels)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        kind = d["kind"]
+        if kind == "ff":
+            return InputType.feed_forward(d["size"])
+        if kind == "rnn":
+            return InputType.recurrent(d["size"], d.get("timesteps"))
+        if kind == "cnn":
+            return InputType.convolutional(d["height"], d["width"], d["channels"])
+        if kind == "cnn_flat":
+            return InputType.convolutional_flat(d["height"], d["width"], d["channels"])
+        raise ValueError(f"Unknown InputType kind '{kind}'")

@@ -1,0 +1,100 @@
+"""Network configuration: config-as-data with JSON round-trip.
+
+TPU-native equivalent of the reference's config tier
+(nn/conf/NeuralNetConfiguration.java Builder :486-514,
+nn/conf/MultiLayerConfiguration.java — SURVEY.md §2.1 "Config DSL").
+A configuration is a plain dataclass of JSON-safe values; ``to_json``/
+``from_json`` replace the reference's Jackson round-trip and serve the same
+three consumers: checkpoints (ModelSerializer zip), broadcast to distributed
+workers, and human inspection.
+
+The JSON is the persisted artifact — the layer registry
+(nn/layers/base.py) replaces Jackson's reflective subtype scan.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .inputs import InputType
+from ..layers.base import BaseLayer, layer_from_dict
+from ..updaters import UpdaterConfig
+
+
+@dataclass
+class MultiLayerConfiguration:
+    """Sequential network config (reference: MultiLayerConfiguration.java)."""
+
+    layers: List[BaseLayer] = field(default_factory=list)
+    input_type: Optional[InputType] = None
+    updater: UpdaterConfig = field(default_factory=UpdaterConfig)
+    seed: int = 12345
+    dtype: str = "float32"  # compute dtype; "bfloat16" keeps the MXU fed on TPU
+    # reference: BackpropType.Standard | TruncatedBPTT + lengths (MultiLayerConfiguration.java)
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    # per-layer-index input preprocessors (reference: nn/conf/preprocessor/*);
+    # stored as {"idx": {"@type": ...}} in JSON
+    preprocessors: Dict[int, object] = field(default_factory=dict)
+
+    # ---- shape inference ----------------------------------------------------
+    def layer_input_types(self) -> List[InputType]:
+        """InputType seen by each layer (preprocessors applied), length n_layers."""
+        if self.input_type is None:
+            raise ValueError("input_type must be set for shape inference")
+        its: List[InputType] = []
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            pre = self.preprocessors.get(i)
+            if pre is not None:
+                cur = pre.get_output_type(cur)
+            its.append(cur)
+            cur = layer.get_output_type(cur)
+        return its
+
+    def output_type(self) -> InputType:
+        its = self.layer_input_types()
+        return self.layers[-1].get_output_type(its[-1])
+
+    # ---- JSON ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "updater": self.updater.to_dict(),
+            "seed": self.seed,
+            "dtype": self.dtype,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        from .preprocessors import preprocessor_from_dict
+
+        return MultiLayerConfiguration(
+            layers=[layer_from_dict(ld) for ld in d["layers"]],
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            updater=UpdaterConfig.from_dict(d.get("updater", {})),
+            seed=d.get("seed", 12345),
+            dtype=d.get("dtype", "float32"),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            preprocessors={
+                int(k): preprocessor_from_dict(v)
+                for k, v in (d.get("preprocessors") or {}).items()
+            },
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))

@@ -1,0 +1,188 @@
+"""Input preprocessors: shape adapters between layer families.
+
+Reference: nn/conf/preprocessor/ (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+ComposableInputPreProcessor — SURVEY.md §2.1 "Input typing & preprocessors").
+
+All are pure reshapes/transposes — free under XLA (layout ops fuse into
+neighbors). Layout conventions are TPU-native (NHWC images,
+[batch, time, features] sequences), see conf/inputs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Type
+
+import jax.numpy as jnp
+
+from .inputs import InputType
+
+PREPROCESSOR_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_from_dict(d: dict) -> "InputPreProcessor":
+    d = dict(d)
+    name = d.pop("@type")
+    cls = PREPROCESSOR_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"Unknown preprocessor '{name}'")
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclass
+class InputPreProcessor:
+    """SPI (reference: nn/conf/InputPreProcessor.java). ``backprop`` is autodiff'd."""
+
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,H,W,C] -> [B, H*W*C] (reference: CnnToFeedForwardPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def get_output_type(self, it: InputType) -> InputType:
+        if it.kind == "cnn":
+            return InputType.feed_forward(it.height * it.width * it.channels)
+        return InputType.feed_forward(it.flat_size())
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[B, H*W*C] -> [B,H,W,C] (reference: FeedForwardToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B*T, F] (reference: RnnToFeedForwardPreProcessor.java).
+
+    The reference flattens time into batch so FF layers apply per-timestep;
+    same trick here — one big matmul keeps the MXU fed.
+    """
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.size)
+
+    def apply(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T, F] -> [B,T,F]; needs the timestep count at apply time."""
+
+    timesteps: int = 0
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.flat_size(), self.timesteps or None)
+
+    def apply(self, x):
+        if self.timesteps <= 0:
+            raise ValueError("FeedForwardToRnnPreProcessor requires timesteps > 0")
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B,H,W,C] per-step maps are not supported mid-sequence in v1; this treats
+    each image as one timestep-flattened vector sequence of length H
+    (reference: CnnToRnnPreProcessor.java flattens depth*width per timestep)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def get_output_type(self, it: InputType) -> InputType:
+        h = self.height or it.height
+        w = self.width or it.width
+        c = self.channels or it.channels
+        return InputType.recurrent(w * c, h)
+
+    def apply(self, x):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B*T,H,W,C] (reference: RnnToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors (reference: ComposableInputPreProcessor.java)."""
+
+    children: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "@type": type(self).__name__,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def __post_init__(self):
+        self.children = [
+            preprocessor_from_dict(c) if isinstance(c, dict) else c for c in self.children
+        ]
+
+    def get_output_type(self, it: InputType) -> InputType:
+        for c in self.children:
+            it = c.get_output_type(it)
+        return it
+
+    def apply(self, x):
+        for c in self.children:
+            x = c.apply(x)
+        return x

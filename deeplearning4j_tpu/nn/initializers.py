@@ -1,0 +1,106 @@
+"""Weight-initialization catalog.
+
+TPU-native equivalent of the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java — see
+SURVEY.md §2.1 "Param init"). Schemes follow the reference's formulas:
+
+- XAVIER: N(0, 2/(fanIn+fanOut))
+- XAVIER_UNIFORM: U(-s, s), s = sqrt(6/(fanIn+fanOut))
+- XAVIER_FAN_IN: N(0, 1/fanIn)
+- RELU: N(0, 2/fanIn)   (He init)
+- RELU_UNIFORM: U(-s, s), s = sqrt(6/fanIn)
+- LECUN_NORMAL: N(0, 1/fanIn); LECUN_UNIFORM: U(-s,s), s=sqrt(3/fanIn)
+- SIGMOID_UNIFORM: U(-s,s), s = 4*sqrt(6/(fanIn+fanOut))
+- UNIFORM: U(-s,s), s = 1/sqrt(fanIn)
+- NORMAL: N(0, 1/fanIn) scaled  (reference "NORMALIZED"/legacy)
+- ZERO / ONES / DISTRIBUTION(custom)
+
+Each initializer is a pure function of an explicit PRNG key (JAX functional
+RNG replaces the reference's global Nd4j RNG).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, Tuple[int, ...], float, float], jnp.ndarray]
+
+
+def _fans(fan_in: float, fan_out: float):
+    return max(fan_in, 1.0), max(fan_out, 1.0)
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    scheme: str = "xavier",
+    distribution: Optional[dict] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Create a weight array per the named scheme (WeightInitUtil.initWeights)."""
+    scheme = scheme.lower()
+    fan_in, fan_out = _fans(fan_in, fan_out)
+    shape = tuple(int(s) for s in shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_uniform":
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "xavier_fan_in":
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "relu":
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "relu_uniform":
+        s = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "lecun_normal":
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "lecun_uniform":
+        s = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "sigmoid_uniform":
+        s = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "uniform":
+        s = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "normal":
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "distribution":
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+def _from_distribution(key, shape, dist: dict, dtype):
+    """Reference: nn/conf/distribution/* (Normal, Uniform, Binomial, GaussianDistribution)."""
+    kind = dist.get("type", "normal").lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lo = float(dist.get("lower", -1.0))
+        hi = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lo, hi)
+    if kind == "binomial":
+        n = int(dist.get("n", 1))
+        p = float(dist.get("p", 0.5))
+        return jax.random.binomial(key, n, p, shape).astype(dtype)
+    if kind == "constant":
+        return jnp.full(shape, float(dist.get("value", 0.0)), dtype)
+    raise ValueError(f"Unknown distribution '{kind}'")

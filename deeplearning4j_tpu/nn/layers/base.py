@@ -1,0 +1,176 @@
+"""Layer SPI + registry.
+
+TPU-native reimagining of the reference's layer tier. The reference splits each
+layer into a conf class (nn/conf/layers/*) and an impl class (nn/layers/*) with
+hand-written ``activate``/``backpropGradient`` (nn/api/Layer.java:70-217). Here
+one dataclass per layer *is* the config (JSON-serializable fields) and carries
+pure functions:
+
+- ``get_output_type(input_type)``  — static shape inference (InputType.java parity)
+- ``init_params(key, input_type)`` — parameter pytree (nn/params/* parity)
+- ``init_state(input_type)``       — non-trainable state (e.g. BN running stats)
+- ``apply(params, x, state, train, rng, mask)`` — forward; ``jax.grad`` supplies
+  every ``backpropGradient`` so none are hand-ported (SURVEY.md §7).
+
+Params for layer i live at ``params[i]`` (a dict keyed "W"/"b"/... matching the
+reference's DefaultParamInitializer keys) — a pytree replaces the reference's
+flattened contiguous param vector + views (MultiLayerNetwork.initGradientsView,
+MultiLayerNetwork.java:470).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from ..activations import get_activation
+from ..initializers import init_weights
+
+LAYER_REGISTRY: Dict[str, Type["BaseLayer"]] = {}
+
+Params = Dict[str, jnp.ndarray]
+State = Dict[str, Any]
+
+
+def register_layer(cls):
+    """Class decorator: register a layer for JSON round-trip by class name."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: dict) -> "BaseLayer":
+    d = dict(d)
+    type_name = d.pop("@type")
+    cls = LAYER_REGISTRY.get(type_name)
+    if cls is None:
+        raise ValueError(f"Unknown layer type '{type_name}'. Known: {sorted(LAYER_REGISTRY)}")
+    fields = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        if k not in fields:
+            continue
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def _jsonify(v):
+    if isinstance(v, tuple):
+        return [_jsonify(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonify(x) for k, x in v.items()}
+    return v
+
+
+@dataclass
+class BaseLayer:
+    """Common hyperparameters (reference: nn/conf/layers/Layer + BaseLayer conf).
+
+    ``l1``/``l2`` enter the loss (0.5*l2*||W||^2 + l1*|W|, biases governed by
+    ``l1_bias``/``l2_bias``) — equivalent to the reference's score terms
+    (BaseLayer.calcL2) with gradients supplied by autodiff.
+    """
+
+    name: str = ""
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    distribution: Optional[dict] = None
+    bias_init: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    dropout: float = 0.0  # reference: applied to layer *input* (BaseLayer.applyDropOutIfNecessary)
+
+    # ---- serialization ----
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = _jsonify(getattr(self, f.name))
+        return d
+
+    # ---- SPI ----
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init_params(self, key: jax.Array, input_type: InputType) -> Params:
+        return {}
+
+    def init_state(self, input_type: InputType) -> State:
+        return {}
+
+    def apply(
+        self,
+        params: Params,
+        x: jnp.ndarray,
+        state: State,
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, State]:
+        raise NotImplementedError
+
+    # ---- helpers ----
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    @property
+    def is_output_layer(self) -> bool:
+        return False
+
+    @property
+    def is_recurrent(self) -> bool:
+        return False
+
+    def regularization_loss(self, params: Params) -> jnp.ndarray:
+        """0.5*l2*||W||² + l1*|W| (+ bias variants) — reference BaseLayer.calcL2/calcL1."""
+        total = jnp.asarray(0.0)
+        for k, v in params.items():
+            if k.startswith("b") or "bias" in k.lower():
+                l1c, l2c = self.l1_bias, self.l2_bias
+            elif k in ("gamma", "beta", "mean", "var"):
+                continue  # BN params not regularized (reference parity)
+            else:
+                l1c, l2c = self.l1, self.l2
+            if l2c:
+                total = total + 0.5 * l2c * jnp.sum(v * v)
+            if l1c:
+                total = total + l1c * jnp.sum(jnp.abs(v))
+        return total
+
+    def _init_weight(self, key, shape, fan_in, fan_out, dtype=None):
+        if dtype is None:
+            dtype = jnp.result_type(float)
+        return init_weights(
+            key, shape, fan_in, fan_out,
+            scheme=self.weight_init, distribution=self.distribution, dtype=dtype,
+        )
+
+    def _init_bias(self, shape, dtype=None):
+        if dtype is None:
+            dtype = jnp.result_type(float)
+        return jnp.full(shape, self.bias_init, dtype)
+
+    def _activate(self, preout: jnp.ndarray) -> jnp.ndarray:
+        return get_activation(self.activation)(preout)
+
+
+def maybe_dropout(
+    x: jnp.ndarray, rate: float, train: bool, rng: Optional[jax.Array]
+) -> jnp.ndarray:
+    """Inverted dropout on layer input (reference: util/Dropout.java).
+
+    ``rate`` is the probability of *dropping* a unit; inverted scaling
+    (divide by keep prob) matches Dropout.applyDropout.
+    """
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

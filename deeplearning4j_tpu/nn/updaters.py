@@ -1,0 +1,241 @@
+"""Updaters: gradient transforms with learning-rate schedules and clipping.
+
+TPU-native equivalent of the reference's updater tier (SURVEY.md §2.1 "Updater
+layer"): ND4J ``GradientUpdater`` implementations (Sgd/Adam/AdaDelta/Nesterovs/
+AdaGrad/RmsProp/NoOp) + ``LayerUpdater.update`` (lr/momentum schedules, gradient
+normalization/clipping, minibatch division) + the flattened updater-state view
+array that made checkpoints resumable
+(deeplearning4j-nn/.../nn/updater/LayerUpdater.java:73-113).
+
+Here the whole tier is **optax-style pure transforms with an explicit state
+pytree**: ``build_updater(conf)`` returns an ``optax.GradientTransformation``;
+its state is part of the checkpoint triple (config, params, opt_state) exactly
+like the reference's ``updaterState.bin`` (ModelSerializer.java:56-135).
+
+Differences by design (documented, not accidental):
+- L1/L2 regularization enters through the *loss* (autodiff then routes it through
+  the updater like any other gradient term) rather than the reference's
+  post-updater gradient addition (LayerUpdater.postApply:103-113).
+- Minibatch division is implicit: losses are means over the batch.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules (reference: LearningRatePolicy enum + applyLrDecayPolicy)
+# ---------------------------------------------------------------------------
+
+def build_schedule(
+    lr: float,
+    policy: str = "none",
+    decay_rate: float = 0.0,
+    power: float = 0.0,
+    steps: float = 1.0,
+    gamma: float = 0.0,
+    max_iterations: int = 1,
+    schedule: Optional[Dict[int, float]] = None,
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Return iteration -> learning-rate, mirroring the reference's policies."""
+    policy = (policy or "none").lower()
+    if policy == "none":
+        return lambda it: jnp.asarray(lr)
+    if policy == "exponential":
+        return lambda it: lr * jnp.power(decay_rate, it)
+    if policy == "inverse":
+        return lambda it: lr / jnp.power(1.0 + decay_rate * it, power)
+    if policy == "poly":
+        return lambda it: lr * jnp.power(1.0 - jnp.minimum(it / max_iterations, 1.0), power)
+    if policy == "sigmoid":
+        return lambda it: lr / (1.0 + jnp.exp(-gamma * (it - steps)))
+    if policy == "step":
+        return lambda it: lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if policy == "schedule":
+        # piecewise-constant map {iteration: lr}, like conf.learningRateSchedule
+        sched = sorted((int(k), float(v)) for k, v in (schedule or {}).items())
+        boundaries = jnp.asarray([k for k, _ in sched]) if sched else jnp.asarray([0])
+        values = jnp.asarray([lr] + [v for _, v in sched])
+
+        def fn(it):
+            idx = jnp.sum(it >= boundaries)
+            return values[idx]
+
+        return fn
+    if policy == "torch_step":  # alias
+        return lambda it: lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    raise ValueError(f"Unknown learning-rate policy '{policy}'")
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (reference: GradientNormalization enum, applied in
+# BaseUpdater.preApply before the per-param updater runs)
+# ---------------------------------------------------------------------------
+
+def _per_leaf_l2(g):
+    return jnp.sqrt(jnp.maximum(jnp.sum(g * g), 1e-12))
+
+
+def gradient_normalization(kind: str, threshold: float = 1.0) -> optax.GradientTransformation:
+    """Build the reference's GradientNormalization modes as an optax transform.
+
+    Layer granularity note: the reference's "PerLayer" modes normalize over all
+    params of one layer jointly; "PerParamType" per tensor. Params here are a
+    pytree ``[{'W':..,'b':..}, ...]`` so per-layer = per top-level element.
+    """
+    kind = (kind or "none").lower()
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def per_layer(fn):
+        def update_fn(updates, state, params=None):
+            # updates is a list/tuple of per-layer dicts (possibly empty)
+            def layer_map(layer_updates):
+                leaves = jax.tree_util.tree_leaves(layer_updates)
+                if not leaves:
+                    return layer_updates
+                norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves) + 1e-12)
+                return jax.tree_util.tree_map(lambda g: fn(g, norm), layer_updates)
+
+            if isinstance(updates, (list, tuple)):
+                new = type(updates)(layer_map(lu) for lu in updates)
+            else:
+                new = layer_map(updates)
+            return new, state
+
+        return update_fn
+
+    if kind == "none":
+        return optax.identity()
+    if kind == "renormalizel2perlayer":
+        return optax.GradientTransformation(
+            init_fn, per_layer(lambda g, norm: g / norm)
+        )
+    if kind == "renormalizel2perparamtype":
+        def update_fn(updates, state, params=None):
+            new = jax.tree_util.tree_map(lambda g: g / _per_leaf_l2(g), updates)
+            return new, state
+        return optax.GradientTransformation(init_fn, update_fn)
+    if kind == "clipelementwiseabsolutevalue":
+        def update_fn(updates, state, params=None):
+            new = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -threshold, threshold), updates
+            )
+            return new, state
+        return optax.GradientTransformation(init_fn, update_fn)
+    if kind == "clipl2perlayer":
+        return optax.GradientTransformation(
+            init_fn,
+            per_layer(lambda g, norm: jnp.where(norm > threshold, g * threshold / norm, g)),
+        )
+    if kind == "clipl2perparamtype":
+        def update_fn(updates, state, params=None):
+            def clip(g):
+                n = _per_leaf_l2(g)
+                return jnp.where(n > threshold, g * threshold / n, g)
+            return jax.tree_util.tree_map(clip, updates), state
+        return optax.GradientTransformation(init_fn, update_fn)
+    raise ValueError(f"Unknown gradient normalization '{kind}'")
+
+
+# ---------------------------------------------------------------------------
+# Updater config (reference: Updater enum + per-updater hyperparams on
+# NeuralNetConfiguration.Builder:486-514)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class UpdaterConfig:
+    """JSON-serializable updater description -> optax transform via build()."""
+
+    updater: str = "sgd"
+    learning_rate: float = 0.1
+    # momentum family
+    momentum: float = 0.9
+    # adam family
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    # rmsprop / adadelta
+    rms_decay: float = 0.95
+    rho: float = 0.95
+    # schedules
+    lr_policy: str = "none"
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_power: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_policy_gamma: float = 0.0
+    max_iterations: int = 1
+    learning_rate_schedule: Optional[Dict[int, float]] = None
+    # gradient normalization (reference: GradientNormalization)
+    gradient_normalization: str = "none"
+    gradient_normalization_threshold: float = 1.0
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "UpdaterConfig":
+        d = dict(d)
+        if d.get("learning_rate_schedule"):
+            d["learning_rate_schedule"] = {
+                int(k): float(v) for k, v in d["learning_rate_schedule"].items()
+            }
+        return UpdaterConfig(**d)
+
+    # -- build ---------------------------------------------------------------
+    def build(self) -> optax.GradientTransformation:
+        sched = build_schedule(
+            self.learning_rate,
+            self.lr_policy,
+            self.lr_policy_decay_rate,
+            self.lr_policy_power,
+            self.lr_policy_steps,
+            self.lr_policy_gamma,
+            self.max_iterations,
+            self.learning_rate_schedule,
+        )
+        name = self.updater.lower()
+        if name == "sgd":
+            core = optax.sgd(learning_rate=sched)
+        elif name == "nesterovs":
+            core = optax.sgd(learning_rate=sched, momentum=self.momentum, nesterov=True)
+        elif name == "momentum":
+            core = optax.sgd(learning_rate=sched, momentum=self.momentum)
+        elif name == "adam":
+            core = optax.adam(learning_rate=sched, b1=self.beta1, b2=self.beta2,
+                              eps=self.epsilon)
+        elif name == "adamw":
+            core = optax.adamw(learning_rate=sched, b1=self.beta1, b2=self.beta2,
+                               eps=self.epsilon)
+        elif name == "adamax":
+            core = optax.adamax(learning_rate=sched, b1=self.beta1, b2=self.beta2,
+                                eps=self.epsilon)
+        elif name == "adadelta":
+            core = optax.adadelta(learning_rate=1.0, rho=self.rho, eps=self.epsilon)
+        elif name == "adagrad":
+            core = optax.adagrad(learning_rate=sched, eps=self.epsilon)
+        elif name == "rmsprop":
+            core = optax.rmsprop(learning_rate=sched, decay=self.rms_decay,
+                                 eps=self.epsilon)
+        elif name == "lamb":
+            core = optax.lamb(learning_rate=sched)
+        elif name == "lion":
+            core = optax.lion(learning_rate=sched)
+        elif name in ("none", "noop"):
+            core = optax.set_to_zero()
+        else:
+            raise ValueError(f"Unknown updater '{self.updater}'")
+
+        norm = gradient_normalization(
+            self.gradient_normalization, self.gradient_normalization_threshold
+        )
+        return optax.chain(norm, core)

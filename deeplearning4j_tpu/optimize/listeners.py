@@ -1,0 +1,93 @@
+"""Training listener SPI.
+
+Reference: optimize/api/IterationListener.java + TrainingListener.java and the
+stock listeners in optimize/listeners/ (ScoreIterationListener,
+CollectScoresIterationListener, PerformanceListener — SURVEY.md §5.5).
+
+``iteration_done(model, iteration, score)`` receives the score as a device
+array; listeners that need the float call ``float(score)`` (the
+``block_until_ready`` sync point is theirs to pay, keeping the train loop's
+async dispatch intact when no listener syncs — the reference had the same
+concern with the CUDA grid executioner).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class IterationListener:
+    """SPI (reference: optimize/api/IterationListener.java)."""
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        pass
+
+
+class TrainingListener(IterationListener):
+    """Adds epoch hooks (reference: optimize/api/TrainingListener.java)."""
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference: ScoreIterationListener)."""
+
+    def __init__(self, print_every: int = 10):
+        self.print_every = max(1, print_every)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_every == 0:
+            logger.info("Score at iteration %d is %s", iteration, float(score))
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Accumulate (iteration, score) pairs (reference: CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput: samples/sec + batches/sec (reference: PerformanceListener.java —
+    the in-tree measurement hook called out in SURVEY.md §6)."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency:
+            return
+        now = time.perf_counter()
+        if self._last_time is not None:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            batch = getattr(model, "last_batch_size", None)
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": iters / dt if dt > 0 else float("inf"),
+            }
+            if batch:
+                rec["samples_per_sec"] = iters * batch / dt
+            if self.report_score:
+                rec["score"] = float(score)
+            self.history.append(rec)
+            logger.info("perf: %s", rec)
+        self._last_time = now
+        self._last_iter = iteration

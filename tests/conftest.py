@@ -1,0 +1,46 @@
+"""Test harness config.
+
+Forces the CPU backend with 8 virtual devices — the analog of the reference's
+Spark `local[n]` test trick (SURVEY.md §4.3): multi-device mesh semantics
+(sharding, collectives, averaging) are exercised in one process without TPU
+hardware. Must run before jax is imported anywhere.
+
+Also enables x64 so gradient checks (tests/test_gradcheck.py) run in float64,
+matching the reference's double-precision GradientCheckUtil runs.
+"""
+
+import os
+
+# Force-override: the environment pins JAX_PLATFORMS=axon (the real TPU tunnel);
+# tests must run on the virtual 8-device CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# Persistent compilation cache: repeated test runs skip XLA recompiles.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)
+
+
+@pytest.fixture
+def tiny_classification(rng):
+    """Linearly-separable-ish 3-class problem (Iris-shaped: 4 features)."""
+    n, f, c = 96, 4, 3
+    x = rng.normal(size=(n, f)).astype(np.float64)
+    w = rng.normal(size=(f, c))
+    y_idx = (x @ w + 0.1 * rng.normal(size=(n, c))).argmax(-1)
+    y = np.eye(c)[y_idx]
+    return x, y

@@ -1,0 +1,96 @@
+"""Config DSL + JSON round-trip + shape inference tests.
+
+Mirrors the reference's config serialization tests (SURVEY.md §4.5:
+"config JSON <-> object" round-trips).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    OutputLayer,
+    ActivationLayer,
+    DropoutLayer,
+    InputType,
+    MultiLayerConfiguration,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+)
+
+
+def make_conf():
+    return MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu", weight_init="xavier", l2=1e-4),
+            DropoutLayer(dropout=0.25),
+            DenseLayer(n_out=8, activation="tanh"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        seed=42,
+    )
+
+
+def test_json_round_trip():
+    conf = make_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert len(conf2.layers) == 4
+    assert isinstance(conf2.layers[0], DenseLayer)
+    assert conf2.layers[0].n_out == 16
+    assert conf2.layers[0].l2 == pytest.approx(1e-4)
+    assert isinstance(conf2.layers[3], OutputLayer)
+    assert conf2.layers[3].loss == "mcxent"
+    assert conf2.updater.updater == "adam"
+    assert conf2.input_type == InputType.feed_forward(4)
+
+
+def test_shape_inference():
+    conf = make_conf()
+    its = conf.layer_input_types()
+    assert [it.flat_size() for it in its] == [4, 16, 16, 8]
+    assert conf.output_type().flat_size() == 3
+
+
+def test_preprocessor_round_trip():
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=10), OutputLayer(n_out=2, loss="mse")],
+        input_type=InputType.convolutional(4, 4, 2),
+        preprocessors={0: CnnToFeedForwardPreProcessor(4, 4, 2)},
+    )
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert isinstance(conf2.preprocessors[0], CnnToFeedForwardPreProcessor)
+    assert conf2.layer_input_types()[0].flat_size() == 32
+
+
+def test_preprocessor_apply_shapes():
+    import jax.numpy as jnp
+
+    x = jnp.ones((5, 4, 4, 2))
+    flat = CnnToFeedForwardPreProcessor(4, 4, 2).apply(x)
+    assert flat.shape == (5, 32)
+    back = FeedForwardToCnnPreProcessor(4, 4, 2).apply(flat)
+    assert back.shape == (5, 4, 4, 2)
+
+
+def test_input_type_factories():
+    assert InputType.recurrent(10, 5).example_shape() == (5, 10)
+    assert InputType.convolutional(28, 28, 1).example_shape() == (28, 28, 1)
+    assert InputType.convolutional_flat(28, 28, 1).flat_size() == 784
+    d = InputType.recurrent(7, None).to_dict()
+    assert InputType.from_dict(d).timesteps is None
+
+
+def test_unknown_layer_type_rejected():
+    conf = make_conf()
+    d = conf.to_dict()
+    d["layers"][0]["@type"] = "NoSuchLayer"
+    with pytest.raises(ValueError, match="NoSuchLayer"):
+        MultiLayerConfiguration.from_dict(d)

@@ -1,0 +1,122 @@
+"""Evaluation metrics + dataset iterator tests (SURVEY.md §2.1/§2.2 parity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    AsyncDataSetIterator,
+    DataSet,
+    Evaluation,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    NumpyDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.iterators import (
+    ExistingDataSetIterator,
+    IteratorDataSetIterator,
+    SamplingDataSetIterator,
+)
+
+
+class TestEvaluation:
+    def test_perfect_predictions(self):
+        ev = Evaluation()
+        labels = np.eye(3)[[0, 1, 2, 0, 1]]
+        ev.eval(labels, labels)
+        assert ev.accuracy() == 1.0
+        assert ev.precision() == 1.0
+        assert ev.recall() == 1.0
+        assert ev.f1() == 1.0
+
+    def test_known_confusion(self):
+        ev = Evaluation()
+        # actual:    0 0 1 1
+        # predicted: 0 1 1 1
+        labels = np.eye(2)[[0, 0, 1, 1]]
+        preds = np.eye(2)[[0, 1, 1, 1]]
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(0.75)
+        assert ev.confusion.get_count(0, 1) == 1
+        assert ev.recall(0) == pytest.approx(0.5)
+        assert ev.precision(1) == pytest.approx(2 / 3)
+        assert "Accuracy" in ev.stats()
+
+    def test_accumulates_over_batches(self):
+        ev = Evaluation()
+        for _ in range(4):
+            labels = np.eye(2)[[0, 1]]
+            ev.eval(labels, labels)
+        assert ev.examples == 8
+        assert ev.accuracy() == 1.0
+
+    def test_int_labels(self):
+        ev = Evaluation()
+        ev.eval(np.array([0, 1, 2]), np.eye(3))
+        assert ev.accuracy() == 1.0
+
+    def test_time_series_flattened(self):
+        ev = Evaluation()
+        labels = np.eye(2)[[[0, 1], [1, 0]]]  # [2,2,2]
+        ev.eval(labels, labels)
+        assert ev.examples == 4
+
+
+class TestIterators:
+    def test_numpy_iterator_drops_last(self):
+        x = np.zeros((10, 3))
+        y = np.zeros((10, 2))
+        it = NumpyDataSetIterator(x, y, batch=4)
+        batches = list(it)
+        assert len(batches) == 2
+        assert all(b.features.shape == (4, 3) for b in batches)
+
+    def test_numpy_iterator_shuffles_per_epoch(self):
+        x = np.arange(8).reshape(8, 1).astype(float)
+        it = NumpyDataSetIterator(x, x, batch=8, shuffle=True, seed=1)
+        e1 = next(iter(it)).features.ravel()
+        e2 = next(iter(it)).features.ravel()
+        assert not np.array_equal(e1, e2)
+        assert sorted(e1) == sorted(e2)
+
+    def test_async_iterator_yields_same_data(self):
+        base = ListDataSetIterator(
+            [DataSet(np.full((2, 2), i), np.zeros((2, 1))) for i in range(20)]
+        )
+        out = list(AsyncDataSetIterator(base, queue_size=3))
+        assert len(out) == 20
+        for i, ds in enumerate(out):
+            assert ds.features[0, 0] == i
+
+    def test_async_iterator_propagates_errors(self):
+        def gen():
+            yield DataSet(np.zeros((1, 1)), np.zeros((1, 1)))
+            raise RuntimeError("boom")
+
+        it = AsyncDataSetIterator(ExistingDataSetIterator(gen()))
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_multiple_epochs(self):
+        base = ListDataSetIterator([DataSet(np.zeros((1, 1)), np.zeros((1, 1)))] * 3)
+        assert len(list(MultipleEpochsIterator(4, base))) == 12
+
+    def test_sampling_iterator(self):
+        ds = DataSet(np.arange(20).reshape(20, 1).astype(float), np.zeros((20, 1)))
+        it = SamplingDataSetIterator(ds, batch=5, total_batches=7)
+        batches = list(it)
+        assert len(batches) == 7
+        assert all(b.features.shape == (5, 1) for b in batches)
+
+    def test_iterator_dataset_iterator_rebatches(self):
+        examples = (DataSet(np.full(3, i), np.array([i])) for i in range(9))
+        it = IteratorDataSetIterator(examples, batch=4)
+        batches = list(it)
+        assert len(batches) == 2  # trailing partial dropped
+        assert batches[0].features.shape == (4, 3)
+
+    def test_dataset_split_and_shuffle(self):
+        ds = DataSet(np.arange(10).reshape(10, 1).astype(float), np.zeros((10, 2)))
+        a, b = ds.split_test_and_train(7)
+        assert a.num_examples() == 7 and b.num_examples() == 3
+        sh = ds.shuffle(seed=3)
+        assert sorted(sh.features.ravel()) == list(range(10))

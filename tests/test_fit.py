@@ -1,0 +1,142 @@
+"""End-to-end training tests: deterministic small-data integration.
+
+Mirrors the reference's pattern (SURVEY.md §4.2): train a small net and assert
+the score decreases / accuracy exceeds a threshold.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    CollectScoresIterationListener,
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    NumpyDataSetIterator,
+    OutputLayer,
+    PerformanceListener,
+    UpdaterConfig,
+)
+
+
+def make_net(updater="adam", lr=0.01, seed=42, dropout=0.0):
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu", dropout=dropout),
+            DenseLayer(n_out=16, activation="relu"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(updater=updater, learning_rate=lr),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "nesterovs", "rmsprop", "adagrad", "adadelta"])
+def test_score_decreases(updater, tiny_classification):
+    x, y = tiny_classification
+    lr = 0.05 if updater in ("sgd", "nesterovs") else 0.01
+    net = make_net(updater=updater, lr=lr)
+    scores = CollectScoresIterationListener()
+    net.set_listeners(scores)
+    it = NumpyDataSetIterator(x, y, batch=32)
+    net.fit(it, epochs=30)
+    first = scores.scores[0][1]
+    last = scores.scores[-1][1]
+    assert np.isfinite(last)
+    assert last < first * 0.9, f"{updater}: score did not decrease ({first} -> {last})"
+
+
+def test_accuracy_threshold(tiny_classification):
+    x, y = tiny_classification
+    net = make_net(updater="adam", lr=0.02)
+    it = NumpyDataSetIterator(x, y, batch=32, shuffle=True)
+    net.fit(it, epochs=60)
+    ev = net.evaluate(NumpyDataSetIterator(x, y, batch=32))
+    assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_fit_deterministic_given_seed(tiny_classification):
+    x, y = tiny_classification
+    n1 = make_net(seed=7)
+    n2 = make_net(seed=7)
+    it = NumpyDataSetIterator(x, y, batch=32)
+    n1.fit(it, epochs=3)
+    n2.fit(NumpyDataSetIterator(x, y, batch=32), epochs=3)
+    for a, b in zip(n1.params, n2.params):
+        for k in a:
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=1e-6)
+
+
+def test_dropout_trains(tiny_classification):
+    x, y = tiny_classification
+    net = make_net(dropout=0.3, lr=0.02)
+    scores = CollectScoresIterationListener()
+    net.set_listeners(scores)
+    net.fit(NumpyDataSetIterator(x, y, batch=32), epochs=30)
+    assert scores.scores[-1][1] < scores.scores[0][1]
+
+
+def test_output_and_predict(tiny_classification):
+    x, y = tiny_classification
+    net = make_net()
+    out = np.asarray(net.output(x[:10]))
+    assert out.shape == (10, 3)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)  # softmax rows
+    preds = net.predict(x[:10])
+    assert preds.shape == (10,)
+    assert preds.dtype.kind == "i"
+
+
+def test_performance_listener(tiny_classification):
+    x, y = tiny_classification
+    net = make_net()
+    perf = PerformanceListener(frequency=1)
+    net.set_listeners(perf)
+    net.fit(NumpyDataSetIterator(x, y, batch=32), epochs=2)
+    assert len(perf.history) >= 2
+    assert perf.history[-1]["samples_per_sec"] > 0
+
+
+def test_lr_schedule_step_policy(tiny_classification):
+    x, y = tiny_classification
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=8, activation="tanh"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(
+            updater="sgd", learning_rate=0.1, lr_policy="step",
+            lr_policy_decay_rate=0.5, lr_policy_steps=10,
+        ),
+    )
+    net = MultiLayerNetwork(conf).init()
+    scores = CollectScoresIterationListener()
+    net.set_listeners(scores)
+    net.fit(NumpyDataSetIterator(x, y, batch=32), epochs=20)
+    assert scores.scores[-1][1] < scores.scores[0][1]
+
+
+def test_gradient_clipping_modes(tiny_classification):
+    x, y = tiny_classification
+    for mode in ["clipl2perlayer", "clipelementwiseabsolutevalue",
+                 "renormalizel2perlayer", "clipl2perparamtype"]:
+        conf = MultiLayerConfiguration(
+            layers=[
+                DenseLayer(n_out=8, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.feed_forward(4),
+            updater=UpdaterConfig(
+                updater="sgd", learning_rate=0.1,
+                gradient_normalization=mode, gradient_normalization_threshold=1.0,
+            ),
+        )
+        net = MultiLayerNetwork(conf).init()
+        scores = CollectScoresIterationListener()
+        net.set_listeners(scores)
+        net.fit(NumpyDataSetIterator(x, y, batch=32), epochs=10)
+        assert scores.scores[-1][1] < scores.scores[0][1], mode
