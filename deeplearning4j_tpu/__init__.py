@@ -21,6 +21,13 @@ from .nn.layers.dense import (
     DropoutLayer,
     EmbeddingLayer,
 )
+from .nn.layers.convolution import (
+    ConvolutionLayer,
+    Convolution1DLayer,
+    ZeroPaddingLayer,
+)
+from .nn.layers.pooling import SubsamplingLayer, GlobalPoolingLayer
+from .nn.layers.normalization import BatchNormalization, LocalResponseNormalization
 from .datasets.iterators import (
     DataSet,
     MultiDataSet,
@@ -53,6 +60,13 @@ __all__ = [
     "ActivationLayer",
     "DropoutLayer",
     "EmbeddingLayer",
+    "ConvolutionLayer",
+    "Convolution1DLayer",
+    "ZeroPaddingLayer",
+    "SubsamplingLayer",
+    "GlobalPoolingLayer",
+    "BatchNormalization",
+    "LocalResponseNormalization",
     "DataSet",
     "MultiDataSet",
     "DataSetIterator",

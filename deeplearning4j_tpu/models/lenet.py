@@ -1,0 +1,41 @@
+"""LeNet-5-style MNIST model (BASELINE config #1).
+
+Role parity: the reference era's canonical first CNN (dl4j-examples
+LenetMnistExample shape; model-zoo role of modelimport's TrainedModels —
+SURVEY.md §2.7). Built from the framework's own config DSL.
+"""
+
+from __future__ import annotations
+
+from ..nn.conf.inputs import InputType
+from ..nn.conf.multi_layer import MultiLayerConfiguration
+from ..nn.layers.convolution import ConvolutionLayer
+from ..nn.layers.dense import DenseLayer, OutputLayer
+from ..nn.layers.pooling import SubsamplingLayer
+from ..nn.updaters import UpdaterConfig
+
+
+def lenet_mnist_conf(
+    height: int = 28,
+    width: int = 28,
+    channels: int = 1,
+    n_classes: int = 10,
+    learning_rate: float = 1e-3,
+    updater: str = "adam",
+    dtype: str = "float32",
+    seed: int = 12345,
+) -> MultiLayerConfiguration:
+    return MultiLayerConfiguration(
+        layers=[
+            ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1), activation="identity"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1), activation="identity"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            DenseLayer(n_out=500, activation="relu"),
+            OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.convolutional(height, width, channels),
+        updater=UpdaterConfig(updater=updater, learning_rate=learning_rate),
+        dtype=dtype,
+        seed=seed,
+    )

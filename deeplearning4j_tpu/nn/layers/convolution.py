@@ -1,0 +1,218 @@
+"""Convolution + padding layers.
+
+Reference parity: nn/conf/layers/ConvolutionLayer + nn/layers/convolution/
+ConvolutionLayer.java (im2col+gemm at :166-185, Same-mode padding :135-141),
+ZeroPaddingLayer, and the cuDNN helper tier (deeplearning4j-cuda
+CudnnConvolutionHelper.java) — SURVEY.md §2.1/§2.3.
+
+TPU-native: ``lax.conv_general_dilated`` in NHWC/HWIO layout lowers straight to
+XLA convolution HLO, which the TPU compiler maps onto the MXU — the whole
+im2col/cuDNN/helper indirection of the reference disappears (SURVEY.md §2.3
+note). ConvolutionMode semantics (Strict/Truncate/Same) follow the reference's
+output-size rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, Params, register_layer, maybe_dropout
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def conv_output_size(size: int, k: int, s: int, p: int, mode: str, dilation: int = 1) -> int:
+    """Output spatial size per the reference's ConvolutionMode rules
+    (ConvolutionUtils.getOutputSize; Same at ConvolutionLayer.java:135-141)."""
+    k_eff = k + (k - 1) * (dilation - 1)
+    if mode == "same":
+        if p:
+            # reference parity: ConvolutionUtils rejects Same + explicit padding
+            raise ValueError(
+                "ConvolutionMode=same ignores explicit padding; set padding=0 "
+                f"(got padding={p})"
+            )
+        return -(-size // s)  # ceil(size / stride)
+    if mode == "strict":
+        if (size - k_eff + 2 * p) % s != 0:
+            raise ValueError(
+                f"ConvolutionMode=strict: (in={size} - k={k_eff} + 2*p={p}) not divisible by stride {s}"
+            )
+        return (size - k_eff + 2 * p) // s + 1
+    # truncate: floor
+    return (size - k_eff + 2 * p) // s + 1
+
+
+def _same_pads(size: int, k: int, s: int, dilation: int = 1) -> Tuple[int, int]:
+    """Asymmetric Same padding, low = total//2 (XLA 'SAME' == reference's rule)."""
+    k_eff = k + (k - 1) * (dilation - 1)
+    out = -(-size // s)
+    total = max((out - 1) * s + k_eff - size, 0)
+    return total // 2, total - total // 2
+
+
+@register_layer
+@dataclass
+class ConvolutionLayer(BaseLayer):
+    """2D convolution, NHWC (reference: nn/conf/layers/ConvolutionLayer.java).
+
+    Params: W [kh, kw, in, out] (HWIO), b [out]. Weight-init fans follow the
+    reference (fanIn = in*kh*kw, fanOut = out*kh*kw / stride-area).
+    """
+
+    n_in: int = 0  # channels; inferred when 0
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"  # reference default (ConvolutionMode.Truncate)
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel = _pair(self.kernel)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def get_output_type(self, it: InputType) -> InputType:
+        if it.kind != "cnn":
+            raise ValueError(f"ConvolutionLayer expects CNN input, got {it.kind}")
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        oh = conv_output_size(it.height, kh, sh, ph, self.convolution_mode, self.dilation[0])
+        ow = conv_output_size(it.width, kw, sw, pw, self.convolution_mode, self.dilation[1])
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init_params(self, key, it: InputType) -> Params:
+        n_in = self.n_in or it.channels
+        kh, kw = self.kernel
+        fan_in = n_in * kh * kw
+        fan_out = self.n_out * kh * kw / (self.stride[0] * self.stride[1])
+        wkey, _ = jax.random.split(key)
+        p = {"W": self._init_weight(wkey, (kh, kw, n_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = self._init_bias((self.n_out,))
+        return p
+
+    def _pads(self, it_shape) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+        h, w = it_shape
+        if self.convolution_mode == "same":
+            return (
+                _same_pads(h, self.kernel[0], self.stride[0], self.dilation[0]),
+                _same_pads(w, self.kernel[1], self.stride[1], self.dilation[1]),
+            )
+        return (
+            (self.padding[0], self.padding[0]),
+            (self.padding[1], self.padding[1]),
+        )
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = maybe_dropout(x, self.dropout, train, rng)
+        pads = self._pads(x.shape[1:3])
+        z = lax.conv_general_dilated(
+            x,
+            params["W"],
+            window_strides=self.stride,
+            padding=pads,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self._activate(z), state
+
+
+@register_layer
+@dataclass
+class Convolution1DLayer(BaseLayer):
+    """1D convolution over [B,T,F] sequences (reference: Convolution1DLayer)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "same"
+    has_bias: bool = True
+
+    def get_output_type(self, it: InputType) -> InputType:
+        t = it.timesteps
+        if t is not None:
+            t = conv_output_size(t, self.kernel, self.stride, self.padding, self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, it: InputType) -> Params:
+        n_in = self.n_in or it.size
+        fan_in = n_in * self.kernel
+        fan_out = self.n_out * self.kernel / self.stride
+        wkey, _ = jax.random.split(key)
+        p = {"W": self._init_weight(wkey, (self.kernel, n_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = self._init_bias((self.n_out,))
+        return p
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = maybe_dropout(x, self.dropout, train, rng)
+        if self.convolution_mode == "same":
+            lo, hi = _same_pads(x.shape[1], self.kernel, self.stride)
+            pads = [(lo, hi)]
+        else:
+            pads = [(self.padding, self.padding)]
+        z = lax.conv_general_dilated(
+            x,
+            params["W"],
+            window_strides=(self.stride,),
+            padding=pads,
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self._activate(z), state
+
+
+@register_layer
+@dataclass
+class ZeroPaddingLayer(BaseLayer):
+    """Spatial zero padding (reference: nn/conf/layers/ZeroPaddingLayer)."""
+
+    pad_top: int = 0
+    pad_bottom: int = 0
+    pad_left: int = 0
+    pad_right: int = 0
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(
+            it.height + self.pad_top + self.pad_bottom,
+            it.width + self.pad_left + self.pad_right,
+            it.channels,
+        )
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return (
+            jnp.pad(
+                x,
+                (
+                    (0, 0),
+                    (self.pad_top, self.pad_bottom),
+                    (self.pad_left, self.pad_right),
+                    (0, 0),
+                ),
+            ),
+            state,
+        )

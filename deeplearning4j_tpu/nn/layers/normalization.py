@@ -1,0 +1,100 @@
+"""Normalization layers: BatchNormalization + LocalResponseNormalization.
+
+Reference parity: nn/conf/layers/BatchNormalization + nn/layers/normalization/
+BatchNormalization.java (452 LoC) and LocalResponseNormalization.java (238 LoC)
++ their cuDNN helpers (SURVEY.md §2.3). TPU-native: both are fused elementwise/
+reduction chains XLA compiles into a couple of kernels; running stats live in
+the explicit state pytree (threaded through the jitted train step) instead of
+the reference's mutable param-view arrays.
+
+BatchNorm conventions follow the reference: decay (default 0.9) for the
+moving average — moving = decay*moving + (1-decay)*batch — eps 1e-5, and
+optional lockGammaBeta (fixed gamma/beta).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, Params, State, register_layer
+
+
+@register_layer
+@dataclass
+class BatchNormalization(BaseLayer):
+    """Per-channel batch norm over NHWC images or [B,F] activations."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    def _n_feat(self, it: InputType) -> int:
+        return it.channels if it.kind == "cnn" else it.flat_size()
+
+    def init_params(self, key, it: InputType) -> Params:
+        if self.lock_gamma_beta:
+            return {}
+        n = self._n_feat(it)
+        dt = jnp.result_type(float)
+        return {
+            "gamma": jnp.full((n,), self.gamma_init, dt),
+            "beta": jnp.full((n,), self.beta_init, dt),
+        }
+
+    def init_state(self, it: InputType) -> State:
+        n = self._n_feat(it)
+        dt = jnp.result_type(float)
+        return {"mean": jnp.zeros((n,), dt), "var": jnp.ones((n,), dt)}
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        else:
+            xhat = xhat * self.gamma_init + self.beta_init
+        return self._activate(xhat), new_state
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(BaseLayer):
+    """Cross-channel LRN (reference: LocalResponseNormalization.java defaults
+    k=2, n=5, alpha=1e-4, beta=0.75): y = x / (k + alpha*sum_n x^2)^beta."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        # window-sum of squares over the channel axis (NHWC last axis)
+        half = self.n // 2
+        sq = x * x
+        # pad channels, then a small static unrolled window sum — XLA fuses this
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        acc = jnp.zeros_like(x)
+        for i in range(self.n):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + x.shape[-1], axis=-1)
+        denom = (self.k + self.alpha * acc) ** self.beta
+        return self._activate(x / denom), state

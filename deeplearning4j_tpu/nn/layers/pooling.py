@@ -1,0 +1,130 @@
+"""Pooling layers.
+
+Reference parity: nn/conf/layers/SubsamplingLayer + nn/layers/convolution/
+subsampling/SubsamplingLayer.java (+ CudnnSubsamplingHelper — SURVEY.md §2.3),
+GlobalPoolingLayer.java (:321). TPU-native: ``lax.reduce_window`` lowers to XLA
+ReduceWindow; its gradient (the scatter in max-pool backward) is supplied by
+autodiff.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, register_layer
+from .convolution import _pair, _same_pads, conv_output_size
+
+
+@register_layer
+@dataclass
+class SubsamplingLayer(BaseLayer):
+    """Max/avg spatial pooling, NHWC (reference: SubsamplingLayer.java)."""
+
+    pooling_type: str = "max"  # max | avg | sum
+    kernel: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+
+    def __post_init__(self):
+        self.kernel = _pair(self.kernel)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, it: InputType) -> InputType:
+        oh = conv_output_size(
+            it.height, self.kernel[0], self.stride[0], self.padding[0], self.convolution_mode
+        )
+        ow = conv_output_size(
+            it.width, self.kernel[1], self.stride[1], self.padding[1], self.convolution_mode
+        )
+        return InputType.convolutional(oh, ow, it.channels)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if self.convolution_mode == "same":
+            pads = (
+                (0, 0),
+                _same_pads(x.shape[1], self.kernel[0], self.stride[0]),
+                _same_pads(x.shape[2], self.kernel[1], self.stride[1]),
+                (0, 0),
+            )
+        else:
+            pads = (
+                (0, 0),
+                (self.padding[0], self.padding[0]),
+                (self.padding[1], self.padding[1]),
+                (0, 0),
+            )
+        window = (1, self.kernel[0], self.kernel[1], 1)
+        strides = (1, self.stride[0], self.stride[1], 1)
+        if self.pooling_type == "max":
+            init = -jnp.inf
+            out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        elif self.pooling_type in ("avg", "sum"):
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            if self.pooling_type == "avg":
+                # exclude-pad divisor (reference parity): divide by the count of
+                # real elements in each window; XLA constant-folds the counts.
+                ones = jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype)
+                counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+                out = out / counts
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return out, state
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(BaseLayer):
+    """Pool CNN spatial dims or RNN time dim away (reference: GlobalPoolingLayer.java:321).
+
+    CNN [B,H,W,C] -> [B,C]; RNN [B,T,F] -> [B,F]. Mask-aware over time for
+    padded sequences (reference: MaskedReductionUtil) — masked steps are
+    excluded from the reduction.
+    """
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, it: InputType) -> InputType:
+        if it.kind == "cnn":
+            return InputType.feed_forward(it.channels)
+        return InputType.feed_forward(it.size)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        axes = (1, 2) if x.ndim == 4 else (1,)
+        if mask is not None and x.ndim == 3:
+            m = mask.reshape(mask.shape[0], mask.shape[1], 1)
+            if self.pooling_type == "max":
+                x = jnp.where(m > 0, x, -jnp.inf)
+                return jnp.max(x, axis=axes), state
+            if self.pooling_type == "avg":
+                s = jnp.sum(x * m, axis=axes)
+                return s / jnp.maximum(jnp.sum(m, axis=axes), 1.0), state
+            if self.pooling_type == "sum":
+                return jnp.sum(x * m, axis=axes), state
+            if self.pooling_type == "pnorm":
+                s = jnp.sum(jnp.abs(x * m) ** self.pnorm, axis=axes)
+                return s ** (1.0 / self.pnorm), state
+        if self.pooling_type == "max":
+            return jnp.max(x, axis=axes), state
+        if self.pooling_type == "avg":
+            return jnp.mean(x, axis=axes), state
+        if self.pooling_type == "sum":
+            return jnp.sum(x, axis=axes), state
+        if self.pooling_type == "pnorm":
+            return jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm), state
+        raise ValueError(f"Unknown pooling type '{self.pooling_type}'")

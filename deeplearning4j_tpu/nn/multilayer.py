@@ -96,8 +96,15 @@ class MultiLayerNetwork:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 
     # ------------------------------------------------------- functional core
-    def _forward(self, params, x, state, train: bool, rng, *, upto: Optional[int] = None):
-        """Forward pass through layers [0, upto). Returns (x, new_state)."""
+    def _forward(
+        self, params, x, state, train: bool, rng, *,
+        upto: Optional[int] = None, features_mask=None,
+    ):
+        """Forward pass through layers [0, upto). Returns (x, new_state).
+
+        ``features_mask`` ([batch, time] for padded sequences) reaches every
+        layer's ``apply`` (reference: Layer.setMaskArray / feedForward masking).
+        """
         layers = self.conf.layers
         n = len(layers) if upto is None else upto
         params, x = _compute_cast(self.conf.dtype, params, x)
@@ -110,18 +117,21 @@ class MultiLayerNetwork:
             if pre is not None:
                 x = pre.apply(x)
             x, new_state[i] = layers[i].apply(
-                params[i], x, state[i], train=train, rng=rngs[i]
+                params[i], x, state[i], train=train, rng=rngs[i], mask=features_mask
             )
         return x, tuple(new_state)
 
-    def _loss(self, params, state, x, y, rng, train: bool, labels_mask=None):
+    def _loss(self, params, state, x, y, rng, train: bool, labels_mask=None,
+              features_mask=None):
         """Loss + regularization (reference: computeGradientAndScore + calcL1/L2)."""
         layers = self.conf.layers
         out_idx = len(layers) - 1
         fwd_rng, out_rng = (
             jax.random.split(rng) if rng is not None else (None, None)
         )
-        h, new_state = self._forward(params, x, state, train, fwd_rng, upto=out_idx)
+        h, new_state = self._forward(
+            params, x, state, train, fwd_rng, upto=out_idx, features_mask=features_mask
+        )
         out_layer = layers[out_idx]
         pre = self.conf.preprocessors.get(out_idx)
         if pre is not None:
@@ -139,19 +149,20 @@ class MultiLayerNetwork:
         )
         return loss + reg, new_state
 
-    def loss_fn(self, params, x, y, *, train: bool = False, state=None, rng=None):
+    def loss_fn(self, params, x, y, *, train: bool = False, state=None, rng=None,
+                labels_mask=None, features_mask=None):
         """Pure scalar loss of params — the gradient-check entry point."""
         st = state if state is not None else self.state
-        val, _ = self._loss(params, st, x, y, rng, train)
+        val, _ = self._loss(params, st, x, y, rng, train, labels_mask, features_mask)
         return val
 
     # ------------------------------------------------------------- train step
     def _build_train_step(self):
         tx = self._tx
 
-        def step(params, opt_state, state, x, y, rng, labels_mask):
+        def step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
             def loss_of(p):
-                return self._loss(p, state, x, y, rng, True, labels_mask)
+                return self._loss(p, state, x, y, rng, True, labels_mask, features_mask)
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
@@ -195,7 +206,7 @@ class MultiLayerNetwork:
         self._rng, step_key = jax.random.split(self._rng)
         self.params, self.opt_state, self.state, loss = self._train_step(
             self.params, self.opt_state, self.state, ds.features, ds.labels, step_key,
-            getattr(ds, "labels_mask", None),
+            getattr(ds, "labels_mask", None), getattr(ds, "features_mask", None),
         )
         self._last_loss = loss
         self.iteration += 1
@@ -203,14 +214,16 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, loss)
 
     # -------------------------------------------------------------- inference
-    def output(self, x, train: bool = False):
+    def output(self, x, train: bool = False, features_mask=None):
         """Inference output (reference: MultiLayerNetwork.output:1505)."""
         self.init()
         if self._eval_forward is None:
             self._eval_forward = jax.jit(
-                lambda params, state, x: self._forward(params, x, state, False, None)[0]
+                lambda params, state, x, fm: self._forward(
+                    params, x, state, False, None, features_mask=fm
+                )[0]
             )
-        return self._eval_forward(self.params, self.state, jnp.asarray(x))
+        return self._eval_forward(self.params, self.state, jnp.asarray(x), features_mask)
 
     def predict(self, x) -> np.ndarray:
         """Class indices (reference: MultiLayerNetwork.predict)."""
@@ -246,7 +259,7 @@ class MultiLayerNetwork:
 
         ev = Evaluation(top_n=top_n)
         for ds in as_iterator(data):
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=getattr(ds, "features_mask", None))
             ev.eval(ds.labels, out)
         return ev
 

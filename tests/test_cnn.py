@@ -1,0 +1,286 @@
+"""Conv-family tests: gradient checks + shape inference + LeNet training.
+
+Mirrors the reference's CNNGradientCheckTest / BNGradientCheckTest /
+LRNGradientCheckTests / GlobalPoolingGradientCheckTests (SURVEY.md §4.1) and
+the deterministic LeNet-MNIST integration pattern (§4.2).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    InputType,
+    LocalResponseNormalization,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    NumpyDataSetIterator,
+    OutputLayer,
+    SubsamplingLayer,
+    UpdaterConfig,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import conv_output_size
+from deeplearning4j_tpu.utils.gradcheck import gradient_check
+
+
+def image_data(n=6, h=8, w=8, c=2, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    y = np.eye(classes)[rng.integers(0, classes, size=n)]
+    return x, y
+
+
+def build(layers, h=8, w=8, c=2):
+    conf = MultiLayerConfiguration(
+        layers=layers,
+        input_type=InputType.convolutional(h, w, c),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        seed=7,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestShapeInference:
+    def test_conv_output_size_rules(self):
+        # truncate: floor((in - k + 2p)/s) + 1
+        assert conv_output_size(28, 5, 1, 0, "truncate") == 24
+        assert conv_output_size(7, 3, 2, 0, "truncate") == 3
+        # same: ceil(in/s)
+        assert conv_output_size(28, 5, 1, 0, "same") == 28
+        assert conv_output_size(7, 3, 2, 0, "same") == 4
+        # strict raises on non-divisible
+        with pytest.raises(ValueError):
+            conv_output_size(8, 3, 2, 0, "strict")  # (8-3) % 2 != 0
+        assert conv_output_size(7, 3, 2, 0, "strict") == 3  # divisible: ok
+
+    def test_network_shape_chain(self):
+        net = build(
+            [
+                ConvolutionLayer(n_out=4, kernel=(3, 3), convolution_mode="same"),
+                SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+                ConvolutionLayer(n_out=8, kernel=(3, 3)),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        its = net.conf.layer_input_types()
+        assert its[1].example_shape() == (8, 8, 4)  # same conv keeps 8x8
+        assert its[2].example_shape() == (4, 4, 4)  # pooled
+        assert its[3].example_shape() == (2, 2, 8)  # valid 3x3
+        assert its[4].flat_size() == 8  # global pooled to channels
+        out = net.output(np.zeros((2, 8, 8, 2), np.float32))
+        assert out.shape == (2, 3)
+
+    def test_zero_padding(self):
+        net = build(
+            [
+                ZeroPaddingLayer(pad_top=1, pad_bottom=2, pad_left=3, pad_right=0),
+                GlobalPoolingLayer(pooling_type="sum"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        its = net.conf.layer_input_types()
+        assert its[1].example_shape() == (11, 11, 2)
+
+
+class TestGradients:
+    def check(self, net, x, y, budget=60):
+        ok, failures, max_rel = gradient_check(
+            net.loss_fn, net.params, x, y, max_params_to_check=budget, verbose=True
+        )
+        assert ok, f"{failures} failures, max rel {max_rel:.3g}"
+
+    def test_conv_truncate(self):
+        x, y = image_data()
+        net = build(
+            [
+                ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        self.check(net, x, y)
+
+    def test_conv_same_strided(self):
+        x, y = image_data()
+        net = build(
+            [
+                ConvolutionLayer(
+                    n_out=3, kernel=(3, 3), stride=(2, 2), convolution_mode="same",
+                    activation="sigmoid",
+                ),
+                GlobalPoolingLayer(pooling_type="sum"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        self.check(net, x, y)
+
+    @pytest.mark.parametrize("pool", ["max", "avg", "sum"])
+    def test_subsampling(self, pool):
+        x, y = image_data(seed=2)
+        net = build(
+            [
+                ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                SubsamplingLayer(pooling_type=pool, kernel=(2, 2), stride=(2, 2)),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        self.check(net, x, y)
+
+    def test_batchnorm_train_mode(self):
+        x, y = image_data(seed=3)
+        net = build(
+            [
+                ConvolutionLayer(n_out=3, kernel=(3, 3), activation="identity"),
+                BatchNormalization(activation="relu"),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        loss_train = lambda p, xx, yy: net.loss_fn(p, xx, yy, train=True)
+        ok, failures, max_rel = gradient_check(
+            loss_train, net.params, x + 0.05 * np.sign(x), y,
+            max_params_to_check=60, verbose=True,
+        )
+        assert ok, f"{failures} BN failures, max rel {max_rel:.3g}"
+
+    def test_lrn(self):
+        x, y = image_data(c=6, seed=4)
+        net = build(
+            [
+                LocalResponseNormalization(),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ],
+            c=6,
+        )
+        self.check(net, x, y)
+
+    @pytest.mark.parametrize("pool", ["max", "avg", "sum", "pnorm"])
+    def test_global_pooling_types(self, pool):
+        x, y = image_data(seed=5)
+        net = build(
+            [
+                ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                GlobalPoolingLayer(pooling_type=pool),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        self.check(net, x, y, budget=40)
+
+
+class TestPoolingSemantics:
+    def test_avg_pool_excludes_padding(self):
+        """Same-mode avg pooling divides by real-element count, not kernel area."""
+        x = np.ones((1, 4, 4, 1), np.float64)
+        net = build(
+            [
+                SubsamplingLayer(
+                    pooling_type="avg", kernel=(3, 3), stride=(1, 1),
+                    convolution_mode="same",
+                ),
+                GlobalPoolingLayer(pooling_type="sum"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+            ],
+            h=4, w=4, c=1,
+        )
+        acts = net.feed_forward(x)
+        pooled = np.asarray(acts[0])
+        # all-ones input: every window averages to exactly 1.0 incl. borders
+        np.testing.assert_allclose(pooled, 1.0, rtol=1e-12)
+
+    def test_same_mode_rejects_explicit_padding(self):
+        with pytest.raises(ValueError, match="same"):
+            build(
+                [
+                    ConvolutionLayer(
+                        n_out=2, kernel=(3, 3), padding=(2, 2), convolution_mode="same"
+                    ),
+                    GlobalPoolingLayer(pooling_type="avg"),
+                    OutputLayer(n_out=2, loss="mcxent"),
+                ]
+            ).conf.layer_input_types()
+
+    def test_global_pooling_respects_time_mask(self):
+        """Masked timesteps excluded (reference: MaskedReductionUtil)."""
+        from deeplearning4j_tpu import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+        conf = MultiLayerConfiguration(
+            layers=[
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+            ],
+            input_type=IT.recurrent(3, 4),
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((2, 4, 3))
+        x[:, :2] = 1.0  # real steps are all-ones
+        x[:, 2:] = 99.0  # padded steps are garbage
+        mask = np.zeros((2, 4))
+        mask[:, :2] = 1.0
+        acts_masked = net._forward(net.params, x, net.state, False, None,
+                                   upto=1, features_mask=mask)[0]
+        np.testing.assert_allclose(np.asarray(acts_masked), 1.0, rtol=1e-12)
+
+
+class TestBatchNormState:
+    def test_running_stats_update_and_freeze(self):
+        x, y = image_data(n=16, seed=6)
+        net = build(
+            [
+                BatchNormalization(decay=0.5),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ]
+        )
+        m0 = np.asarray(net.state[0]["mean"]).copy()
+        net.fit((x, y))
+        m1 = np.asarray(net.state[0]["mean"])
+        assert not np.allclose(m0, m1), "running mean did not update during training"
+        # inference must not mutate state
+        net.output(x[:4])
+        m2 = np.asarray(net.state[0]["mean"])
+        np.testing.assert_array_equal(m1, m2)
+
+    def test_bn_json_round_trip(self):
+        conf = MultiLayerConfiguration(
+            layers=[
+                BatchNormalization(decay=0.8, eps=1e-3),
+                OutputLayer(n_out=2, loss="mse"),
+            ],
+            input_type=InputType.feed_forward(5),
+        )
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.layers[0].decay == 0.8
+        assert conf2.layers[0].eps == 1e-3
+
+
+class TestLeNet:
+    def test_lenet_trains_on_synthetic_mnist(self):
+        from deeplearning4j_tpu.models.lenet import lenet_mnist_conf
+
+        rng = np.random.default_rng(0)
+        n, classes = 64, 10
+        y_idx = rng.integers(0, classes, size=n)
+        # class-dependent blobs so the problem is learnable
+        x = rng.normal(size=(n, 28, 28, 1)) * 0.1
+        for i, c in enumerate(y_idx):
+            x[i, (c * 2) % 28 : (c * 2) % 28 + 4, (c * 3) % 24 : (c * 3) % 24 + 4, 0] += 2.0
+        y = np.eye(classes)[y_idx]
+
+        conf = lenet_mnist_conf(learning_rate=2e-3, seed=3)
+        net = MultiLayerNetwork(conf)
+        from deeplearning4j_tpu import CollectScoresIterationListener
+
+        scores = CollectScoresIterationListener()
+        net.set_listeners(scores)
+        net.fit(NumpyDataSetIterator(x, y, batch=32, shuffle=True), epochs=12)
+        assert scores.scores[-1][1] < scores.scores[0][1] * 0.5
+        ev = net.evaluate(NumpyDataSetIterator(x, y, batch=32))
+        assert ev.accuracy() > 0.8, ev.stats()
