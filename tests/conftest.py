@@ -3,7 +3,8 @@
 Forces the CPU backend with 8 virtual devices — the analog of the reference's
 Spark `local[n]` test trick (SURVEY.md §4.3): multi-device mesh semantics
 (sharding, collectives, averaging) are exercised in one process without TPU
-hardware. Must run before jax is imported anywhere.
+hardware. Must run before any jax backend is initialized (jax itself is
+already pre-imported by the axon sitecustomize; see below).
 
 Also enables x64 so gradient checks (tests/test_gradcheck.py) run in float64,
 matching the reference's double-precision GradientCheckUtil runs.
@@ -11,16 +12,24 @@ matching the reference's double-precision GradientCheckUtil runs.
 
 import os
 
-# Force-override: the environment pins JAX_PLATFORMS=axon (the real TPU tunnel);
-# tests must run on the virtual 8-device CPU backend.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Force-override: the environment pins JAX_PLATFORMS=axon (the real TPU tunnel)
+# and sitecustomize PRE-IMPORTS jax at interpreter startup, so env vars set here
+# are latched too late. jax.config.update works post-import as long as no
+# backend has been initialized yet.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# config.update is a SILENT no-op if a backend was already initialized
+# (e.g. an import-time jax.devices() anywhere) — fail loudly instead.
+assert jax.default_backend() == "cpu", (
+    f"tests must run on the virtual CPU mesh, got {jax.default_backend()!r}; "
+    "a jax backend was initialized before conftest could switch platforms"
+)
 # Persistent compilation cache: repeated test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
