@@ -13,6 +13,25 @@ from .nn.conf.multi_layer import MultiLayerConfiguration
 from .nn.updaters import UpdaterConfig
 from .nn.multilayer import MultiLayerNetwork
 from .nn.layers.base import BaseLayer, register_layer
+from .nn.conf.computation_graph import ComputationGraphConfiguration, GraphBuilder
+from .nn.graph import (
+    ComputationGraph,
+    BaseVertex,
+    LayerVertex,
+    ElementWiseVertex,
+    MergeVertex,
+    SubsetVertex,
+    StackVertex,
+    UnstackVertex,
+    ScaleVertex,
+    ShiftVertex,
+    L2Vertex,
+    L2NormalizeVertex,
+    PreprocessorVertex,
+    LastTimeStepVertex,
+    DuplicateToTimeSeriesVertex,
+    ReshapeVertex,
+)
 from .nn.layers.dense import (
     DenseLayer,
     OutputLayer,
@@ -54,6 +73,24 @@ __all__ = [
     "MultiLayerNetwork",
     "BaseLayer",
     "register_layer",
+    "ComputationGraphConfiguration",
+    "GraphBuilder",
+    "ComputationGraph",
+    "BaseVertex",
+    "LayerVertex",
+    "ElementWiseVertex",
+    "MergeVertex",
+    "SubsetVertex",
+    "StackVertex",
+    "UnstackVertex",
+    "ScaleVertex",
+    "ShiftVertex",
+    "L2Vertex",
+    "L2NormalizeVertex",
+    "PreprocessorVertex",
+    "LastTimeStepVertex",
+    "DuplicateToTimeSeriesVertex",
+    "ReshapeVertex",
     "DenseLayer",
     "OutputLayer",
     "LossLayer",

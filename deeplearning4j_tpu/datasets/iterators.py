@@ -250,10 +250,15 @@ class AsyncDataSetIterator(DataSetIterator):
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(_SENTINEL)
-                except queue.Full:
-                    pass  # consumer gone; stop flag already set or will be on close
+                # The sentinel MUST reach the consumer or it blocks forever on
+                # q.get() — so keep retrying while the consumer is alive (it
+                # drains the queue); bail only once stop is set (consumer gone).
+                while not stop.is_set():
+                    try:
+                        q.put(_SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True, name="async-dataset-prefetch")
         t.start()
@@ -278,8 +283,8 @@ class AsyncDataSetIterator(DataSetIterator):
 
 
 def as_iterator(data) -> Iterable[DataSet]:
-    """Normalize fit() input: (x, y) tuple, DataSet, or iterator."""
-    if isinstance(data, DataSet):
+    """Normalize fit() input: (x, y) tuple, DataSet, MultiDataSet, or iterator."""
+    if isinstance(data, (DataSet, MultiDataSet)):
         return ListDataSetIterator([data])
     if isinstance(data, tuple) and len(data) == 2:
         return ListDataSetIterator([DataSet(np.asarray(data[0]), np.asarray(data[1]))])

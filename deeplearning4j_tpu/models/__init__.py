@@ -1,0 +1,16 @@
+"""Model zoo: standard configs built from the public config DSL.
+
+Reference analog: trainedmodels/TrainedModels.java (VGG16) + the example
+configs users built with MultiLayerConfiguration/ComputationGraphConfiguration.
+"""
+
+from .lenet import lenet_mnist_conf
+from .resnet import resnet_conf, resnet18_conf, resnet34_conf, resnet50_conf
+
+__all__ = [
+    "lenet_mnist_conf",
+    "resnet_conf",
+    "resnet18_conf",
+    "resnet34_conf",
+    "resnet50_conf",
+]

@@ -1,0 +1,149 @@
+"""ResNet family as ComputationGraph configs — the flagship bench model.
+
+The reference era's "model zoo" is downloadable VGG16 weights
+(modelimport/.../trainedmodels/TrainedModels.java); its ComputationGraph was
+the tool users built ResNets with. Here the zoo is code: graph configs built
+from the same vertex set a user has (LayerVertex conv/BN, ElementWiseVertex
+add — the residual sum), so ResNet-50 doubles as the ComputationGraph
+stress test and the BASELINE throughput model (SURVEY.md §6, §7 stage 4).
+
+TPU notes: NHWC layout; bottleneck 1x1/3x3 convs are MXU-shaped matmuls after
+XLA's spatial tiling; set ``dtype="bfloat16"`` on the returned conf for the
+mixed-precision path used in benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from ..nn.conf.computation_graph import ComputationGraphConfiguration, GraphBuilder
+from ..nn.conf.inputs import InputType
+from ..nn.graph.vertices import ElementWiseVertex
+from ..nn.layers.base import BaseLayer
+from ..nn.layers.convolution import ConvolutionLayer
+from ..nn.layers.dense import ActivationLayer, OutputLayer
+from ..nn.layers.normalization import BatchNormalization
+from ..nn.layers.pooling import GlobalPoolingLayer, SubsamplingLayer
+from ..nn.updaters import UpdaterConfig
+
+
+def _conv_bn(
+    b: GraphBuilder,
+    name: str,
+    inp: str,
+    n_out: int,
+    kernel: Tuple[int, int],
+    stride: Tuple[int, int] = (1, 1),
+    relu: bool = True,
+) -> str:
+    """conv → BN [→ relu]; returns the last vertex name."""
+    b.add_layer(
+        f"{name}_conv",
+        ConvolutionLayer(
+            n_out=n_out, kernel=kernel, stride=stride,
+            convolution_mode="same", has_bias=False, weight_init="relu",
+        ),
+        inp,
+    )
+    b.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+    if relu:
+        b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_bn")
+        return f"{name}_relu"
+    return f"{name}_bn"
+
+
+def _bottleneck(
+    b: GraphBuilder, name: str, inp: str, mid: int, stride: Tuple[int, int], project: bool
+) -> str:
+    """ResNet-v1 bottleneck: 1x1(mid) → 3x3(mid, stride) → 1x1(4*mid), + shortcut."""
+    out_ch = 4 * mid
+    t = _conv_bn(b, f"{name}_a", inp, mid, (1, 1), stride)
+    t = _conv_bn(b, f"{name}_b", t, mid, (3, 3))
+    t = _conv_bn(b, f"{name}_c", t, out_ch, (1, 1), relu=False)
+    if project:
+        shortcut = _conv_bn(b, f"{name}_proj", inp, out_ch, (1, 1), stride, relu=False)
+    else:
+        shortcut = inp
+    b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), t, shortcut)
+    b.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def _basic_block(
+    b: GraphBuilder, name: str, inp: str, ch: int, stride: Tuple[int, int], project: bool
+) -> str:
+    """ResNet-v1 basic block (ResNet-18/34): 3x3 → 3x3, + shortcut."""
+    t = _conv_bn(b, f"{name}_a", inp, ch, (3, 3), stride)
+    t = _conv_bn(b, f"{name}_b", t, ch, (3, 3), relu=False)
+    if project:
+        shortcut = _conv_bn(b, f"{name}_proj", inp, ch, (1, 1), stride, relu=False)
+    else:
+        shortcut = inp
+    b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), t, shortcut)
+    b.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def resnet_conf(
+    blocks: Sequence[int],
+    *,
+    bottleneck: bool = True,
+    num_classes: int = 1000,
+    image_size: Tuple[int, int] = (224, 224),
+    channels: int = 3,
+    dtype: str = "float32",
+    updater: UpdaterConfig | None = None,
+    seed: int = 12345,
+) -> ComputationGraphConfiguration:
+    """Generic ResNet-v1 graph. ``blocks``: residual blocks per stage."""
+    b = (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.convolutional(image_size[0], image_size[1], channels))
+        .seed(seed)
+        .dtype(dtype)
+        .updater(updater or UpdaterConfig(updater="sgd", learning_rate=0.1))
+    )
+    stem = _conv_bn(b, "stem", "in", 64, (7, 7), (2, 2))
+    b.add_layer(
+        "stem_pool",
+        SubsamplingLayer(pooling_type="max", kernel=(3, 3), stride=(2, 2),
+                         convolution_mode="same"),
+        stem,
+    )
+    t = "stem_pool"
+    block_fn = _bottleneck if bottleneck else _basic_block
+    width = 64
+    cur_ch = 64  # channels flowing out of the stem
+    for stage, n_blocks in enumerate(blocks):
+        out_ch = 4 * width if bottleneck else width
+        for i in range(n_blocks):
+            stride = (2, 2) if (stage > 0 and i == 0) else (1, 1)
+            # projection shortcut only where identity can't carry the residual:
+            # stride ≠ 1 or channel count changes (standard ResNet-v1; an
+            # unconditional stage-0 projection would not be ResNet-18/34)
+            project = i == 0 and (stride != (1, 1) or cur_ch != out_ch)
+            t = block_fn(b, f"s{stage}_b{i}", t, width, stride, project)
+            cur_ch = out_ch
+        width *= 2
+    b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), t)
+    b.add_layer(
+        "out",
+        OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"),
+        "avgpool",
+    )
+    b.set_outputs("out")
+    return b.build()
+
+
+def resnet50_conf(**kw) -> ComputationGraphConfiguration:
+    """ResNet-50: [3, 4, 6, 3] bottleneck stages — BASELINE config #2."""
+    return resnet_conf([3, 4, 6, 3], bottleneck=True, **kw)
+
+
+def resnet18_conf(**kw) -> ComputationGraphConfiguration:
+    return resnet_conf([2, 2, 2, 2], bottleneck=False, **kw)
+
+
+def resnet34_conf(**kw) -> ComputationGraphConfiguration:
+    return resnet_conf([3, 4, 6, 3], bottleneck=False, **kw)

@@ -1,0 +1,210 @@
+"""ComputationGraph configuration: DAG-as-data with JSON round-trip.
+
+Reference parity: nn/conf/ComputationGraphConfiguration.java:56 +
+GraphBuilder:401 (SURVEY.md §2.1). The graph is (named vertices, edge lists,
+named network inputs/outputs); topological order is computed once at config
+time (reference computes it at init — ComputationGraph.java:286,
+topologicalSortOrder():854) and drives both shape inference and the forward
+trace.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .inputs import InputType
+from ..layers.base import BaseLayer
+from ..updaters import UpdaterConfig
+from ..graph.vertices import (
+    BaseVertex,
+    DuplicateToTimeSeriesVertex,
+    LayerVertex,
+    vertex_from_dict,
+)
+
+
+@dataclass
+class ComputationGraphConfiguration:
+    """DAG network config (reference: ComputationGraphConfiguration.java)."""
+
+    network_inputs: List[str] = field(default_factory=list)
+    network_outputs: List[str] = field(default_factory=list)
+    input_types: List[InputType] = field(default_factory=list)
+    # insertion-ordered: name -> vertex; name -> list of input names
+    vertices: Dict[str, BaseVertex] = field(default_factory=dict)
+    vertex_inputs: Dict[str, List[str]] = field(default_factory=dict)
+    updater: UpdaterConfig = field(default_factory=UpdaterConfig)
+    seed: int = 12345
+    dtype: str = "float32"
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    # ------------------------------------------------------------- topo order
+    def topological_order(self) -> List[str]:
+        """Kahn's algorithm, deterministic by insertion order
+        (reference: ComputationGraph.topologicalSortOrder():854)."""
+        in_deg = {name: 0 for name in self.vertices}
+        dependents: Dict[str, List[str]] = {name: [] for name in self.vertices}
+        for name, ins in self.vertex_inputs.items():
+            for src in ins:
+                if src in self.vertices:
+                    in_deg[name] += 1
+                    dependents[src].append(name)
+                elif src not in self.network_inputs:
+                    raise ValueError(
+                        f"Vertex '{name}' input '{src}' is neither a vertex nor a network input"
+                    )
+        ready = [n for n in self.vertices if in_deg[n] == 0]
+        order: List[str] = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for dep in dependents[n]:
+                in_deg[dep] -= 1
+                if in_deg[dep] == 0:
+                    ready.append(dep)
+        if len(order) != len(self.vertices):
+            cyc = sorted(set(self.vertices) - set(order))
+            raise ValueError(f"Graph has a cycle involving: {cyc}")
+        return order
+
+    # -------------------------------------------------------- shape inference
+    def vertex_input_types(self) -> Dict[str, List[InputType]]:
+        """InputTypes seen by each vertex, propagated in topo order."""
+        if len(self.input_types) != len(self.network_inputs):
+            raise ValueError(
+                f"{len(self.network_inputs)} network inputs but "
+                f"{len(self.input_types)} input types; call set_input_types"
+            )
+        known: Dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))
+        result: Dict[str, List[InputType]] = {}
+        for name in self.topological_order():
+            ins = [known[src] for src in self.vertex_inputs[name]]
+            result[name] = ins
+            known[name] = self.vertices[name].get_output_type(*ins)
+        return result
+
+    def output_types(self) -> List[InputType]:
+        known: Dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))
+        for name in self.topological_order():
+            ins = [known[src] for src in self.vertex_inputs[name]]
+            known[name] = self.vertices[name].get_output_type(*ins)
+        return [known[o] for o in self.network_outputs]
+
+    # ------------------------------------------------------------------- JSON
+    def to_dict(self) -> dict:
+        return {
+            "network_inputs": list(self.network_inputs),
+            "network_outputs": list(self.network_outputs),
+            "input_types": [t.to_dict() for t in self.input_types],
+            "vertices": {k: v.to_dict() for k, v in self.vertices.items()},
+            "vertex_inputs": {k: list(v) for k, v in self.vertex_inputs.items()},
+            "updater": self.updater.to_dict(),
+            "seed": self.seed,
+            "dtype": self.dtype,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration(
+            network_inputs=list(d["network_inputs"]),
+            network_outputs=list(d["network_outputs"]),
+            input_types=[InputType.from_dict(t) for t in d.get("input_types", [])],
+            vertices={k: vertex_from_dict(v) for k, v in d["vertices"].items()},
+            vertex_inputs={k: list(v) for k, v in d["vertex_inputs"].items()},
+            updater=UpdaterConfig.from_dict(d.get("updater", {})),
+            seed=d.get("seed", 12345),
+            dtype=d.get("dtype", "float32"),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+    @staticmethod
+    def builder() -> "GraphBuilder":
+        return GraphBuilder()
+
+
+class GraphBuilder:
+    """Fluent DAG builder (reference: ComputationGraphConfiguration.GraphBuilder:401)."""
+
+    def __init__(self):
+        self._conf = ComputationGraphConfiguration()
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._conf.network_inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._conf.input_types = list(types)
+        return self
+
+    def add_layer(
+        self, name: str, layer: BaseLayer, *inputs: str, preprocessor=None
+    ) -> "GraphBuilder":
+        """reference: GraphBuilder.addLayer(name, layer, preprocessor, inputs)"""
+        return self.add_vertex(
+            name, LayerVertex(layer=layer, preprocessor=preprocessor), *inputs
+        )
+
+    def add_vertex(self, name: str, vertex: BaseVertex, *inputs: str) -> "GraphBuilder":
+        if name in self._conf.vertices or name in self._conf.network_inputs:
+            raise ValueError(f"Duplicate vertex/input name '{name}'")
+        ins = list(inputs)
+        # DuplicateToTimeSeries reads its time length from the named reference
+        # input's activation — wire it in as a real graph edge.
+        if isinstance(vertex, DuplicateToTimeSeriesVertex) and vertex.ts_input:
+            if vertex.ts_input not in ins:
+                ins.append(vertex.ts_input)
+        self._conf.vertices[name] = vertex
+        self._conf.vertex_inputs[name] = ins
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._conf.network_outputs = list(names)
+        return self
+
+    def updater(self, updater: UpdaterConfig) -> "GraphBuilder":
+        self._conf.updater = updater
+        return self
+
+    def seed(self, seed: int) -> "GraphBuilder":
+        self._conf.seed = seed
+        return self
+
+    def dtype(self, dtype: str) -> "GraphBuilder":
+        self._conf.dtype = dtype
+        return self
+
+    def tbptt(self, fwd_length: int, back_length: Optional[int] = None) -> "GraphBuilder":
+        self._conf.backprop_type = "tbptt"
+        self._conf.tbptt_fwd_length = fwd_length
+        self._conf.tbptt_back_length = back_length or fwd_length
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        conf = self._conf
+        if not conf.network_inputs:
+            raise ValueError("Graph has no network inputs (add_inputs)")
+        if not conf.network_outputs:
+            raise ValueError("Graph has no network outputs (set_outputs)")
+        for o in conf.network_outputs:
+            if o not in conf.vertices:
+                raise ValueError(f"Output '{o}' is not a vertex")
+        conf.topological_order()  # validates edges + acyclicity
+        if conf.input_types:
+            conf.vertex_input_types()  # validates shape propagation
+        return conf

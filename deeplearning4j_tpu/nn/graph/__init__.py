@@ -1,0 +1,46 @@
+"""DAG networks: ComputationGraph + graph vertices.
+
+TPU-native equivalent of the reference's graph tier (nn/graph/ComputationGraph.java,
+nn/conf/graph/*, nn/graph/vertex/impl/* — SURVEY.md §2.1 "Graph vertices",
+§3.2 call stack). Topological forward is plain function composition; backward
+is jax.grad — the reference's per-vertex doBackward/epsilon accumulation
+(ComputationGraph.java:1184-1205) has no hand-written counterpart here.
+"""
+
+from .vertices import (
+    BaseVertex,
+    LayerVertex,
+    ElementWiseVertex,
+    MergeVertex,
+    SubsetVertex,
+    StackVertex,
+    UnstackVertex,
+    ScaleVertex,
+    ShiftVertex,
+    L2Vertex,
+    L2NormalizeVertex,
+    PreprocessorVertex,
+    LastTimeStepVertex,
+    DuplicateToTimeSeriesVertex,
+    ReshapeVertex,
+)
+from .computation_graph import ComputationGraph
+
+__all__ = [
+    "BaseVertex",
+    "LayerVertex",
+    "ElementWiseVertex",
+    "MergeVertex",
+    "SubsetVertex",
+    "StackVertex",
+    "UnstackVertex",
+    "ScaleVertex",
+    "ShiftVertex",
+    "L2Vertex",
+    "L2NormalizeVertex",
+    "PreprocessorVertex",
+    "LastTimeStepVertex",
+    "DuplicateToTimeSeriesVertex",
+    "ReshapeVertex",
+    "ComputationGraph",
+]

@@ -1,0 +1,305 @@
+"""ComputationGraph: DAG model with a jit-compiled train step.
+
+Reference parity: nn/graph/ComputationGraph.java — init():286,
+fit(MultiDataSet):743, feed-forward loop :1051-1060, backprop loop :1184-1205,
+rnnTimeStep:1801 (call stack SURVEY.md §3.2).
+
+TPU-native design: the topological forward is traced once into a single XLA
+program; ``jax.grad`` replaces the reverse-topological doBackward/epsilon
+accumulation entirely (epsilon fan-in "+=" is exactly what autodiff does for
+shared subexpressions). Multi-output losses sum, as in the reference's score
+aggregation across output layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..multilayer import _cast_input, _cast_params
+from .vertices import LayerVertex
+
+
+class ComputationGraph:
+    """DAG network over a :class:`ComputationGraphConfiguration`."""
+
+    def __init__(self, conf: "ComputationGraphConfiguration"):  # noqa: F821
+        self.conf = conf
+        self.params: Any = None
+        self.state: Any = None
+        self.opt_state: Any = None
+        self.iteration: int = 0
+        self.epoch: int = 0
+        self.listeners: List[Any] = []
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._tx = None
+        self._train_step = None
+        self._eval_forward = None
+        self._last_loss = None
+        self._topo = conf.topological_order()
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None, force: bool = False) -> "ComputationGraph":
+        if self.params is not None and not force and params is None:
+            return self
+        vit = self.conf.vertex_input_types()
+        key = jax.random.PRNGKey(self.conf.seed)
+        keys = jax.random.split(key, max(len(self._topo), 1))
+        if params is None:
+            params = {
+                name: self.conf.vertices[name].init_params(k, *vit[name])
+                for name, k in zip(self._topo, keys)
+            }
+        self.params = params
+        self.state = {
+            name: self.conf.vertices[name].init_state(*vit[name]) for name in self._topo
+        }
+        self._tx = self.conf.updater.build()
+        self.opt_state = self._tx.init(self.params)
+        self.iteration = 0
+        self._train_step = None
+        self._eval_forward = None
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+
+    # ------------------------------------------------------- functional core
+    def _activations(self, params, inputs, state, train, rng, masks):
+        """Run the topological forward; returns (acts dict, new_state dict).
+
+        ``inputs``: list of arrays aligned with conf.network_inputs.
+        ``masks``: dict network-input-name -> [b, t] mask (or None).
+        (reference: ComputationGraph feed-forward loop :1051-1060)
+        """
+        conf = self.conf
+        params = _cast_params(conf.dtype, params)
+        cast = [_cast_input(conf.dtype, params, x) for x in inputs]
+        acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs, cast))
+        if masks is None:
+            masks = {}
+        # single-mask convenience: layers deep in the graph receive it as the
+        # feature mask (the common one-recurrent-path case)
+        feat_mask = None
+        non_null = [m for m in masks.values() if m is not None]
+        if len(non_null) == 1:
+            feat_mask = non_null[0]
+        vmasks = dict(masks)
+        vmasks["features"] = feat_mask
+        rngs = (
+            jax.random.split(rng, len(self._topo)) if rng is not None
+            else [None] * len(self._topo)
+        )
+        new_state = dict(state)
+        for name, r in zip(self._topo, rngs):
+            vertex = conf.vertices[name]
+            ins = [acts[src] for src in conf.vertex_inputs[name]]
+            acts[name], new_state[name] = vertex.apply(
+                params[name], ins, state[name], train=train, rng=r, masks=vmasks
+            )
+        return acts, new_state
+
+    def _forward(self, params, inputs, state, train, rng, masks=None):
+        acts, new_state = self._activations(params, inputs, state, train, rng, masks)
+        return [acts[o] for o in self.conf.network_outputs], new_state
+
+    def _loss(self, params, state, inputs, labels, rng, train,
+              labels_masks=None, masks=None):
+        """Sum of output-layer losses + regularization
+        (reference: ComputationGraph.computeGradientAndScore score accumulation)."""
+        conf = self.conf
+        acts_rng, out_rng = (
+            jax.random.split(rng) if rng is not None else (None, None)
+        )
+        # forward over all non-output vertices; output-layer vertices consume
+        # their input activations via compute_loss (pre-activation path for
+        # fused stable softmax-xent, as in MultiLayerNetwork._loss)
+        acts, new_state = self._activations(params, inputs, state, train, acts_rng, masks)
+        total = jnp.asarray(0.0)
+        out_rngs = (
+            jax.random.split(out_rng, len(conf.network_outputs))
+            if out_rng is not None else [None] * len(conf.network_outputs)
+        )
+        for i, out_name in enumerate(conf.network_outputs):
+            vertex = conf.vertices[out_name]
+            if not (isinstance(vertex, LayerVertex) and vertex.is_output_layer):
+                raise ValueError(
+                    f"Training output '{out_name}' is not an output layer vertex"
+                )
+            ins = [acts[src] for src in conf.vertex_inputs[out_name]]
+            h = vertex.pre_output_input(ins)
+            h32 = h.astype(jnp.float32) if h.dtype == jnp.bfloat16 else h
+            p = params[out_name]
+            if conf.dtype == "bfloat16":
+                p = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), p)
+            lm = labels_masks[i] if labels_masks is not None else None
+            total = total + vertex.layer.compute_loss(
+                p, h32, labels[i], lm, train=train, rng=out_rngs[i]
+            )
+        reg = sum(
+            (self.conf.vertices[n].regularization_loss(params[n]) for n in self._topo),
+            start=jnp.asarray(0.0),
+        )
+        return total + reg, new_state
+
+    def loss_fn(self, params, inputs, labels, *, train=False, state=None, rng=None,
+                labels_masks=None, masks=None):
+        """Pure scalar loss of params — the gradient-check entry point."""
+        st = state if state is not None else self.state
+        val, _ = self._loss(params, st, inputs, labels, rng, train, labels_masks, masks)
+        return val
+
+    # ------------------------------------------------------------- train step
+    def _build_train_step(self):
+        tx = self._tx
+
+        def step(params, opt_state, state, inputs, labels, rng, labels_masks, masks):
+            def loss_of(p):
+                return self._loss(p, state, inputs, labels, rng, True, labels_masks, masks)
+
+            (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, new_state, loss
+
+        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def fit(self, data, epochs: int = 1) -> "ComputationGraph":
+        """Train (reference: ComputationGraph.fit(MultiDataSet):743).
+
+        ``data``: MultiDataSet, DataSet, (x, y) tuple, or an iterator of any.
+        """
+        from ...datasets.iterators import AsyncDataSetIterator, as_iterator
+
+        self.init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        for _ in range(epochs):
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_start"):
+                    lst.on_epoch_start(self, self.epoch)
+            it = as_iterator(data)
+            if hasattr(it, "reset"):
+                it.reset()
+            if getattr(it, "prefetch_supported", False):
+                it = AsyncDataSetIterator(it)
+            for ds in it:
+                self._fit_batch(self._as_multi(ds))
+            self.epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self, self.epoch)
+        return self
+
+    @staticmethod
+    def _as_multi(ds):
+        from ...datasets.iterators import DataSet, MultiDataSet
+
+        if isinstance(ds, MultiDataSet):
+            return ds
+        if isinstance(ds, DataSet):
+            return MultiDataSet(
+                features=[ds.features],
+                labels=[ds.labels],
+                features_masks=[ds.features_mask],
+                labels_masks=[ds.labels_mask],
+            )
+        raise TypeError(f"Cannot convert {type(ds).__name__} to MultiDataSet")
+
+    def _fit_batch(self, mds) -> None:
+        self.last_batch_size = mds.num_examples()
+        self._rng, step_key = jax.random.split(self._rng)
+        masks = None
+        if mds.features_masks is not None:
+            masks = {
+                name: m
+                for name, m in zip(self.conf.network_inputs, mds.features_masks)
+            }
+        lmasks = mds.labels_masks
+        if lmasks is not None and all(m is None for m in lmasks):
+            lmasks = None
+        self.params, self.opt_state, self.state, loss = self._train_step(
+            self.params, self.opt_state, self.state,
+            list(mds.features), list(mds.labels), step_key, lmasks, masks,
+        )
+        self._last_loss = loss
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, loss)
+
+    # -------------------------------------------------------------- inference
+    def output(self, *inputs, train: bool = False, masks=None):
+        """Output activations (reference: ComputationGraph.output). Returns a
+        single array for single-output graphs, else a list."""
+        self.init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        if self._eval_forward is None:
+            self._eval_forward = jax.jit(
+                lambda params, state, xs, masks: self._forward(
+                    params, xs, state, False, None, masks
+                )[0]
+            )
+        outs = self._eval_forward(
+            self.params, self.state, [jnp.asarray(x) for x in inputs], masks
+        )
+        return outs[0] if len(outs) == 1 else outs
+
+    def _input_masks(self, mds):
+        if mds.features_masks is None or all(m is None for m in mds.features_masks):
+            return None
+        return dict(zip(self.conf.network_inputs, mds.features_masks))
+
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return float(self._last_loss) if self._last_loss is not None else float("nan")
+        self.init()
+        mds = self._as_multi(dataset)
+        lmasks = mds.labels_masks
+        if lmasks is not None and all(m is None for m in lmasks):
+            lmasks = None
+        return float(
+            self.loss_fn(
+                self.params, list(mds.features), list(mds.labels),
+                labels_masks=lmasks, masks=self._input_masks(mds),
+            )
+        )
+
+    def evaluate(self, data, top_n: int = 1):
+        """Classification eval on the FIRST output (reference: ComputationGraph.evaluate)."""
+        from ...eval.evaluation import Evaluation
+        from ...datasets.iterators import as_iterator
+
+        ev = Evaluation(top_n=top_n)
+        for ds in as_iterator(data):
+            mds = self._as_multi(ds)
+            out = self.output(*mds.features, masks=self._input_masks(mds))
+            if isinstance(out, list):
+                out = out[0]
+            ev.eval(mds.labels[0], out)
+        return ev
+
+    # ------------------------------------------------------------------ misc
+    def clone(self) -> "ComputationGraph":
+        from ..conf.computation_graph import ComputationGraphConfiguration
+
+        other = ComputationGraph(
+            ComputationGraphConfiguration.from_dict(self.conf.to_dict())
+        )
+        if self.params is not None:
+            other.init(params=jax.tree_util.tree_map(lambda a: a, self.params))
+            other.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            other.opt_state = jax.tree_util.tree_map(lambda a: a, self.opt_state)
+            other.iteration = self.iteration
+        return other

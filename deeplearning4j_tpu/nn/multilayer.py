@@ -31,18 +31,32 @@ from .conf.multi_layer import MultiLayerConfiguration
 from .conf.inputs import InputType
 
 
-def _compute_cast(conf_dtype: str, params, x):
+def _cast_params(conf_dtype: str, params):
     """Mixed precision: master params stay f32; bf16 compute keeps the MXU fed."""
     if conf_dtype == "bfloat16":
-        cast = lambda t: jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            params,
         )
-        return cast(params), cast(x)
+    return params
+
+
+def _cast_input(conf_dtype: str, params, x):
+    """Align one input array with the compute dtype of (already-cast) params."""
+    if conf_dtype == "bfloat16":
+        x = jnp.asarray(x)
+        return x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x
     if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
         leaf = jax.tree_util.tree_leaves(params)
         if leaf:
             x = jnp.asarray(x).astype(leaf[0].dtype)
-    return params, x
+    return x
+
+
+def _compute_cast(conf_dtype: str, params, x):
+    """Cast params and one input for compute (see _cast_params/_cast_input)."""
+    params = _cast_params(conf_dtype, params)
+    return params, _cast_input(conf_dtype, params, x)
 
 
 class MultiLayerNetwork:

@@ -78,6 +78,11 @@ def restore_model(path: str):
             from ..nn.multilayer import MultiLayerNetwork
 
             model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+        elif cls_name == "ComputationGraph":
+            from ..nn.conf.computation_graph import ComputationGraphConfiguration
+            from ..nn.graph.computation_graph import ComputationGraph
+
+            model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
         else:
             raise ValueError(f"Unknown model class '{cls_name}'")
         model.init()

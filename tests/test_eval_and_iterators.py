@@ -87,6 +87,30 @@ class TestIterators:
         for i, ds in enumerate(out):
             assert ds.features[0, 0] == i
 
+    def test_async_iterator_sentinel_survives_full_queue(self):
+        """Regression: when the producer finished with a FULL queue, the end
+        sentinel was dropped (swallowed queue.Full) and the consumer blocked
+        forever on q.get() — a slow consumer (every real train loop) hit it."""
+        import threading
+        import time
+
+        base = ListDataSetIterator(
+            [DataSet(np.full((1, 1), i), np.zeros((1, 1))) for i in range(6)]
+        )
+        results = []
+
+        def consume():
+            it = iter(AsyncDataSetIterator(base, queue_size=2))
+            results.append(next(it))
+            time.sleep(0.5)  # let the producer fill the queue and finish
+            results.extend(it)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "consumer hung: end sentinel was lost"
+        assert len(results) == 6
+
     def test_async_iterator_propagates_errors(self):
         def gen():
             yield DataSet(np.zeros((1, 1)), np.zeros((1, 1)))

@@ -1,0 +1,51 @@
+"""ResNet graph tests: the "ResNet-50 buildable" milestone (SURVEY.md §7 stage 4)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import ComputationGraph, UpdaterConfig
+from deeplearning4j_tpu.models import resnet50_conf, resnet_conf
+
+
+class TestResNet50Buildable:
+    def test_structure(self):
+        conf = resnet50_conf()
+        # 1 stem conv + 3*(3+4+6+3) bottleneck convs + 4 projection convs = 53
+        n_convs = sum(1 for n in conf.vertices if n.endswith("_conv"))
+        assert n_convs == 53
+        out_t = conf.output_types()[0]
+        assert out_t.size == 1000
+        # conv+BN param count of the classic ResNet-50 (~25.6M with fc)
+        net = ComputationGraph(conf)
+        # init on 224x224 is slow on CPU test env; structure checks suffice —
+        # shape inference above already validated every vertex.
+        order = conf.topological_order()
+        assert order[-1] == "out"
+
+    def test_json_roundtrip(self):
+        from deeplearning4j_tpu import ComputationGraphConfiguration
+
+        conf = resnet50_conf()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.to_dict() == conf.to_dict()
+
+
+class TestTinyResNetTrains:
+    def test_forward_backward(self, rng):
+        """A 2-stage micro-ResNet trains on 16x16 images end to end."""
+        conf = resnet_conf(
+            [1, 1],
+            bottleneck=True,
+            num_classes=4,
+            image_size=(16, 16),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        )
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(8, 16, 16, 3))
+        y = np.eye(4)[rng.integers(0, 4, size=8)]
+        first = net.loss_fn(net.params, [x], [y], train=False)
+        net.fit((x, y), epochs=12)
+        assert np.isfinite(net.score())
+        assert net.score() < float(first)
+        out = net.output(x)
+        assert out.shape == (8, 4)
+        assert np.allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
