@@ -46,6 +46,13 @@ from .nn.layers.convolution import (
     ZeroPaddingLayer,
 )
 from .nn.layers.pooling import SubsamplingLayer, GlobalPoolingLayer
+from .nn.layers.recurrent import (
+    GravesLSTM,
+    GravesBidirectionalLSTM,
+    RnnOutputLayer,
+    RnnEmbeddingLayer,
+    LastTimeStepLayer,
+)
 from .nn.layers.normalization import BatchNormalization, LocalResponseNormalization
 from .datasets.iterators import (
     DataSet,
@@ -102,6 +109,11 @@ __all__ = [
     "ZeroPaddingLayer",
     "SubsamplingLayer",
     "GlobalPoolingLayer",
+    "GravesLSTM",
+    "GravesBidirectionalLSTM",
+    "RnnOutputLayer",
+    "RnnEmbeddingLayer",
+    "LastTimeStepLayer",
     "BatchNormalization",
     "LocalResponseNormalization",
     "DataSet",

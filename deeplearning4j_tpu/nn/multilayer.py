@@ -73,8 +73,11 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.seed)
         self._tx: Optional[optax.GradientTransformation] = None
         self._train_step = None
+        self._tbptt_step = None
         self._eval_forward = None
         self._last_loss = None
+        self._rnn_state = None  # streaming rnnTimeStep state, one entry per layer
+        self._rnn_step_fn = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "MultiLayerNetwork":
@@ -97,7 +100,10 @@ class MultiLayerNetwork:
         self.opt_state = self._tx.init(self.params)
         self.iteration = 0
         self._train_step = None
+        self._tbptt_step = None
         self._eval_forward = None
+        self._rnn_state = None
+        self._rnn_step_fn = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -112,12 +118,15 @@ class MultiLayerNetwork:
     # ------------------------------------------------------- functional core
     def _forward(
         self, params, x, state, train: bool, rng, *,
-        upto: Optional[int] = None, features_mask=None,
+        upto: Optional[int] = None, features_mask=None, rnn_state=None,
     ):
-        """Forward pass through layers [0, upto). Returns (x, new_state).
+        """Forward pass through layers [0, upto). Returns (x, new_state, new_rnn).
 
         ``features_mask`` ([batch, time] for padded sequences) reaches every
         layer's ``apply`` (reference: Layer.setMaskArray / feedForward masking).
+        ``rnn_state`` (tuple per layer, {} for non-recurrent) threads LSTM h/c
+        across TBPTT segments / rnnTimeStep calls (reference:
+        MultiLayerNetwork.rnnActivateUsingStoredState).
         """
         layers = self.conf.layers
         n = len(layers) if upto is None else upto
@@ -126,25 +135,32 @@ class MultiLayerNetwork:
             jax.random.split(rng, len(layers)) if rng is not None else [None] * len(layers)
         )
         new_state = list(state)
+        new_rnn = list(rnn_state) if rnn_state is not None else None
         for i in range(n):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 x = pre.apply(x)
-            x, new_state[i] = layers[i].apply(
-                params[i], x, state[i], train=train, rng=rngs[i], mask=features_mask
-            )
-        return x, tuple(new_state)
+            if new_rnn is not None and new_rnn[i]:
+                x, new_rnn[i] = layers[i].apply_seq(
+                    params[i], x, new_rnn[i], mask=features_mask, train=train, rng=rngs[i]
+                )
+            else:
+                x, new_state[i] = layers[i].apply(
+                    params[i], x, state[i], train=train, rng=rngs[i], mask=features_mask
+                )
+        return x, tuple(new_state), (tuple(new_rnn) if new_rnn is not None else None)
 
     def _loss(self, params, state, x, y, rng, train: bool, labels_mask=None,
-              features_mask=None):
+              features_mask=None, rnn_state=None):
         """Loss + regularization (reference: computeGradientAndScore + calcL1/L2)."""
         layers = self.conf.layers
         out_idx = len(layers) - 1
         fwd_rng, out_rng = (
             jax.random.split(rng) if rng is not None else (None, None)
         )
-        h, new_state = self._forward(
-            params, x, state, train, fwd_rng, upto=out_idx, features_mask=features_mask
+        h, new_state, new_rnn = self._forward(
+            params, x, state, train, fwd_rng, upto=out_idx, features_mask=features_mask,
+            rnn_state=rnn_state,
         )
         out_layer = layers[out_idx]
         pre = self.conf.preprocessors.get(out_idx)
@@ -161,13 +177,13 @@ class MultiLayerNetwork:
             (layer.regularization_loss(params[i]) for i, layer in enumerate(layers)),
             start=jnp.asarray(0.0),
         )
-        return loss + reg, new_state
+        return loss + reg, new_state, new_rnn
 
     def loss_fn(self, params, x, y, *, train: bool = False, state=None, rng=None,
                 labels_mask=None, features_mask=None):
         """Pure scalar loss of params — the gradient-check entry point."""
         st = state if state is not None else self.state
-        val, _ = self._loss(params, st, x, y, rng, train, labels_mask, features_mask)
+        val, _, _ = self._loss(params, st, x, y, rng, train, labels_mask, features_mask)
         return val
 
     # ------------------------------------------------------------- train step
@@ -176,7 +192,10 @@ class MultiLayerNetwork:
 
         def step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
             def loss_of(p):
-                return self._loss(p, state, x, y, rng, True, labels_mask, features_mask)
+                loss, new_state, _ = self._loss(
+                    p, state, x, y, rng, True, labels_mask, features_mask
+                )
+                return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
@@ -217,6 +236,13 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])
+        if (
+            self.conf.backprop_type == "tbptt"
+            and np.ndim(ds.features) == 3
+            and ds.features.shape[1] > self.conf.tbptt_fwd_length
+        ):
+            self._fit_tbptt(ds)
+            return
         self._rng, step_key = jax.random.split(self._rng)
         self.params, self.opt_state, self.state, loss = self._train_step(
             self.params, self.opt_state, self.state, ds.features, ds.labels, step_key,
@@ -227,6 +253,126 @@ class MultiLayerNetwork:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
 
+    # ---------------------------------------------------------------- TBPTT
+    def _init_rnn_states(self, batch: int):
+        """Per-layer streaming state tuple ({} for stateless layers)."""
+        return tuple(
+            layer.init_recurrent_state(batch)
+            if hasattr(layer, "init_recurrent_state") and layer.is_recurrent
+            else {}
+            for layer in self.conf.layers
+        )
+
+    def _build_tbptt_step(self):
+        tx = self._tx
+
+        def step(params, opt_state, state, rnn, x, y, rng, labels_mask, features_mask):
+            def loss_of(p):
+                loss, new_state, new_rnn = self._loss(
+                    p, state, x, y, rng, True, labels_mask, features_mask, rnn_state=rnn
+                )
+                return loss, (new_state, new_rnn)
+
+            (loss, (new_state, new_rnn)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # Segment boundary IS the gradient-truncation boundary: the returned
+            # h/c re-enter the next jit call as constants (reference:
+            # MultiLayerNetwork.doTruncatedBPTT:1080 rnnUpdateStateWithTBPTTState).
+            new_rnn = jax.lax.stop_gradient(new_rnn)
+            return new_params, new_opt, new_state, new_rnn, loss
+
+        return jax.jit(step)
+
+    def _fit_tbptt(self, ds) -> None:
+        """Truncated BPTT over time segments (reference: doTruncatedBPTT:1080).
+
+        The sequence is split into ``tbptt_fwd_length`` chunks; one param update
+        per chunk; LSTM h/c carry across chunks with gradients stopped. Trailing
+        partial chunks are dropped (static shapes for XLA; the reference
+        processes them — pad sequences to a multiple to keep every step).
+        """
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+            if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
+                import warnings
+
+                warnings.warn(
+                    "tbptt_back_length != tbptt_fwd_length: gradients truncate at "
+                    "segment boundaries (= tbptt_fwd_length); a shorter backward "
+                    "window is not yet supported and tbptt_back_length is ignored.",
+                    stacklevel=3,
+                )
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        fmask = getattr(ds, "features_mask", None)
+        lmask = getattr(ds, "labels_mask", None)
+        T, L = x.shape[1], self.conf.tbptt_fwd_length
+        rnn = self._init_rnn_states(x.shape[0])
+        for t0 in range(0, T - L + 1, L):
+            seg = slice(t0, t0 + L)
+            self._rng, step_key = jax.random.split(self._rng)
+            (self.params, self.opt_state, self.state, rnn, loss) = self._tbptt_step(
+                self.params, self.opt_state, self.state, rnn,
+                x[:, seg], y[:, seg], step_key,
+                None if lmask is None else lmask[:, seg],
+                None if fmask is None else fmask[:, seg],
+            )
+            self._last_loss = loss
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, loss)
+
+    # ------------------------------------------------------------- streaming
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference: MultiLayerNetwork.rnnTimeStep:2163).
+
+        ``x``: [batch, features] (one step) or [batch, time, features]. LSTM
+        h/c persist across calls until :meth:`rnn_clear_previous_state`.
+        """
+        self.init()
+        x = jnp.asarray(x)
+        single_step = x.ndim == 2
+        if single_step:
+            x = x[:, None, :]
+        if self._rnn_state is None or (
+            jax.tree_util.tree_leaves(self._rnn_state)
+            and jax.tree_util.tree_leaves(self._rnn_state)[0].shape[0] != x.shape[0]
+        ):
+            self._rnn_state = self._init_rnn_states(x.shape[0])
+        if self._rnn_step_fn is None:
+            self._rnn_step_fn = jax.jit(
+                lambda params, state, rnn, x: self._forward(
+                    params, x, state, False, None, rnn_state=rnn
+                )[::2]  # (out, new_rnn) — per-token dispatch stays on device
+            )
+        out, self._rnn_state = self._rnn_step_fn(
+            self.params, self.state, self._rnn_state, x
+        )
+        if single_step and out.ndim == 3:
+            out = out[:, 0, :]
+        return out
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference: MultiLayerNetwork.rnnClearPreviousState."""
+        self._rnn_state = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        """Reference: MultiLayerNetwork.rnnGetPreviousState."""
+        if self._rnn_state is None:
+            return None
+        st = self._rnn_state[layer_idx]
+        return st if st else None
+
+    def rnn_set_previous_state(self, layer_idx: int, state_dict) -> None:
+        """Reference: MultiLayerNetwork.rnnSetPreviousState."""
+        if self._rnn_state is None:
+            raise ValueError("No streaming state; call rnn_time_step first")
+        st = list(self._rnn_state)
+        st[layer_idx] = state_dict
+        self._rnn_state = tuple(st)
+
     # -------------------------------------------------------------- inference
     def output(self, x, train: bool = False, features_mask=None):
         """Inference output (reference: MultiLayerNetwork.output:1505)."""
@@ -236,7 +382,7 @@ class MultiLayerNetwork:
                 lambda params, state, x, fm: self._forward(
                     params, x, state, False, None, features_mask=fm
                 )[0]
-            )
+            )  # _forward returns (out, state, rnn); [0] unchanged
         return self._eval_forward(self.params, self.state, jnp.asarray(x), features_mask)
 
     def predict(self, x) -> np.ndarray:

@@ -1,0 +1,235 @@
+"""RNN family tests: GravesLSTM, bidirectional, masking, TBPTT, rnnTimeStep.
+
+Mirrors the reference's GradientCheckTests RNN cases + GravesLSTMTest +
+GradientCheckTestsMasking (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    InputType,
+    LastTimeStepLayer,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    RnnEmbeddingLayer,
+    RnnOutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.models.char_rnn import CharIterator, char_rnn
+from deeplearning4j_tpu.utils.gradcheck import gradient_check
+
+
+def _lstm_net(bidirectional=False, timesteps=6, n_in=4, hidden=5, n_out=3, **conf_kw):
+    lstm_cls = GravesBidirectionalLSTM if bidirectional else GravesLSTM
+    conf = MultiLayerConfiguration(
+        layers=[
+            lstm_cls(n_in=n_in, n_out=hidden, activation="tanh"),
+            RnnOutputLayer(n_in=hidden, n_out=n_out, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(n_in, timesteps),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        seed=7,
+        **conf_kw,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _seq_data(batch=3, timesteps=6, n_in=4, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch, timesteps, n_in)).astype(np.float64)
+    y = np.eye(n_out)[rng.integers(0, n_out, size=(batch, timesteps))].astype(np.float64)
+    return x, y
+
+
+class TestLSTMGradients:
+    def test_graves_lstm_gradcheck(self):
+        net = _lstm_net()
+        x, y = _seq_data()
+        passed, nfail, maxerr = gradient_check(
+            lambda p, x, y: net.loss_fn(p, x, y), net.params, x, y
+        )
+        assert passed, f"{nfail} failures, max rel err {maxerr}"
+
+    def test_bidirectional_gradcheck(self):
+        net = _lstm_net(bidirectional=True)
+        x, y = _seq_data()
+        passed, nfail, maxerr = gradient_check(
+            lambda p, x, y: net.loss_fn(p, x, y), net.params, x, y
+        )
+        assert passed, f"{nfail} failures, max rel err {maxerr}"
+
+    def test_lstm_with_l2_gradcheck(self):
+        conf = MultiLayerConfiguration(
+            layers=[
+                GravesLSTM(n_in=4, n_out=5, activation="tanh", l2=0.01),
+                RnnOutputLayer(n_in=5, n_out=3, activation="softmax", loss="mcxent", l2=0.01),
+            ],
+            input_type=InputType.recurrent(4, 6),
+            seed=7,
+        )
+        net = MultiLayerNetwork(conf).init()
+        x, y = _seq_data()
+        passed, nfail, maxerr = gradient_check(
+            lambda p, x, y: net.loss_fn(p, x, y), net.params, x, y
+        )
+        assert passed, f"{nfail} failures, max rel err {maxerr}"
+
+    def test_masked_gradcheck(self):
+        # Reference: GradientCheckTestsMasking — per-timestep label mask
+        net = _lstm_net()
+        x, y = _seq_data()
+        mask = np.ones((3, 6))
+        mask[0, 4:] = 0.0
+        mask[2, 2:] = 0.0
+        passed, nfail, maxerr = gradient_check(
+            lambda p, x, y: net.loss_fn(p, x, y, labels_mask=mask, features_mask=mask),
+            net.params, x, y,
+        )
+        assert passed, f"{nfail} failures, max rel err {maxerr}"
+
+
+class TestLSTMSemantics:
+    def test_forget_gate_bias_init(self):
+        layer = GravesLSTM(n_in=4, n_out=5, forget_gate_bias_init=1.0)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(4))
+        b = np.asarray(p["b"])
+        assert np.allclose(b[5:10], 1.0)  # forget slice
+        assert np.allclose(b[:5], 0.0)
+        assert np.allclose(b[10:], 0.0)
+        assert p["W"].shape == (4, 20)
+        assert p["RW"].shape == (5, 20)
+        assert p["pF"].shape == (5,)
+
+    def test_masking_equals_truncation(self):
+        """Masked padded sequence ≡ short sequence, for both output and state."""
+        net = _lstm_net(timesteps=None)
+        x, _ = _seq_data()
+        x_short = x[:, :4]
+        x_padded = np.concatenate([x_short, np.zeros((3, 2, 4))], axis=1)
+        mask = np.concatenate([np.ones((3, 4)), np.zeros((3, 2))], axis=1)
+
+        lstm, params = net.conf.layers[0], net.params[0]
+        r0 = lstm.init_recurrent_state(3)
+        y_short, st_short = lstm.apply_seq(jax.tree_util.tree_map(jnp.asarray, params),
+                                           jnp.asarray(x_short), r0)
+        y_pad, st_pad = lstm.apply_seq(jax.tree_util.tree_map(jnp.asarray, params),
+                                       jnp.asarray(x_padded), r0, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(y_short, y_pad[:, :4], rtol=1e-6)
+        # carried state frozen at last valid step
+        np.testing.assert_allclose(st_short["h"], st_pad["h"], rtol=1e-6)
+        np.testing.assert_allclose(st_short["c"], st_pad["c"], rtol=1e-6)
+
+    def test_bidirectional_is_sum_of_directions(self):
+        """Reference: GravesBidirectionalLSTM.java:224-228 sums fwd+bwd outputs."""
+        bi = GravesBidirectionalLSTM(n_in=4, n_out=5, activation="tanh")
+        p = bi.init_params(jax.random.PRNGKey(1), InputType.recurrent(4))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 4)))
+        y_bi, _ = bi.apply(p, x, {})
+
+        uni = GravesLSTM(n_in=4, n_out=5, activation="tanh")
+        fwd_p = {k: v for k, v in p.items() if not k.startswith("bwd_")}
+        bwd_p = {k[len("bwd_"):]: v for k, v in p.items() if k.startswith("bwd_")}
+        y_f, _ = uni.apply(fwd_p, x, {})
+        y_b, _ = uni.apply(bwd_p, x[:, ::-1], {})
+        np.testing.assert_allclose(y_bi, y_f + y_b[:, ::-1], rtol=1e-6)
+
+    def test_last_time_step_layer(self):
+        layer = LastTimeStepLayer()
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 3)))
+        y, _ = layer.apply({}, x, {})
+        np.testing.assert_allclose(y, x[:, -1])
+        mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], dtype=jnp.float32)
+        y, _ = layer.apply({}, x, {}, mask=mask)
+        np.testing.assert_allclose(y[0], x[0, 2])
+        np.testing.assert_allclose(y[1], x[1, 4])
+
+    def test_rnn_embedding(self):
+        layer = RnnEmbeddingLayer(n_in=7, n_out=4)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(7))
+        idx = jnp.asarray([[0, 3, 6], [1, 1, 2]])
+        y, _ = layer.apply(p, idx, {})
+        assert y.shape == (2, 3, 4)
+        np.testing.assert_allclose(y[0, 1], p["W"][3])
+
+
+class TestStreamingAndTBPTT:
+    def test_rnn_time_step_matches_full_forward(self):
+        """Reference: MultiLayerNetwork.rnnTimeStep:2163 — step-by-step ==
+        full-sequence forward."""
+        net = _lstm_net(timesteps=None)
+        x, _ = _seq_data()
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        for t in range(x.shape[1]):
+            step_out = np.asarray(net.rnn_time_step(x[:, t]))
+            np.testing.assert_allclose(step_out, full[:, t], rtol=1e-5, atol=1e-6)
+        # state persists: h/c present for the LSTM layer
+        assert net.rnn_get_previous_state(0) is not None
+        net.rnn_clear_previous_state()
+        assert net.rnn_get_previous_state(0) is None
+
+    def test_rnn_time_step_chunked(self):
+        net = _lstm_net(timesteps=None)
+        x, _ = _seq_data(timesteps=8)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        out1 = np.asarray(net.rnn_time_step(x[:, :5]))
+        out2 = np.asarray(net.rnn_time_step(x[:, 5:]))
+        np.testing.assert_allclose(out1, full[:, :5], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out2, full[:, 5:], rtol=1e-5, atol=1e-6)
+
+    def test_tbptt_training_decreases_loss(self):
+        conf = char_rnn(vocab_size=8, hidden_size=16, num_layers=1,
+                        tbptt_length=5, learning_rate=0.05)
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        # deterministic repeating pattern -> learnable
+        seq = np.tile(np.arange(8), 40)
+        T = 20
+        x = np.zeros((4, T, 8), dtype=np.float32)
+        y = np.zeros((4, T, 8), dtype=np.float32)
+        for b in range(4):
+            s = rng.integers(0, 8)
+            ids = seq[s : s + T + 1]
+            x[b, np.arange(T), ids[:-1]] = 1
+            y[b, np.arange(T), ids[1:]] = 1
+        ds = DataSet(x, y)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score() < first * 0.5
+        # 4 segments per fit (T=20, L=5)
+        assert net.iteration == 31 * 4
+
+    def test_char_iterator(self):
+        it = CharIterator("hello world " * 20, seq_length=10, batch_size=4)
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 10, it.vocab_size)
+        # labels are inputs shifted by one step
+        f_ids = ds.features.argmax(-1)
+        l_ids = ds.labels.argmax(-1)
+        np.testing.assert_array_equal(f_ids[:, 1:], l_ids[:, :-1])
+
+
+class TestRnnSerialization:
+    def test_lstm_json_roundtrip(self):
+        net = _lstm_net()
+        js = net.conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        net2 = MultiLayerNetwork(conf2).init()
+        assert jax.tree_util.tree_structure(net.params) == jax.tree_util.tree_structure(
+            net2.params
+        )
+        x, y = _seq_data()
+        np.testing.assert_allclose(
+            net.loss_fn(net.params, x, y), net2.loss_fn(net2.params, x, y)
+        )
