@@ -1,0 +1,28 @@
+"""Parallelism tier: mesh trainers replacing the reference's ParallelWrapper /
+Spark parameter averaging / Aeron parameter server (SURVEY.md §2.4, §5.8)."""
+
+from .mesh import (
+    make_mesh,
+    initialize_multihost,
+    replicated_sharding,
+    data_sharding,
+)
+from .wrapper import ParallelWrapper
+from .training_master import (
+    TrainingMaster,
+    TrainingStats,
+    SyncAllReduceTrainingMaster,
+    ParameterAveragingTrainingMaster,
+)
+
+__all__ = [
+    "make_mesh",
+    "initialize_multihost",
+    "replicated_sharding",
+    "data_sharding",
+    "ParallelWrapper",
+    "TrainingMaster",
+    "TrainingStats",
+    "SyncAllReduceTrainingMaster",
+    "ParameterAveragingTrainingMaster",
+]

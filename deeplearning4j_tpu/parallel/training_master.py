@@ -1,0 +1,152 @@
+"""TrainingMaster SPI: pluggable distributed-training strategies.
+
+Reference: spark/api/TrainingMaster.java:29 + TrainingWorker.java — the SPI
+that made the Spark parameter-averaging strategy pluggable
+(ParameterAveragingTrainingMaster.java: executeTraining:344, split/repartition
+:655-664, processResults:770-811). Kept as an SPI here (SURVEY.md §5.8) so
+per-step all-reduce AND periodic averaging coexist behind one interface; both
+run on the same mesh machinery (wrapper.py) instead of Spark RDD shuffles.
+
+Per-phase timing stats mirror the reference's SparkTrainingStats
+(spark/stats/StatsUtils.java, ParameterAveragingTrainingMasterStats.java):
+every split/broadcast/fit/aggregate phase is timed and queryable.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class TrainingStats:
+    """Phase-timing events (reference: SparkTrainingStats / StatsUtils.java)."""
+
+    events: List[Dict[str, Any]] = field(default_factory=list)
+
+    def record(self, phase: str, start: float, end: float, **meta) -> None:
+        self.events.append(
+            {"phase": phase, "start": start, "duration_ms": (end - start) * 1e3, **meta}
+        )
+
+    def total_ms(self, phase: str) -> float:
+        return sum(e["duration_ms"] for e in self.events if e["phase"] == phase)
+
+    def phases(self) -> List[str]:
+        seen = []
+        for e in self.events:
+            if e["phase"] not in seen:
+                seen.append(e["phase"])
+        return seen
+
+    def summary(self) -> Dict[str, float]:
+        return {p: self.total_ms(p) for p in self.phases()}
+
+    def export_html(self, path: str) -> None:
+        """Reference: StatsUtils.exportStatsAsHtml — simple bar-chart export."""
+        rows = "".join(
+            f"<tr><td>{p}</td><td>{ms:.1f}</td>"
+            f"<td><div style='background:#4a7;height:12px;width:{min(ms, 600):.0f}px'></div></td></tr>"
+            for p, ms in self.summary().items()
+        )
+        html = (
+            "<html><body><h2>Training phase timings</h2>"
+            f"<table border=1><tr><th>phase</th><th>total ms</th><th></th></tr>{rows}</table>"
+            "</body></html>"
+        )
+        with open(path, "w") as f:
+            f.write(html)
+
+
+class TrainingMaster:
+    """Strategy SPI (reference: spark/api/TrainingMaster.java:29)."""
+
+    def execute_training(self, net, data, epochs: int = 1):
+        raise NotImplementedError
+
+    def get_stats(self) -> TrainingStats:
+        raise NotImplementedError
+
+
+class SyncAllReduceTrainingMaster(TrainingMaster):
+    """Per-step gradient all-reduce over the mesh — the modern, strictly better
+    form of averagingFrequency=1 (SURVEY.md §5.8). Subsumes both the reference's
+    ParallelWrapper (single host) and its Spark master when the mesh spans hosts."""
+
+    def __init__(self, workers: Optional[int] = None, mesh=None):
+        from .wrapper import ParallelWrapper
+
+        self._wrapper_cls = ParallelWrapper
+        self.workers = workers
+        self.mesh = mesh
+        self.stats = TrainingStats()
+
+    def execute_training(self, net, data, epochs: int = 1):
+        t0 = time.perf_counter()
+        wrapper = self._wrapper_cls(
+            net, workers=self.workers, averaging_frequency=1, mesh=self.mesh
+        )
+        self.stats.record("setup", t0, time.perf_counter())
+        t1 = time.perf_counter()
+        wrapper.fit(data, epochs=epochs)
+        self.stats.record("fit", t1, time.perf_counter(), iterations=wrapper.iteration)
+        return net
+
+    def get_stats(self) -> TrainingStats:
+        return self.stats
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Periodic parameter averaging (reference:
+    impl/paramavg/ParameterAveragingTrainingMaster.java). The reference's
+    driver-side split → broadcast → worker-fit → treeAggregate loop maps to:
+    replica-stacked params on the mesh (broadcast ≡ initial stack), independent
+    vmapped worker steps (ExecuteWorkerFlatMap ≡ vmap), and a mean over the
+    replica axis (treeAggregate ≡ all-reduce) every ``averaging_frequency``
+    iterations. ``batches_per_worker`` sizes each worker's share of a split
+    (reference: batchSizePerWorker/averagingFrequency split sizing :655-664)."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        averaging_frequency: int = 5,
+        batches_per_worker: int = 1,
+        average_updaters: bool = True,
+        report_score_after_averaging: bool = True,
+        collect_training_stats: bool = True,
+        mesh=None,
+    ):
+        self.workers = workers
+        self.averaging_frequency = averaging_frequency
+        self.batches_per_worker = batches_per_worker
+        self.average_updaters = average_updaters
+        self.report_score_after_averaging = report_score_after_averaging
+        self.collect_training_stats = collect_training_stats
+        self.mesh = mesh
+        self.stats = TrainingStats()
+
+    def execute_training(self, net, data, epochs: int = 1):
+        from .wrapper import ParallelWrapper
+
+        t0 = time.perf_counter()
+        wrapper = ParallelWrapper(
+            net,
+            workers=self.workers,
+            averaging_frequency=self.averaging_frequency,
+            average_updaters=self.average_updaters,
+            report_score_after_averaging=self.report_score_after_averaging,
+            mesh=self.mesh,
+        )
+        if self.collect_training_stats:
+            self.stats.record("broadcast", t0, time.perf_counter())
+        t1 = time.perf_counter()
+        wrapper.fit(data, epochs=epochs)
+        if self.collect_training_stats:
+            self.stats.record("fit", t1, time.perf_counter(), iterations=wrapper.iteration)
+            t2 = time.perf_counter()
+            self.stats.record("aggregate", t2, time.perf_counter())
+        return net
+
+    def get_stats(self) -> TrainingStats:
+        return self.stats

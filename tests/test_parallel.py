@@ -1,0 +1,176 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (conftest.py) — the
+analog of the reference's Spark `local[n]` tests (SURVEY.md §4.3) and
+ParallelWrapperTest thread tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.parallel import (
+    ParallelWrapper,
+    ParameterAveragingTrainingMaster,
+    SyncAllReduceTrainingMaster,
+    make_mesh,
+)
+
+
+def _net(seed=3, lr=0.05, updater="sgd"):
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="tanh"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(updater=updater, learning_rate=lr),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n_batches, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    w = np.random.default_rng(42).normal(size=(4, 3))  # fixed ground truth
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch, 4))
+        y = np.eye(3)[(x @ w).argmax(-1)]
+        out.append(DataSet(x, y))
+    return out
+
+
+class TestMesh:
+    def test_make_mesh(self):
+        mesh = make_mesh(8)
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("data",)
+
+    def test_make_mesh_2d(self):
+        mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+        assert mesh.devices.shape == (4, 2)
+
+    def test_too_many_workers(self):
+        with pytest.raises(ValueError):
+            make_mesh(1024)
+
+
+class TestSyncDataParallel:
+    def test_sync_equals_single_device(self):
+        """SPMD sharded step == unsharded step on the same global batch
+        (all-reduce DP is mathematically a bigger batch)."""
+        batches = _batches(8, batch=8)
+        net_a = _net()
+        ParallelWrapper(net_a, workers=8, averaging_frequency=1).fit(
+            ListDataSetIterator(batches)
+        )
+        net_b = _net()
+        glob = DataSet(
+            np.concatenate([b.features for b in batches]),
+            np.concatenate([b.labels for b in batches]),
+        )
+        net_b.fit(glob)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(net_a.params),
+            jax.tree_util.tree_leaves(net_b.params),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-8)
+
+    def test_sync_training_converges(self):
+        net = _net(lr=0.2)
+        batches = _batches(64)
+        w = ParallelWrapper(net, workers=8)
+        w.fit(ListDataSetIterator(batches), epochs=5)
+        ev_data = _batches(1, batch=64, seed=9)[0]
+        acc = net.evaluate([ev_data]).accuracy()
+        assert acc > 0.8, acc
+        assert w.iteration == 5 * 8  # 64 batches / 8 workers per step
+
+
+class TestPeriodicAveraging:
+    def test_replicas_equal_after_averaging(self):
+        net = _net(updater="adam", lr=0.01)
+        w = ParallelWrapper(net, workers=4, averaging_frequency=2)
+        w.fit(ListDataSetIterator(_batches(16)))  # 4 groups -> 2 averaging events
+        params, opt_state, state = w._replica
+        for leaf in jax.tree_util.tree_leaves(params):
+            arr = np.asarray(leaf)
+            for i in range(1, arr.shape[0]):
+                np.testing.assert_allclose(arr[i], arr[0], rtol=1e-6, atol=1e-8)
+
+    def test_periodic_converges_and_propagates(self):
+        net = _net(lr=0.2)
+        before = [np.asarray(l).copy() for l in jax.tree_util.tree_leaves(net.params)]
+        w = ParallelWrapper(net, workers=4, averaging_frequency=2)
+        w.fit(ListDataSetIterator(_batches(64)), epochs=4)
+        after = jax.tree_util.tree_leaves(net.params)
+        # params propagated back to the wrapped net and changed
+        assert any(
+            not np.allclose(b, np.asarray(a)) for b, a in zip(before, after)
+        )
+        acc = net.evaluate([_batches(1, batch=64, seed=9)[0]]).accuracy()
+        assert acc > 0.8, acc
+
+    def test_periodic_no_updater_averaging(self):
+        net = _net(updater="adam", lr=0.01)
+        w = ParallelWrapper(
+            net, workers=4, averaging_frequency=2, average_updaters=False
+        )
+        w.fit(ListDataSetIterator(_batches(8)))
+        # updater state NOT averaged -> replica opt states differ
+        _, opt_state, _ = w._replica
+        leaves = [
+            np.asarray(l)
+            for l in jax.tree_util.tree_leaves(opt_state)
+            if np.asarray(l).ndim > 1
+        ]
+        assert any(not np.allclose(l[0], l[1]) for l in leaves if l.shape[0] >= 2)
+
+
+class TestTrainingMasters:
+    def test_sync_master(self):
+        net = _net(lr=0.2)
+        master = SyncAllReduceTrainingMaster(workers=8)
+        master.execute_training(net, ListDataSetIterator(_batches(32)), epochs=3)
+        assert net.evaluate([_batches(1, batch=64, seed=9)[0]]).accuracy() > 0.75
+        stats = master.get_stats()
+        assert "fit" in stats.phases()
+        assert stats.total_ms("fit") > 0
+
+    def test_param_avg_master_stats_and_html(self, tmp_path):
+        net = _net(lr=0.2)
+        master = ParameterAveragingTrainingMaster(workers=4, averaging_frequency=2)
+        master.execute_training(net, ListDataSetIterator(_batches(32)), epochs=3)
+        stats = master.get_stats()
+        assert {"broadcast", "fit", "aggregate"} <= set(stats.phases())
+        out = tmp_path / "stats.html"
+        stats.export_html(str(out))
+        assert "Training phase timings" in out.read_text()
+
+    def test_checkpoint_restart_mid_training(self, tmp_path):
+        """Sync-DP training -> checkpoint -> restore -> continue (SURVEY.md §5.4
+        as the recovery story)."""
+        from deeplearning4j_tpu.utils.serialization import write_model, restore_model
+
+        net = _net(updater="adam", lr=0.01)
+        batches = _batches(32)
+        ParallelWrapper(net, workers=8).fit(ListDataSetIterator(batches))
+        path = tmp_path / "ckpt.zip"
+        write_model(net, str(path))
+        restored = restore_model(str(path))
+        # updater state must round-trip exactly for exact resume
+        for a, b in zip(
+            jax.tree_util.tree_leaves(net.opt_state),
+            jax.tree_util.tree_leaves(restored.opt_state),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        ParallelWrapper(restored, workers=8).fit(ListDataSetIterator(batches), epochs=2)
+        assert restored.evaluate([_batches(1, batch=64, seed=9)[0]]).accuracy() > 0.7
