@@ -64,6 +64,14 @@ from .datasets.iterators import (
     MultipleEpochsIterator,
 )
 from .eval.evaluation import Evaluation
+from .eval.roc import ROC, ROCMultiClass
+from .eval.regression import RegressionEvaluation
+from .nn.layers.frozen import FrozenLayer
+from .nn.transferlearning import (
+    TransferLearning,
+    TransferLearningBuilder,
+    FineTuneConfiguration,
+)
 from .optimize.listeners import (
     IterationListener,
     TrainingListener,
@@ -124,6 +132,13 @@ __all__ = [
     "AsyncDataSetIterator",
     "MultipleEpochsIterator",
     "Evaluation",
+    "ROC",
+    "ROCMultiClass",
+    "RegressionEvaluation",
+    "FrozenLayer",
+    "TransferLearning",
+    "TransferLearningBuilder",
+    "FineTuneConfiguration",
     "IterationListener",
     "TrainingListener",
     "ScoreIterationListener",

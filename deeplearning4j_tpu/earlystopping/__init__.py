@@ -1,0 +1,37 @@
+"""Early stopping (reference: deeplearning4j-nn earlystopping/ — SURVEY.md §2.1).
+
+EarlyStoppingConfiguration + termination conditions + score calculators +
+model savers + trainer, matching the reference's fit loop
+(trainer/BaseEarlyStoppingTrainer.java:76): per epoch → fit → every
+``evaluate_every_n_epochs`` compute score → check improvement → save best →
+check epoch termination conditions; iteration conditions checked per iteration.
+"""
+
+from .config import EarlyStoppingConfiguration, EarlyStoppingResult
+from .conditions import (
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    BestScoreEpochTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+)
+from .scorecalc import DataSetLossCalculator
+from .saver import InMemoryModelSaver, LocalFileModelSaver
+from .trainer import EarlyStoppingTrainer, EarlyStoppingParallelTrainer
+
+__all__ = [
+    "EarlyStoppingConfiguration",
+    "EarlyStoppingResult",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "DataSetLossCalculator",
+    "InMemoryModelSaver",
+    "LocalFileModelSaver",
+    "EarlyStoppingTrainer",
+    "EarlyStoppingParallelTrainer",
+]

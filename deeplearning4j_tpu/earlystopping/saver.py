@@ -1,0 +1,80 @@
+"""Model savers (reference: earlystopping/saver/ — InMemoryModelSaver.java,
+LocalFileModelSaver.java)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """Reference: InMemoryModelSaver.java — clones kept on the host."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Reference: LocalFileModelSaver.java — bestModel.bin / latestModel.bin
+    under a directory (here the ModelSerializer zip format)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def _best_path(self):
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def _latest_path(self):
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        from ..utils.serialization import write_model
+
+        write_model(net, self._best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        from ..utils.serialization import write_model
+
+        write_model(net, self._latest_path)
+
+    def get_best_model(self):
+        from ..utils.serialization import restore_model
+
+        return restore_model(self._best_path) if os.path.exists(self._best_path) else None
+
+    def get_latest_model(self):
+        from ..utils.serialization import restore_model
+
+        return (
+            restore_model(self._latest_path) if os.path.exists(self._latest_path) else None
+        )

@@ -1,0 +1,121 @@
+"""Early-stopping trainers (reference:
+earlystopping/trainer/BaseEarlyStoppingTrainer.java:76 fit loop;
+EarlyStoppingTrainer / EarlyStoppingGraphTrainer;
+parallelism/EarlyStoppingParallelTrainer.java).
+
+One trainer serves both MultiLayerNetwork and ComputationGraph (duck-typed
+``fit``/``score``/``clone`` — the reference needed two classes only because of
+Java typing). The parallel variant trains each epoch through a
+:class:`~deeplearning4j_tpu.parallel.ParallelWrapper` mesh instead of replica
+threads.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from .config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    TerminationReason,
+)
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_data):
+        self.config = config
+        self.net = net
+        self.train_data = train_data
+
+    def _fit_epoch(self):
+        self.net.fit(self.train_data, epochs=1)
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+
+        best_score = math.inf
+        best_epoch = -1
+        score_vs_epoch = {}
+        epoch = 0
+
+        # Iteration-condition hook: listener checked per iteration
+        stop_flag = {"stop": False, "details": ""}
+        it_conditions = cfg.iteration_termination_conditions
+
+        class _IterListener:
+            def iteration_done(self, model, iteration, loss):
+                score = float(loss)
+                for c in it_conditions:
+                    if c.terminate(score):
+                        stop_flag["stop"] = True
+                        stop_flag["details"] = str(c)
+
+        listener = _IterListener()
+        self.net.add_listener(listener)
+        try:
+            while True:
+                try:
+                    self._fit_epoch()
+                except FloatingPointError as e:  # pragma: no cover
+                    return EarlyStoppingResult(
+                        TerminationReason.ERROR, str(e), score_vs_epoch,
+                        best_epoch, best_score, epoch,
+                        cfg.model_saver.get_best_model(),
+                    )
+                if stop_flag["stop"]:
+                    return EarlyStoppingResult(
+                        TerminationReason.ITERATION_TERMINATION_CONDITION,
+                        stop_flag["details"], score_vs_epoch, best_epoch,
+                        best_score, epoch + 1, cfg.model_saver.get_best_model(),
+                    )
+
+                if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+                    score = (
+                        cfg.score_calculator.calculate_score(self.net)
+                        if cfg.score_calculator is not None
+                        else self.net.score()
+                    )
+                    score_vs_epoch[epoch] = score
+                    if score < best_score:
+                        best_score = score
+                        best_epoch = epoch
+                        cfg.model_saver.save_best_model(self.net, score)
+                    if cfg.save_last_model:
+                        cfg.model_saver.save_latest_model(self.net, score)
+                    for c in cfg.epoch_termination_conditions:
+                        if c.terminate(epoch, score):
+                            return EarlyStoppingResult(
+                                TerminationReason.EPOCH_TERMINATION_CONDITION,
+                                str(c), score_vs_epoch, best_epoch, best_score,
+                                epoch + 1, cfg.model_saver.get_best_model(),
+                            )
+                epoch += 1
+        finally:
+            if listener in self.net.listeners:
+                self.net.listeners.remove(listener)
+
+
+# Alias matching the reference's ComputationGraph trainer name.
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over mesh-parallel epochs (reference:
+    parallelism/EarlyStoppingParallelTrainer.java)."""
+
+    def __init__(self, config, net, train_data, workers: Optional[int] = None,
+                 averaging_frequency: int = 1):
+        super().__init__(config, net, train_data)
+        from ..parallel import ParallelWrapper
+
+        self.wrapper = ParallelWrapper(
+            net, workers=workers, averaging_frequency=averaging_frequency
+        )
+
+    def _fit_epoch(self):
+        self.wrapper.fit(self.train_data, epochs=1)

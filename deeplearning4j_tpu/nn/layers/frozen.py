@@ -1,0 +1,75 @@
+"""FrozenLayer: wrapper that blocks gradient flow into a layer's params.
+
+Reference: nn/layers/FrozenLayer.java (427 LoC of zeroed-gradient plumbing).
+Here freezing is one ``jax.lax.stop_gradient`` on the param subtree — autodiff
+then produces exactly-zero grads for it, and regularization is excluded just as
+the reference skips score terms for frozen layers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, Params, register_layer, layer_from_dict
+
+
+@register_layer
+@dataclass
+class FrozenLayer(BaseLayer):
+    """Wraps any layer; params are held constant during training."""
+
+    layer: Optional[Any] = None  # BaseLayer or its to_dict() form
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            self.layer = layer_from_dict(self.layer)
+
+    def to_dict(self) -> dict:
+        return {"@type": "FrozenLayer", "layer": self.layer.to_dict(), "name": self.name}
+
+    # ---- delegation ----
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return self.layer.get_output_type(input_type)
+
+    def init_params(self, key, input_type) -> Params:
+        return self.layer.init_params(key, input_type)
+
+    def init_state(self, input_type):
+        return self.layer.init_state(input_type)
+
+    @property
+    def has_params(self) -> bool:
+        return self.layer.has_params
+
+    @property
+    def is_output_layer(self) -> bool:
+        return self.layer.is_output_layer
+
+    @property
+    def is_recurrent(self) -> bool:
+        return self.layer.is_recurrent
+
+    def init_recurrent_state(self, batch: int, dtype=None):
+        return self.layer.init_recurrent_state(batch, dtype)
+
+    def regularization_loss(self, params: Params):
+        return jnp.asarray(0.0)  # frozen params carry no score terms
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        frozen = jax.lax.stop_gradient(params)
+        # train=False inside: frozen layers run in inference mode (the reference
+        # FrozenLayer also suppresses dropout and BN stat updates)
+        return self.layer.apply(frozen, x, state, train=False, rng=rng, mask=mask)
+
+    def apply_seq(self, params, x, rstate, *, mask=None, train=False, rng=None):
+        frozen = jax.lax.stop_gradient(params)
+        return self.layer.apply_seq(frozen, x, rstate, mask=mask, train=False, rng=rng)
+
+    def compute_loss(self, params, x, labels, mask=None, *, train=False, rng=None):
+        frozen = jax.lax.stop_gradient(params)
+        return self.layer.compute_loss(frozen, x, labels, mask, train=False, rng=rng)

@@ -1,0 +1,125 @@
+"""Transfer learning: surgery on trained networks.
+
+Reference: nn/transferlearning/TransferLearning.java:34 (Builder :36,
+GraphBuilder :420) + FineTuneConfiguration.java. Clone a trained net, freeze a
+feature-extractor prefix (FrozenLayer wrappers), remove/replace output layers,
+change nOut with re-initialization, override training hyperparams — then train
+only the unfrozen tail.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+
+from .conf.multi_layer import MultiLayerConfiguration
+from .layers.base import BaseLayer
+from .layers.frozen import FrozenLayer
+from .multilayer import MultiLayerNetwork
+from .updaters import UpdaterConfig
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Training-hyperparam overrides applied to the cloned conf
+    (reference: FineTuneConfiguration.java)."""
+
+    updater: Optional[UpdaterConfig] = None
+    seed: Optional[int] = None
+    dtype: Optional[str] = None
+
+    def apply(self, conf: MultiLayerConfiguration) -> None:
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        if self.dtype is not None:
+            conf.dtype = self.dtype
+
+
+class TransferLearningBuilder:
+    """Reference: TransferLearning.Builder:36. Operations are applied at
+    ``build()``; layer params are preserved except where surgery invalidates
+    them (nOutReplace re-initializes the changed layer AND the next layer's
+    now-stale input weights, matching the reference)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        net.init()
+        self._conf = MultiLayerConfiguration.from_dict(net.conf.to_dict())
+        self._params: List = [
+            jax.tree_util.tree_map(lambda a: a, p) for p in net.params
+        ]
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._reinit: set = set()
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration) -> "TransferLearningBuilder":
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, layer_idx: int) -> "TransferLearningBuilder":
+        """Freeze layers [0, layer_idx] (reference: setFeatureExtractor)."""
+        self._freeze_until = layer_idx
+        return self
+
+    def remove_output_layer(self) -> "TransferLearningBuilder":
+        return self.remove_layers_from_output(1)
+
+    def remove_layers_from_output(self, n: int) -> "TransferLearningBuilder":
+        for _ in range(n):
+            self._conf.layers.pop()
+            self._params.pop()
+        return self
+
+    def add_layer(self, layer: BaseLayer) -> "TransferLearningBuilder":
+        self._conf.layers.append(layer)
+        self._params.append(None)  # fresh init at build
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int,
+                      weight_init: Optional[str] = None) -> "TransferLearningBuilder":
+        """Change layer_idx's n_out, re-initializing it and the next layer
+        (reference: nOutReplace)."""
+        layer = self._conf.layers[layer_idx]
+        layer.n_out = int(n_out)
+        if weight_init is not None:
+            layer.weight_init = weight_init
+        self._reinit.add(layer_idx)
+        if layer_idx + 1 < len(self._conf.layers):
+            nxt = self._conf.layers[layer_idx + 1]
+            if hasattr(nxt, "n_in"):
+                nxt.n_in = int(n_out)
+            self._reinit.add(layer_idx + 1)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        conf = self._conf
+        if self._fine_tune is not None:
+            self._fine_tune.apply(conf)
+        # freeze prefix by wrapping in FrozenLayer (params pass through unchanged)
+        if self._freeze_until is not None:
+            for i in range(min(self._freeze_until + 1, len(conf.layers))):
+                if not isinstance(conf.layers[i], FrozenLayer):
+                    conf.layers[i] = FrozenLayer(layer=conf.layers[i])
+        # re-init params for new/changed layers
+        input_types = conf.layer_input_types()
+        key = jax.random.PRNGKey(conf.seed)
+        keys = jax.random.split(key, len(conf.layers))
+        params = []
+        for i, layer in enumerate(conf.layers):
+            if i < len(self._params) and self._params[i] is not None and i not in self._reinit:
+                params.append(self._params[i])
+            else:
+                params.append(layer.init_params(keys[i], input_types[i]))
+        net = MultiLayerNetwork(conf)
+        net.init(params=tuple(params))
+        return net
+
+
+class TransferLearning:
+    """Namespace matching the reference's TransferLearning.Builder entry point."""
+
+    Builder = TransferLearningBuilder

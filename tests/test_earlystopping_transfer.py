@@ -1,0 +1,288 @@
+"""Early stopping + transfer learning + eval-extras tests (reference suites:
+TestEarlyStopping.java, TransferLearning tests, EvalTest/ROC tests)."""
+
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    FineTuneConfiguration,
+    FrozenLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    ROC,
+    ROCMultiClass,
+    RegressionEvaluation,
+    TransferLearning,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    EarlyStoppingParallelTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.config import TerminationReason
+
+
+def _net(lr=0.1, seed=3):
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="tanh"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(updater="sgd", learning_rate=lr),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    w = np.random.default_rng(42).normal(size=(4, 3))
+    x = rng.normal(size=(n, 4))
+    y = np.eye(3)[(x @ w).argmax(-1)]
+    return DataSet(x, y)
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        net = _net()
+        train = _data()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+        )
+        result = EarlyStoppingTrainer(cfg, net, [train]).fit()
+        assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+        assert result.total_epochs == 5
+        assert result.best_model is not None
+        assert result.best_model_score < math.inf
+        assert len(result.score_vs_epoch) == 5
+
+    def test_score_improvement_patience(self):
+        net = _net(lr=0.0)  # lr=0 -> score never improves after epoch 0
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(patience=3),
+            ],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+        )
+        result = EarlyStoppingTrainer(cfg, net, [_data()]).fit()
+        assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+        assert "ScoreImprovement" in result.termination_details
+        assert result.total_epochs <= 5
+
+    def test_max_score_iteration_termination(self):
+        net = _net(lr=1e4)  # diverges immediately
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(100)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(50.0),
+                InvalidScoreIterationTerminationCondition(),
+            ],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+        )
+        result = EarlyStoppingTrainer(
+            cfg, net, ListDataSetIterator([_data(seed=i) for i in range(8)], )
+        ).fit()
+        assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+        assert result.total_epochs <= 3
+
+    def test_max_time_termination(self):
+        net = _net()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(100000)],
+            iteration_termination_conditions=[MaxTimeIterationTerminationCondition(0.0)],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+        )
+        result = EarlyStoppingTrainer(cfg, net, [_data()]).fit()
+        assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+
+    def test_local_file_saver_roundtrip(self, tmp_path):
+        net = _net()
+        saver = LocalFileModelSaver(str(tmp_path))
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+            model_saver=saver,
+            save_last_model=True,
+        )
+        result = EarlyStoppingTrainer(cfg, net, [_data()]).fit()
+        best = saver.get_best_model()
+        assert best is not None
+        assert saver.get_latest_model() is not None
+        np.testing.assert_allclose(
+            best.score(_data(seed=1)), result.best_model_score, rtol=1e-6
+        )
+
+    def test_parallel_early_stopping(self):
+        net = _net(lr=0.2)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator([_data(seed=1)]),
+        )
+        batches = [_data(n=16, seed=i) for i in range(8)]
+        result = EarlyStoppingParallelTrainer(
+            cfg, net, ListDataSetIterator(batches), workers=4
+        ).fit()
+        assert result.total_epochs == 3
+        assert result.best_model is not None
+
+
+class TestTransferLearning:
+    def test_freeze_feature_extractor(self):
+        net = _net(lr=0.5)
+        net.fit(_data())
+        tl = (
+            TransferLearning.Builder(net)
+            .set_feature_extractor(0)
+            .build()
+        )
+        assert isinstance(tl.conf.layers[0], FrozenLayer)
+        frozen_before = np.asarray(tl.params[0]["W"]).copy()
+        out_before = np.asarray(tl.params[1]["W"]).copy()
+        tl.fit(_data(), epochs=3)
+        np.testing.assert_array_equal(np.asarray(tl.params[0]["W"]), frozen_before)
+        assert not np.allclose(np.asarray(tl.params[1]["W"]), out_before)
+
+    def test_nout_replace(self):
+        net = _net()
+        tl = TransferLearning.Builder(net).n_out_replace(0, 32).build()
+        assert tl.params[0]["W"].shape == (4, 32)
+        assert tl.params[1]["W"].shape == (32, 3)
+        tl.fit(_data())  # trains fine after surgery
+
+    def test_remove_and_add_output_layer(self):
+        net = _net()
+        net.fit(_data())
+        w0 = np.asarray(net.params[0]["W"])
+        tl = (
+            TransferLearning.Builder(net)
+            .remove_output_layer()
+            .add_layer(OutputLayer(n_in=16, n_out=5, activation="softmax", loss="mcxent"))
+            .build()
+        )
+        assert tl.params[1]["W"].shape == (16, 5)
+        np.testing.assert_array_equal(np.asarray(tl.params[0]["W"]), w0)  # kept
+        x = _data().features
+        assert tl.output(x).shape == (64, 5)
+
+    def test_fine_tune_updater_override(self):
+        net = _net()
+        tl = (
+            TransferLearning.Builder(net)
+            .fine_tune_configuration(
+                FineTuneConfiguration(updater=UpdaterConfig(updater="adam", learning_rate=1e-3))
+            )
+            .build()
+        )
+        assert tl.conf.updater.updater == "adam"
+        tl.fit(_data())
+
+    def test_frozen_json_roundtrip(self):
+        net = _net()
+        tl = TransferLearning.Builder(net).set_feature_extractor(0).build()
+        conf2 = MultiLayerConfiguration.from_json(tl.conf.to_json())
+        assert isinstance(conf2.layers[0], FrozenLayer)
+        assert isinstance(conf2.layers[0].layer, DenseLayer)
+        net2 = MultiLayerNetwork(conf2).init()
+        x = _data().features
+        assert net2.output(x).shape == (64, 3)
+
+
+class TestROC:
+    def test_perfect_classifier_auc_1(self):
+        roc = ROC(threshold_steps=30)
+        y = np.array([0, 0, 0, 1, 1, 1])
+        p = np.array([0.1, 0.2, 0.3, 0.7, 0.8, 0.9])
+        roc.eval(y, p)
+        assert roc.calculate_auc() == pytest.approx(1.0, abs=0.02)
+
+    def test_random_classifier_auc_half(self):
+        rng = np.random.default_rng(0)
+        roc = ROC(threshold_steps=50)
+        y = rng.integers(0, 2, size=5000)
+        p = rng.uniform(size=5000)
+        roc.eval(y, p)
+        assert roc.calculate_auc() == pytest.approx(0.5, abs=0.05)
+
+    def test_two_column_input_and_accumulation(self):
+        roc_a = ROC()
+        y = np.eye(2)[np.array([0, 1, 1, 0])]
+        p = np.array([[0.9, 0.1], [0.2, 0.8], [0.4, 0.6], [0.7, 0.3]])
+        roc_a.eval(y, p)
+        roc_b = ROC()
+        roc_b.eval(y[:2], p[:2])
+        roc_b.eval(y[2:], p[2:])
+        assert roc_a.calculate_auc() == pytest.approx(roc_b.calculate_auc())
+        assert roc_a.count == 4
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(1)
+        labels = np.eye(3)[rng.integers(0, 3, size=300)]
+        # probabilities correlated with labels
+        probs = labels * 0.6 + rng.uniform(size=(300, 3)) * 0.4
+        probs /= probs.sum(-1, keepdims=True)
+        roc = ROCMultiClass(threshold_steps=30)
+        roc.eval(labels, probs)
+        for c in range(3):
+            assert roc.calculate_auc(c) > 0.8
+        assert roc.calculate_average_auc() > 0.8
+
+
+class TestRegressionEvaluation:
+    def test_perfect_prediction(self):
+        ev = RegressionEvaluation(["a", "b"])
+        y = np.random.default_rng(0).normal(size=(50, 2))
+        ev.eval(y, y)
+        assert ev.mean_squared_error(0) == 0.0
+        assert ev.mean_absolute_error(1) == 0.0
+        assert ev.correlation_r2(0) == pytest.approx(1.0)
+
+    def test_known_errors(self):
+        ev = RegressionEvaluation()
+        y = np.array([[0.0], [1.0], [2.0], [3.0]])
+        p = y + np.array([[0.5], [-0.5], [0.5], [-0.5]])
+        ev.eval(y, p)
+        assert ev.mean_squared_error(0) == pytest.approx(0.25)
+        assert ev.mean_absolute_error(0) == pytest.approx(0.5)
+        assert ev.root_mean_squared_error(0) == pytest.approx(0.5)
+
+    def test_accumulation_and_stats(self):
+        rng = np.random.default_rng(2)
+        y = rng.normal(size=(100, 3))
+        p = y + 0.1 * rng.normal(size=(100, 3))
+        ev1 = RegressionEvaluation(["x", "y", "z"])
+        ev1.eval(y, p)
+        ev2 = RegressionEvaluation(["x", "y", "z"])
+        ev2.eval(y[:50], p[:50])
+        ev2.eval(y[50:], p[50:])
+        for c in range(3):
+            assert ev1.mean_squared_error(c) == pytest.approx(ev2.mean_squared_error(c))
+            assert ev1.correlation_r2(c) > 0.97
+        assert "RMSE" in ev1.stats()
+
+    def test_time_series_with_mask(self):
+        ev = RegressionEvaluation()
+        y = np.ones((2, 4, 1))
+        p = np.zeros((2, 4, 1))
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]])
+        ev.eval(y, p, mask=mask)
+        assert ev._n == 6
+        assert ev.mean_squared_error(0) == pytest.approx(1.0)
