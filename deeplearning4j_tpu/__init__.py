@@ -67,6 +67,15 @@ from .eval.evaluation import Evaluation
 from .eval.roc import ROC, ROCMultiClass
 from .eval.regression import RegressionEvaluation
 from .nn.layers.frozen import FrozenLayer
+from .nn.layers.pretrain import AutoEncoder, RBM
+from .nn.layers.variational import (
+    VariationalAutoencoder,
+    BernoulliReconstruction,
+    GaussianReconstruction,
+    ExponentialReconstruction,
+    CompositeReconstruction,
+    LossFunctionWrapper,
+)
 from .nn.transferlearning import (
     TransferLearning,
     TransferLearningBuilder,
@@ -136,6 +145,14 @@ __all__ = [
     "ROCMultiClass",
     "RegressionEvaluation",
     "FrozenLayer",
+    "AutoEncoder",
+    "RBM",
+    "VariationalAutoencoder",
+    "BernoulliReconstruction",
+    "GaussianReconstruction",
+    "ExponentialReconstruction",
+    "CompositeReconstruction",
+    "LossFunctionWrapper",
     "TransferLearning",
     "TransferLearningBuilder",
     "FineTuneConfiguration",

@@ -128,6 +128,11 @@ class BaseLayer:
     def is_recurrent(self) -> bool:
         return False
 
+    @property
+    def is_pretrain_layer(self) -> bool:
+        """Layerwise-pretrainable (reference: Layer.isPretrainLayer)."""
+        return False
+
     def regularization_loss(self, params: Params) -> jnp.ndarray:
         """0.5*l2*||W||² + l1*|W| (+ bias variants) — reference BaseLayer.calcL2/calcL1."""
         total = jnp.asarray(0.0)

@@ -373,6 +373,59 @@ class MultiLayerNetwork:
         st[layer_idx] = state_dict
         self._rnn_state = tuple(st)
 
+    # --------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+        """Layerwise unsupervised pretraining of AE/RBM/VAE layers
+        (reference: MultiLayerNetwork.pretrain, MultiLayerNetwork.java:932-945:
+        each pretrainable layer trains on the frozen activations of the stack
+        below it)."""
+        self.init()
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "is_pretrain_layer", False):
+                self.pretrain_layer(i, data, epochs)
+        return self
+
+    def pretrain_layer(self, layer_idx: int, data, epochs: int = 1) -> None:
+        """Reference: MultiLayerNetwork.pretrainLayer."""
+        from ..datasets.iterators import as_iterator
+        import optax as _optax
+
+        self.init()
+        layer = self.conf.layers[layer_idx]
+        if not getattr(layer, "is_pretrain_layer", False):
+            raise ValueError(f"layer {layer_idx} ({type(layer).__name__}) is not pretrainable")
+        tx = self.conf.updater.build()
+        opt_state = tx.init(self.params[layer_idx])
+
+        def step(lp, opt, params_all, state, x, rng):
+            h, _, _ = self._forward(params_all, x, state, False, None, upto=layer_idx)
+            if h.ndim > 2:
+                h = h.reshape(h.shape[0], -1)
+
+            def loss_of(p):
+                return layer.pretrain_loss(p, h, rng)
+
+            loss, grads = jax.value_and_grad(loss_of)(lp)
+            updates, new_opt = tx.update(grads, opt, lp)
+            return _optax.apply_updates(lp, updates), new_opt, loss
+
+        jstep = jax.jit(step)
+        lp = self.params[layer_idx]
+        for _ in range(epochs):
+            it = as_iterator(data)
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in it:
+                self._rng, k = jax.random.split(self._rng)
+                lp, opt_state, loss = jstep(
+                    lp, opt_state, self.params, self.state, ds.features, k
+                )
+                self._last_loss = loss
+        params = list(self.params)
+        params[layer_idx] = lp
+        self.params = tuple(params)
+        self._train_step = None  # params object replaced; next fit re-traces
+
     # -------------------------------------------------------------- inference
     def output(self, x, train: bool = False, features_mask=None):
         """Inference output (reference: MultiLayerNetwork.output:1505)."""

@@ -1,0 +1,223 @@
+"""Pretrain-layer tests: AutoEncoder, RBM, VAE (reference suites:
+VaeGradientCheckTests, RBM/AutoEncoder tests under deeplearning4j-core)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    AutoEncoder,
+    BernoulliReconstruction,
+    CompositeReconstruction,
+    DenseLayer,
+    ExponentialReconstruction,
+    GaussianReconstruction,
+    InputType,
+    LossFunctionWrapper,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    RBM,
+    UpdaterConfig,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.utils.gradcheck import gradient_check
+
+
+def _binary_data(n=64, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    # two prototype patterns + noise -> learnable structure
+    protos = rng.integers(0, 2, size=(2, d)).astype(np.float64)
+    idx = rng.integers(0, 2, size=n)
+    x = protos[idx]
+    flip = rng.uniform(size=x.shape) < 0.05
+    return np.abs(x - flip), idx
+
+
+class TestAutoEncoder:
+    def test_pretrain_reduces_reconstruction_loss(self):
+        x, _ = _binary_data()
+        ae = AutoEncoder(n_in=12, n_out=6, activation="sigmoid",
+                         corruption_level=0.1, loss="mse")
+        conf = MultiLayerConfiguration(
+            layers=[ae, OutputLayer(n_in=6, n_out=2, activation="softmax")],
+            input_type=InputType.feed_forward(12),
+            updater=UpdaterConfig(updater="adam", learning_rate=0.01),
+            seed=1,
+        )
+        net = MultiLayerNetwork(conf).init()
+        p0 = net.params[0]
+        loss0 = float(ae.pretrain_loss(p0, jnp.asarray(x)))
+        net.pretrain(DataSet(x, None), epochs=60)
+        loss1 = float(ae.pretrain_loss(net.params[0], jnp.asarray(x)))
+        assert loss1 < loss0 * 0.6, (loss0, loss1)
+
+    def test_pretrain_loss_gradcheck(self):
+        ae = AutoEncoder(n_in=5, n_out=3, activation="sigmoid",
+                         corruption_level=0.0, loss="mse")
+        p = ae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(5))
+        x = np.random.default_rng(0).uniform(size=(4, 5))
+        passed, nfail, err = gradient_check(
+            lambda p, x: ae.pretrain_loss(p, x), p, jnp.asarray(x)
+        )
+        assert passed, (nfail, err)
+
+    def test_sparsity_penalty(self):
+        ae = AutoEncoder(n_in=5, n_out=3, activation="sigmoid", sparsity=0.05,
+                         corruption_level=0.0)
+        p = ae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(5))
+        x = jnp.asarray(np.random.default_rng(0).uniform(size=(4, 5)))
+        plain = AutoEncoder(n_in=5, n_out=3, activation="sigmoid",
+                            corruption_level=0.0)
+        assert float(ae.pretrain_loss(p, x)) > float(plain.pretrain_loss(p, x))
+
+
+class TestRBM:
+    def test_cd_training_lowers_free_energy_gap(self):
+        x, _ = _binary_data(n=128)
+        rbm = RBM(n_in=12, n_out=8, k=1)
+        conf = MultiLayerConfiguration(
+            layers=[rbm, OutputLayer(n_in=8, n_out=2, activation="softmax")],
+            input_type=InputType.feed_forward(12),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+            seed=1,
+        )
+        net = MultiLayerNetwork(conf).init()
+        err0 = float(rbm.reconstruction_error(net.params[0], jnp.asarray(x)))
+        net.pretrain(DataSet(x, None), epochs=100)
+        err1 = float(rbm.reconstruction_error(net.params[0], jnp.asarray(x)))
+        assert err1 < err0 * 0.7, (err0, err1)
+
+    def test_prop_up_down_shapes(self):
+        rbm = RBM(n_in=6, n_out=4)
+        p = rbm.init_params(jax.random.PRNGKey(0), InputType.feed_forward(6))
+        v = jnp.asarray(np.random.default_rng(0).uniform(size=(3, 6)))
+        h = rbm.prop_up(p, v)
+        assert h.shape == (3, 4)
+        assert float(h.min()) >= 0 and float(h.max()) <= 1
+        assert rbm.prop_down(p, h).shape == (3, 6)
+
+    def test_gaussian_visible(self):
+        rbm = RBM(n_in=6, n_out=4, visible_unit="gaussian")
+        p = rbm.init_params(jax.random.PRNGKey(0), InputType.feed_forward(6))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 6)))
+        loss = rbm.pretrain_loss(p, x, jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+
+
+class TestVAE:
+    def _vae(self, recon=None, n_in=8, n_z=3):
+        return VariationalAutoencoder(
+            n_in=n_in, n_out=n_z,
+            encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+            activation="tanh", num_samples=1,
+            reconstruction=recon or BernoulliReconstruction(),
+        )
+
+    def test_elbo_gradcheck(self):
+        vae = self._vae()
+        p = vae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(8))
+        x = jnp.asarray((np.random.default_rng(0).uniform(size=(4, 8)) > 0.5).astype(float))
+        rng = jax.random.PRNGKey(7)  # fixed sampling noise -> deterministic loss
+        passed, nfail, err = gradient_check(
+            lambda p, x: vae.pretrain_loss(p, x, rng), p, x
+        )
+        assert passed, (nfail, err)
+
+    @pytest.mark.parametrize(
+        "recon,data",
+        [
+            (BernoulliReconstruction(), "binary"),
+            (GaussianReconstruction(), "real"),
+            (ExponentialReconstruction(), "positive"),
+            (LossFunctionWrapper(loss="mse"), "real"),
+            (
+                CompositeReconstruction(
+                    parts=[(4, BernoulliReconstruction()), (4, GaussianReconstruction())]
+                ),
+                "binary",
+            ),
+        ],
+    )
+    def test_all_reconstruction_distributions(self, recon, data):
+        rng = np.random.default_rng(0)
+        if data == "binary":
+            x = (rng.uniform(size=(6, 8)) > 0.5).astype(np.float64)
+        elif data == "positive":
+            x = rng.exponential(size=(6, 8))
+        else:
+            x = rng.normal(size=(6, 8))
+        vae = self._vae(recon=recon)
+        p = vae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(8))
+        loss = vae.pretrain_loss(p, jnp.asarray(x), jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+        # mean path produces data-shaped output
+        z = jnp.zeros((6, 3))
+        assert vae.generate_at_mean_given_z(p, z).shape == (6, 8)
+
+    def test_vae_pretrain_improves_elbo(self):
+        x, _ = _binary_data(n=128, d=8)
+        vae = self._vae()
+        conf = MultiLayerConfiguration(
+            layers=[vae, OutputLayer(n_in=3, n_out=2, activation="softmax")],
+            input_type=InputType.feed_forward(8),
+            updater=UpdaterConfig(updater="adam", learning_rate=0.01),
+            seed=1,
+        )
+        net = MultiLayerNetwork(conf).init()
+        key = jax.random.PRNGKey(5)
+        loss0 = float(vae.pretrain_loss(net.params[0], jnp.asarray(x), key))
+        net.pretrain(DataSet(x, None), epochs=80)
+        loss1 = float(vae.pretrain_loss(net.params[0], jnp.asarray(x), key))
+        assert loss1 < loss0, (loss0, loss1)
+
+    def test_reconstruction_log_probability(self):
+        x, _ = _binary_data(n=16, d=8)
+        vae = self._vae()
+        p = vae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(8))
+        logp = vae.reconstruction_log_probability(p, jnp.asarray(x), num_samples=16)
+        assert logp.shape == (16,)
+        assert np.all(np.asarray(logp) < 0)
+
+    def test_vae_json_roundtrip(self):
+        vae = self._vae(
+            recon=CompositeReconstruction(
+                parts=[(4, BernoulliReconstruction()), (4, GaussianReconstruction())]
+            )
+        )
+        conf = MultiLayerConfiguration(
+            layers=[vae, OutputLayer(n_in=3, n_out=2, activation="softmax")],
+            input_type=InputType.feed_forward(8),
+        )
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        vae2 = conf2.layers[0]
+        assert isinstance(vae2, VariationalAutoencoder)
+        assert isinstance(vae2.reconstruction, CompositeReconstruction)
+        assert vae2.encoder_layer_sizes == (16,)
+        p = vae.init_params(jax.random.PRNGKey(0), InputType.feed_forward(8))
+        x = jnp.asarray((np.random.default_rng(0).uniform(size=(4, 8)) > 0.5).astype(float))
+        k = jax.random.PRNGKey(1)
+        np.testing.assert_allclose(
+            float(vae.pretrain_loss(p, x, k)), float(vae2.pretrain_loss(p, x, k))
+        )
+
+
+class TestSupervisedAfterPretrain:
+    def test_pretrain_then_finetune(self):
+        x, idx = _binary_data(n=128, d=12)
+        y = np.eye(2)[idx]
+        conf = MultiLayerConfiguration(
+            layers=[
+                AutoEncoder(n_in=12, n_out=6, activation="sigmoid", corruption_level=0.1),
+                OutputLayer(n_in=6, n_out=2, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.feed_forward(12),
+            updater=UpdaterConfig(updater="adam", learning_rate=0.01),
+            seed=1,
+        )
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain(DataSet(x, None), epochs=30)
+        net.fit(DataSet(x, y), epochs=30)
+        assert net.evaluate([DataSet(x, y)]).accuracy() > 0.95
