@@ -1,0 +1,24 @@
+"""Observability tier: stats storage, StatsListener, browser UI
+(reference: deeplearning4j-ui-parent — SURVEY.md §2.8, §5.5)."""
+
+from .storage import (
+    StatsStorage,
+    StatsStorageRouter,
+    InMemoryStatsStorage,
+    FileStatsStorage,
+    SqliteStatsStorage,
+    RemoteStatsStorageRouter,
+)
+from .stats_listener import StatsListener
+from .server import UIServer
+
+__all__ = [
+    "StatsStorage",
+    "StatsStorageRouter",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "SqliteStatsStorage",
+    "RemoteStatsStorageRouter",
+    "StatsListener",
+    "UIServer",
+]

@@ -1,0 +1,157 @@
+"""UIServer: browser training dashboard over a StatsStorage.
+
+Reference: deeplearning4j-play/.../PlayUIServer.java:53 + api/UIServer.java
+(``UIServer.getInstance().attach(statsStorage)``) and the train module pages
+(module/train/TrainModule.java — overview/model/system). The Play framework is
+replaced by a stdlib ``http.server`` on a background thread serving one
+self-contained HTML page (inline SVG charts, zero JS dependencies) plus a JSON
+API; a remote-stats receiver endpoint accepts POSTs from
+RemoteStatsStorageRouter (reference: ui/module/remote/).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from .storage import StatsStorage, InMemoryStatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu Training UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#f7f7f7}
+h1{font-size:20px} .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+padding:12px;margin:12px 0} table{border-collapse:collapse}
+td,th{border:1px solid #ccc;padding:4px 8px;font-size:13px}
+</style></head>
+<body>
+<h1>deeplearning4j_tpu — Training overview</h1>
+<div class="card"><h3>Score vs iteration</h3><svg id="score" width="800" height="240"></svg></div>
+<div class="card"><h3>Sessions</h3><table id="sessions"><tr><th>session</th><th>workers</th><th>updates</th><th>last score</th></tr></table></div>
+<div class="card"><h3>Model</h3><pre id="model"></pre></div>
+<script>
+async function refresh(){
+  const sessions = await (await fetch('api/sessions')).json();
+  const tbl = document.getElementById('sessions');
+  tbl.innerHTML = '<tr><th>session</th><th>workers</th><th>updates</th><th>last score</th></tr>';
+  for (const s of sessions){
+    const ups = await (await fetch('api/updates?session='+s)).json();
+    const last = ups.length ? ups[ups.length-1].score.toFixed(5) : '-';
+    tbl.innerHTML += `<tr><td>${s}</td><td>-</td><td>${ups.length}</td><td>${last}</td></tr>`;
+    if (ups.length) drawScore(ups);
+    const st = await (await fetch('api/static?session='+s)).json();
+    if (st.length) document.getElementById('model').textContent = JSON.stringify(st[0], null, 2);
+  }
+}
+function drawScore(ups){
+  const svg = document.getElementById('score');
+  const xs = ups.map(u=>u.iteration), ys = ups.map(u=>u.score);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs), ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const W=780, H=220, pad=30;
+  const px=x=>pad+(W-pad)*(x-xmin)/Math.max(xmax-xmin,1e-9);
+  const py=y=>H-pad-(H-2*pad)*(y-ymin)/Math.max(ymax-ymin,1e-9);
+  let d='M'+ups.map(u=>px(u.iteration)+','+py(u.score)).join(' L');
+  svg.innerHTML=`<path d="${d}" fill="none" stroke="#36c" stroke-width="1.5"/>`+
+   `<text x="5" y="15" font-size="11">${ymax.toFixed(4)}</text>`+
+   `<text x="5" y="${H-pad+12}" font-size="11">${ymin.toFixed(4)}</text>`;
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTpuUI/0.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _query(self) -> dict:
+        from urllib.parse import urlparse, parse_qs
+
+        q = parse_qs(urlparse(self.path).query)
+        return {k: v[0] for k, v in q.items()}
+
+    def do_GET(self):
+        storages: List[StatsStorage] = self.server.storages  # type: ignore
+        path = self.path.split("?")[0]
+        if path in ("/", "/train", "/train/overview"):
+            return self._send(200, _PAGE.encode(), "text/html")
+        if path == "/api/sessions":
+            out = sorted({s for st in storages for s in st.list_session_ids()})
+            return self._send(200, json.dumps(out).encode())
+        if path == "/api/updates":
+            q = self._query()
+            sess = q.get("session", "")
+            out = []
+            for st in storages:
+                out.extend(st.get_all_updates(sess, q.get("worker")))
+            # strip histograms for the overview payload
+            slim = [
+                {k: v for k, v in r.items() if k != "param_histograms"} for r in out
+            ]
+            return self._send(200, json.dumps(slim).encode())
+        if path == "/api/static":
+            q = self._query()
+            out = []
+            for st in storages:
+                out.extend(st.get_static_info(q.get("session", "")))
+            return self._send(200, json.dumps(out).encode())
+        return self._send(404, b'{"error": "not found"}')
+
+    def do_POST(self):
+        """Remote stats receiver (reference: ui/module/remote/)."""
+        storages: List[StatsStorage] = self.server.storages  # type: ignore
+        length = int(self.headers.get("Content-Length", 0))
+        record = json.loads(self.rfile.read(length) or b"{}")
+        if not storages:
+            return self._send(503, b'{"error": "no storage attached"}')
+        if self.path == "/remote/static":
+            storages[0].put_static_info(record)
+        elif self.path == "/remote/update":
+            storages[0].put_update(record)
+        else:
+            return self._send(404, b"{}")
+        return self._send(200, b'{"status": "ok"}')
+
+
+class UIServer:
+    """Reference: api/UIServer.java — singleton, ``attach(statsStorage)``."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.storages = []  # type: ignore
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._httpd.storages.append(storage)  # type: ignore
+
+    def detach(self, storage: StatsStorage) -> None:
+        self._httpd.storages.remove(storage)  # type: ignore
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

