@@ -1,0 +1,51 @@
+"""NLP stack (reference: deeplearning4j-nlp-parent — SURVEY.md §2.5):
+SequenceVectors engine, Word2Vec/ParagraphVectors/GloVe, tokenizer +
+sentence-iterator SPIs, vocab/Huffman, word-vector serialization."""
+
+from .tokenization import (
+    Tokenizer,
+    TokenizerFactory,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    TokenPreProcess,
+    CommonPreprocessor,
+    EndingPreProcessor,
+)
+from .sentence_iterator import (
+    SentenceIterator,
+    CollectionSentenceIterator,
+    BasicLineIterator,
+    SentencePreProcessor,
+    LabelledDocument,
+    LabelAwareIterator,
+    CollectionLabelAwareIterator,
+)
+from .vocab import VocabWord, VocabCache, VocabConstructor, Huffman
+from .lookup import InMemoryLookupTable
+from .sequence_vectors import Sequence, SequenceVectors
+from .word2vec import Word2Vec
+from .paragraph_vectors import ParagraphVectors
+from .glove import Glove, AbstractCoOccurrences
+from .serialization import (
+    write_word_vectors,
+    load_txt_vectors,
+    write_binary_model,
+    read_binary_model,
+    write_sequence_vectors,
+    read_sequence_vectors,
+)
+
+__all__ = [
+    "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",
+    "NGramTokenizerFactory", "TokenPreProcess", "CommonPreprocessor",
+    "EndingPreProcessor",
+    "SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
+    "SentencePreProcessor", "LabelledDocument", "LabelAwareIterator",
+    "CollectionLabelAwareIterator",
+    "VocabWord", "VocabCache", "VocabConstructor", "Huffman",
+    "InMemoryLookupTable",
+    "Sequence", "SequenceVectors",
+    "Word2Vec", "ParagraphVectors", "Glove", "AbstractCoOccurrences",
+    "write_word_vectors", "load_txt_vectors", "write_binary_model",
+    "read_binary_model", "write_sequence_vectors", "read_sequence_vectors",
+]

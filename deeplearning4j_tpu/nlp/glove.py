@@ -1,0 +1,165 @@
+"""GloVe: co-occurrence counting + weighted least-squares embedding.
+
+Reference: models/glove/Glove.java (438) + AbstractCoOccurrences.java (640) and
+the GloVe learning algorithm (embeddings/learning/impl/elements/GloVe.java):
+window-weighted co-occurrence counts (1/distance), then AdaGrad on
+  f(X_ij)(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ - log X_ij)²  with f(x)=(x/x_max)^α clipped at 1.
+
+TPU-native: co-occurrences accumulate in a host dict (sparse, one pass); the
+optimization runs as jitted minibatched AdaGrad over the nonzero entries —
+gathers + one fused elementwise block, scatter-add grads from autodiff.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .sequence_vectors import Sequence, _as_sequence
+from .vocab import VocabCache, VocabConstructor
+from .lookup import InMemoryLookupTable
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+
+
+class AbstractCoOccurrences:
+    """Reference: glove/AbstractCoOccurrences.java — symmetric, 1/distance
+    weighting within the window."""
+
+    def __init__(self, vocab: VocabCache, window: int = 15, symmetric: bool = True):
+        self.vocab = vocab
+        self.window = window
+        self.symmetric = symmetric
+        self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
+
+    def fit(self, sequences: Iterable[Sequence]) -> "AbstractCoOccurrences":
+        for s in sequences:
+            idxs = [
+                self.vocab.index_of(w) for w in s.elements if self.vocab.contains_word(w)
+            ]
+            n = len(idxs)
+            for i in range(n):
+                for j in range(max(0, i - self.window), i):
+                    w = 1.0 / (i - j)
+                    a, b = idxs[i], idxs[j]
+                    self.counts[(a, b)] += w
+                    if self.symmetric:
+                        self.counts[(b, a)] += w
+        return self
+
+    def as_arrays(self):
+        keys = np.array(list(self.counts.keys()), np.int32).reshape(-1, 2)
+        vals = np.array(list(self.counts.values()), np.float32)
+        return keys[:, 0], keys[:, 1], vals
+
+
+class Glove:
+    """Reference: models/glove/Glove.java Builder — xMax, alpha, learningRate,
+    epochs, layerSize, windowSize, minWordFrequency."""
+
+    def __init__(
+        self,
+        layer_size: int = 100,
+        window: int = 15,
+        min_word_frequency: int = 1,
+        epochs: int = 25,
+        learning_rate: float = 0.05,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        batch_size: int = 4096,
+        symmetric: bool = True,
+        seed: int = 12345,
+        tokenizer_factory: Optional[TokenizerFactory] = None,
+    ):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.lookup: Optional[InMemoryLookupTable] = None
+
+    def _to_sequences(self, data) -> List[Sequence]:
+        data = list(data)
+        if data and isinstance(data[0], str):
+            return [
+                Sequence(elements=self.tokenizer_factory.create(s).get_tokens())
+                for s in data
+            ]
+        return [_as_sequence(s) for s in data]
+
+    def fit(self, data) -> "Glove":
+        import jax
+        import jax.numpy as jnp
+
+        seqs = self._to_sequences(data)
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(
+            (s.elements for s in seqs)
+        )
+        co = AbstractCoOccurrences(self.vocab, self.window, self.symmetric).fit(seqs)
+        rows, cols, xs = co.as_arrays()
+        if len(xs) == 0:
+            raise ValueError("empty co-occurrence matrix (vocab/window too small?)")
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((V, D)) - 0.5).astype(np.float32) / D)
+        wt = jnp.asarray((rng.random((V, D)) - 0.5).astype(np.float32) / D)
+        b = jnp.zeros(V, jnp.float32)
+        bt = jnp.zeros(V, jnp.float32)
+        # AdaGrad accumulators (reference: GloVe.java uses AdaGrad per element)
+        state = tuple(jnp.ones_like(t) for t in (w, wt, b, bt))
+        log_x = np.log(np.maximum(xs, 1e-12))
+        fx = np.minimum((xs / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        lr, eps = self.learning_rate, 1e-8
+
+        def step(params, state, i, j, fxb, logxb):
+            def loss_fn(p):
+                w_, wt_, b_, bt_ = p
+                diff = (
+                    jnp.sum(jnp.take(w_, i, axis=0) * jnp.take(wt_, j, axis=0), -1)
+                    + jnp.take(b_, i) + jnp.take(bt_, j) - logxb
+                )
+                return jnp.sum(fxb * diff * diff)
+
+            grads = jax.grad(loss_fn)(params)
+            new_state = tuple(s + g * g for s, g in zip(state, grads))
+            new_params = tuple(
+                p - lr * g / jnp.sqrt(s + eps)
+                for p, g, s in zip(params, grads, new_state)
+            )
+            return new_params, new_state
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        params = (w, wt, b, bt)
+        n = len(xs)
+        B = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for k in range(0, n - B + 1, B):
+                sel = order[k : k + B]
+                params, state = jstep(
+                    params, state, rows[sel], cols[sel], fx[sel], log_x[sel]
+                )
+        # final vectors: w + w̃ (standard GloVe practice)
+        self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed, use_hs=False,
+                                          negative=1)
+        self.lookup.syn0 = np.asarray(params[0]) + np.asarray(params[1])
+        return self
+
+    # ---- queries ----
+    def get_word_vector(self, word: str):
+        return self.lookup.vector(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.lookup.similarity(a, b)
+
+    def words_nearest(self, word, top_n: int = 10):
+        return self.lookup.words_nearest(word, top_n)

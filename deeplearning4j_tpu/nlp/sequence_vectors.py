@@ -1,0 +1,376 @@
+"""SequenceVectors: the generic embedding trainer.
+
+Reference: models/sequencevectors/SequenceVectors.java (fit :193-313,
+trainSequence :315) with pluggable learning algorithms
+(embeddings/learning/impl/elements/SkipGram.java:31 learnSequence:150,
+CBOW.java; sequence algorithms DBOW.java, DM.java).
+
+TPU-native redesign of the hot loop: the reference trains pair-at-a-time with
+hand-coded HS/negative-sampling row updates on the lookup table (SkipGram
+.java:150; AsyncSequencer + VectorCalculationsThreads feeding it). Here the
+host generates *batches* of (source, target) training examples (numpy) and a
+single jitted device step consumes each batch: embedding gathers, one batched
+dot-product block, log-sigmoid losses, and autodiff's scatter-add gradients —
+the MXU-friendly formulation. All four algorithms share two kernels:
+
+- HS kernel: source vector (mean of S source rows) vs Huffman points/codes.
+- NEG kernel: source vector vs 1 positive + K sampled negatives.
+
+SkipGram = S=1 source (center word) per context target; CBOW = S=window
+sources (context mean) per center target; DBOW = S=1 source (doc label row);
+DM = context + doc label rows averaged. Subsampling, reduced windows, and
+linear lr decay follow the reference/word2vec conventions.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+from .vocab import Huffman, VocabCache, VocabConstructor, VocabWord
+from .lookup import InMemoryLookupTable
+
+
+@dataclass
+class Sequence:
+    """Reference: models/sequencevectors/sequence/Sequence.java."""
+
+    elements: List[str]
+    labels: List[str] = field(default_factory=list)
+
+
+def _as_sequence(s) -> Sequence:
+    if isinstance(s, Sequence):
+        return s
+    return Sequence(elements=list(s))
+
+
+class _Kernels:
+    """Lazily-jitted device steps, cached per static shape signature."""
+
+    def __init__(self):
+        self._hs = {}
+        self._neg = {}
+
+    def hs_step(self, S: int, L: int):
+        key = (S, L)
+        if key not in self._hs:
+            import jax
+            import jax.numpy as jnp
+
+            def step(syn0, syn1, src, src_mask, points, codes, code_mask, lr):
+                def loss_fn(tables):
+                    s0, s1 = tables
+                    vecs = jnp.take(s0, src, axis=0)  # [B, S, D]
+                    m = src_mask[..., None]
+                    h = (vecs * m).sum(1) / jnp.maximum(m.sum(1), 1.0)  # [B, D]
+                    node_vecs = jnp.take(s1, points, axis=0)  # [B, L, D]
+                    u = jnp.einsum("bd,bld->bl", h, node_vecs)
+                    # label = 1 - code (word2vec HS); -log σ((1-2c)·u)
+                    sign = 1.0 - 2.0 * codes
+                    return jnp.sum(jax.nn.softplus(-sign * u) * code_mask)
+
+                grads = jax.grad(loss_fn)((syn0, syn1))
+                return syn0 - lr * grads[0], syn1 - lr * grads[1]
+
+            self._hs[key] = jax.jit(step, donate_argnums=(0, 1))
+        return self._hs[key]
+
+    def neg_step(self, S: int, K: int):
+        key = (S, K)
+        if key not in self._neg:
+            import jax
+            import jax.numpy as jnp
+
+            def step(syn0, syn1neg, src, src_mask, tgt, negs, sample_mask, lr):
+                def loss_fn(tables):
+                    s0, s1 = tables
+                    vecs = jnp.take(s0, src, axis=0)
+                    m = src_mask[..., None]
+                    h = (vecs * m).sum(1) / jnp.maximum(m.sum(1), 1.0)  # [B, D]
+                    pos = jnp.sum(h * jnp.take(s1, tgt, axis=0), axis=-1)  # [B]
+                    neg = jnp.einsum("bd,bkd->bk", h, jnp.take(s1, negs, axis=0))
+                    # skip sampled negatives that hit the true target (word2vec
+                    # C convention; with small vocabs this otherwise diverges)
+                    neg_mask = (negs != tgt[:, None]).astype(h.dtype)
+                    loss = jax.nn.softplus(-pos) + jnp.sum(
+                        jax.nn.softplus(neg) * neg_mask, axis=-1
+                    )
+                    return jnp.sum(loss * sample_mask)
+
+                grads = jax.grad(loss_fn)((syn0, syn1neg))
+                return syn0 - lr * grads[0], syn1neg - lr * grads[1]
+
+            self._neg[key] = jax.jit(step, donate_argnums=(0, 1))
+        return self._neg[key]
+
+
+class SequenceVectors:
+    """Reference API surface: SequenceVectors.Builder → layerSize, windowSize,
+    minWordFrequency, negativeSample, useHierarchicSoftmax, epochs,
+    learningRate/minLearningRate, sampling (subsampling), batchSize, seed."""
+
+    def __init__(
+        self,
+        layer_size: int = 100,
+        window: int = 5,
+        min_word_frequency: int = 1,
+        negative: int = 0,
+        use_hs: bool = True,
+        epochs: int = 1,
+        learning_rate: float = 0.025,
+        min_learning_rate: float = 1e-4,
+        subsampling: float = 0.0,
+        batch_size: int = 512,
+        seed: int = 12345,
+        elements_algo: str = "skipgram",  # skipgram | cbow | none
+        sequence_algo: Optional[str] = None,  # dbow | dm | None
+        train_elements: bool = True,
+    ):
+        if negative <= 0 and not use_hs:
+            raise ValueError("need hierarchical softmax and/or negative sampling")
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = int(negative)
+        self.use_hs = use_hs
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.subsampling = subsampling
+        self.batch_size = batch_size
+        self.seed = seed
+        self.elements_algo = elements_algo
+        self.sequence_algo = sequence_algo
+        self.train_elements = train_elements
+
+        self.vocab: Optional[VocabCache] = None
+        self.lookup: Optional[InMemoryLookupTable] = None
+        self._kernels = _Kernels()
+        self._rng = np.random.default_rng(seed)
+        self._max_code = 0
+        self._codes_arr: Optional[np.ndarray] = None
+        self._points_arr: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------- vocab init
+    def build_vocab(self, sequences: Iterable) -> None:
+        seqs = [_as_sequence(s) for s in sequences]
+        cache = VocabConstructor(self.min_word_frequency).build_vocab(
+            (s.elements for s in seqs)
+        )
+        # ParagraphVectors labels become vocab rows too (reference: labels are
+        # special SequenceElements in the same lookup table)
+        for s in seqs:
+            for lab in s.labels:
+                if not cache.contains_word(lab):
+                    vw = VocabWord(word=lab, count=1)
+                    vw.is_label = True
+                    cache.add_token(vw)
+                else:
+                    cache.word_for(lab).is_label = True
+        self.vocab = cache
+        if self.use_hs:
+            Huffman(cache.vocab_words()).build()
+            self._max_code = max((len(vw.codes) for vw in cache.vocab_words()), default=1)
+            V = cache.num_words()
+            L = self._max_code
+            self._codes_arr = np.zeros((V, L), np.float32)
+            self._points_arr = np.zeros((V, L), np.int32)
+            self._code_mask = np.zeros((V, L), np.float32)
+            for vw in cache.vocab_words():
+                n = len(vw.codes)
+                self._codes_arr[vw.index, :n] = vw.codes
+                self._points_arr[vw.index, :n] = vw.points
+                self._code_mask[vw.index, :n] = 1.0
+        self.lookup = InMemoryLookupTable(
+            cache, self.layer_size, seed=self.seed,
+            negative=self.negative, use_hs=self.use_hs,
+        )
+        if self.negative > 0:
+            self.lookup.make_negative_table()
+
+    # ---------------------------------------------------------------- training
+    def fit(self, sequences: Iterable) -> "SequenceVectors":
+        seqs = [_as_sequence(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        total_words = sum(len(s.elements) for s in seqs) * self.epochs
+        words_seen = 0
+
+        # training-example buffers: (src rows [S], target)
+        S = self._num_sources()
+        src_buf: List[np.ndarray] = []
+        mask_buf: List[np.ndarray] = []
+        tgt_buf: List[int] = []
+
+        def flush(final: bool = False):
+            nonlocal src_buf, mask_buf, tgt_buf
+            while len(tgt_buf) >= self.batch_size or (final and tgt_buf):
+                take = min(self.batch_size, len(tgt_buf))
+                lr = max(
+                    self.min_learning_rate,
+                    self.learning_rate * (1.0 - words_seen / max(total_words, 1)),
+                )
+                self._device_step(
+                    np.stack(src_buf[:take]),
+                    np.stack(mask_buf[:take]),
+                    np.asarray(tgt_buf[:take], np.int32),
+                    lr,
+                )
+                src_buf, mask_buf, tgt_buf = src_buf[take:], mask_buf[take:], tgt_buf[take:]
+                if final and not tgt_buf:
+                    break
+
+        for _ in range(self.epochs):
+            order = self._rng.permutation(len(seqs))
+            for si in order:
+                s = seqs[si]
+                n_new = self._generate_examples(s, src_buf, mask_buf, tgt_buf)
+                words_seen += len(s.elements)
+                flush()
+        flush(final=True)
+        self._sync_tables()
+        return self
+
+    def _num_sources(self) -> int:
+        if self.elements_algo == "cbow" or self.sequence_algo == "dm":
+            return 2 * self.window + 1  # context slots (+doc row for DM)
+        return 1
+
+    def _subsample_keep(self, vw: VocabWord) -> bool:
+        if self.subsampling <= 0:
+            return True
+        freq = vw.count / max(self.vocab.total_word_count, 1)
+        prob = (math.sqrt(freq / self.subsampling) + 1) * self.subsampling / freq
+        return self._rng.random() < prob
+
+    def _generate_examples(self, s: Sequence, src_buf, mask_buf, tgt_buf) -> int:
+        """Host-side example generation (reference: SkipGram/CBOW.learnSequence
+    window iteration with reduced window b)."""
+        vocab = self.vocab
+        idxs = [
+            vocab.word_for(w).index
+            for w in s.elements
+            if vocab.contains_word(w) and self._subsample_keep(vocab.word_for(w))
+        ]
+        label_idxs = [vocab.index_of(l) for l in s.labels if vocab.contains_word(l)]
+        S = self._num_sources()
+        count0 = len(tgt_buf)
+
+        n = len(idxs)
+        for pos in range(n):
+            b = int(self._rng.integers(1, self.window + 1))  # reduced window
+            ctx = [idxs[j] for j in range(max(0, pos - b), min(n, pos + b + 1)) if j != pos]
+            if self.train_elements and self.elements_algo == "skipgram":
+                for c in ctx:
+                    src = np.zeros(S, np.int32)
+                    src[0] = idxs[pos]
+                    m = np.zeros(S, np.float32)
+                    m[0] = 1.0
+                    src_buf.append(src)
+                    mask_buf.append(m)
+                    tgt_buf.append(c)
+            elif self.train_elements and self.elements_algo == "cbow":
+                if not ctx:
+                    continue
+                src = np.zeros(S, np.int32)
+                m = np.zeros(S, np.float32)
+                src[: len(ctx)] = ctx[:S]
+                m[: len(ctx)] = 1.0
+                src_buf.append(src)
+                mask_buf.append(m)
+                tgt_buf.append(idxs[pos])
+            if self.sequence_algo == "dm" and label_idxs:
+                src = np.zeros(S, np.int32)
+                m = np.zeros(S, np.float32)
+                both = (ctx + label_idxs)[:S]
+                src[: len(both)] = both
+                m[: len(both)] = 1.0
+                if len(both):
+                    src_buf.append(src)
+                    mask_buf.append(m)
+                    tgt_buf.append(idxs[pos])
+        if self.sequence_algo == "dbow" and label_idxs:
+            for li in label_idxs:
+                for w in idxs:
+                    src = np.zeros(S, np.int32)
+                    src[0] = li
+                    m = np.zeros(S, np.float32)
+                    m[0] = 1.0
+                    src_buf.append(src)
+                    mask_buf.append(m)
+                    tgt_buf.append(w)
+        return len(tgt_buf) - count0
+
+    # ---- device step ----
+    def _ensure_device_tables(self):
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_dev"):
+            self._dev = {
+                "syn0": jnp.asarray(self.lookup.syn0),
+                "syn1": jnp.asarray(self.lookup.syn1) if self.use_hs else None,
+                "syn1neg": (
+                    jnp.asarray(self.lookup.syn1neg) if self.negative > 0 else None
+                ),
+            }
+
+    def _device_step(self, src, src_mask, tgt, lr):
+        self._ensure_device_tables()
+        B, S = src.shape
+        if B < self.batch_size:  # pad to static batch shape
+            pad = self.batch_size - B
+            src = np.concatenate([src, np.zeros((pad, S), np.int32)])
+            src_mask = np.concatenate(
+                [src_mask, np.zeros((pad, S), np.float32)]
+            )
+            # padded rows keep mask via sample_mask / code_mask zeros
+            tgt_pad = np.zeros(pad, np.int32)
+            sample_mask = np.concatenate([np.ones(B, np.float32), np.zeros(pad, np.float32)])
+            tgt = np.concatenate([tgt, tgt_pad])
+        else:
+            sample_mask = np.ones(B, np.float32)
+        # ensure padded src rows have at least one "valid" slot to avoid 0/0
+        if self.use_hs:
+            step = self._kernels.hs_step(S, self._max_code)
+            codes = self._codes_arr[tgt] * sample_mask[:, None]
+            code_mask = self._code_mask[tgt] * sample_mask[:, None]
+            points = self._points_arr[tgt]
+            self._dev["syn0"], self._dev["syn1"] = step(
+                self._dev["syn0"], self._dev["syn1"], src, src_mask,
+                points, codes, code_mask, np.float32(lr),
+            )
+        if self.negative > 0:
+            step = self._kernels.neg_step(S, self.negative)
+            negs = self.lookup.sample_negatives(
+                self._rng, (len(tgt), self.negative)
+            ).astype(np.int32)
+            self._dev["syn0"], self._dev["syn1neg"] = step(
+                self._dev["syn0"], self._dev["syn1neg"], src, src_mask,
+                tgt, negs, sample_mask, np.float32(lr),
+            )
+
+    def _sync_tables(self):
+        if hasattr(self, "_dev"):
+            self.lookup.syn0 = np.asarray(self._dev["syn0"])
+            if self.use_hs:
+                self.lookup.syn1 = np.asarray(self._dev["syn1"])
+            if self.negative > 0:
+                self.lookup.syn1neg = np.asarray(self._dev["syn1neg"])
+            del self._dev
+
+    # --------------------------------------------------------------- queries
+    def get_word_vector(self, word: str):
+        return self.lookup.vector(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.lookup.similarity(a, b)
+
+    def words_nearest(self, word, top_n: int = 10) -> List[str]:
+        return self.lookup.words_nearest(word, top_n)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)

@@ -1,0 +1,230 @@
+"""NLP stack tests (reference suites: Word2VecTests, ParagraphVectorsTest,
+GloveTest, tokenizer/vocab tests — deeplearning4j-nlp src/test)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    LabelledDocument,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    Sequence,
+    SequenceVectors,
+    VocabCache,
+    VocabConstructor,
+    VocabWord,
+    Word2Vec,
+    load_txt_vectors,
+    read_binary_model,
+    read_sequence_vectors,
+    write_binary_model,
+    write_sequence_vectors,
+    write_word_vectors,
+)
+
+
+def _corpus(n_repeat=40):
+    """Toy corpus with strong structure: day names co-occur, color names
+    co-occur — embeddings must separate the clusters."""
+    sents = [
+        "monday tuesday wednesday thursday friday",
+        "tuesday monday thursday friday wednesday",
+        "red green blue yellow purple",
+        "green red yellow blue purple",
+        "monday wednesday friday tuesday thursday",
+        "blue purple red green yellow",
+    ]
+    return sents * n_repeat
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        toks = DefaultTokenizerFactory().create("Hello World foo").get_tokens()
+        assert toks == ["Hello", "World", "foo"]
+
+    def test_common_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        assert tf.create("Hello, World!  123").get_tokens() == ["hello", "world"]
+
+    def test_ngram(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestSentenceIterators:
+    def test_collection_iterator(self):
+        it = CollectionSentenceIterator(["one", "two"])
+        assert list(it) == ["one", "two"]
+        assert list(it) == ["one", "two"]  # reset works
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("line one\nline two\nline three\n")
+        it = BasicLineIterator(str(p))
+        assert list(it) == ["line one", "line two", "line three"]
+
+
+class TestVocabAndHuffman:
+    def test_vocab_constructor_min_freq(self):
+        seqs = [["a", "a", "a", "b", "b", "c"]]
+        cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+        assert cache.contains_word("a") and cache.contains_word("b")
+        assert not cache.contains_word("c")
+        assert cache.word_frequency("a") == 3
+        assert cache.index_of("a") == 0  # frequency-sorted
+
+    def test_huffman_codes(self):
+        words = [VocabWord(w, c) for w, c in
+                 [("the", 100), ("of", 60), ("and", 40), ("cat", 10), ("dog", 5)]]
+        for i, w in enumerate(words):
+            w.index = i
+        Huffman(words).build()
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in words]
+        for i, a in enumerate(codes):
+            for j, b in enumerate(codes):
+                if i != j:
+                    assert not b.startswith(a)
+        # frequent words get shorter codes
+        assert len(words[0].codes) <= len(words[-1].codes)
+        # points index inner nodes < n-1
+        for w in words:
+            assert all(0 <= p < len(words) - 1 for p in w.points)
+            assert len(w.points) == len(w.codes)
+
+
+class TestSequenceVectors:
+    @pytest.mark.parametrize("mode", ["hs", "neg"])
+    def test_skipgram_clusters(self, mode):
+        vec = SequenceVectors(
+            layer_size=24, window=3, epochs=8, seed=1, batch_size=256,
+            learning_rate=0.05,
+            use_hs=(mode == "hs"), negative=0 if mode == "hs" else 5,
+        )
+        seqs = [s.split() for s in _corpus()]
+        vec.fit(seqs)
+        # within-cluster similarity beats across-cluster
+        same = vec.similarity("monday", "tuesday")
+        cross = vec.similarity("monday", "red")
+        assert same > cross, (same, cross)
+        nearest = vec.words_nearest("monday", top_n=4)
+        day_hits = sum(w in {"tuesday", "wednesday", "thursday", "friday"} for w in nearest)
+        assert day_hits >= 3, nearest
+
+    def test_cbow(self):
+        vec = SequenceVectors(
+            layer_size=24, window=3, epochs=10, seed=1, batch_size=128,
+            elements_algo="cbow", use_hs=True, learning_rate=0.05,
+        )
+        vec.fit([s.split() for s in _corpus()])
+        assert vec.similarity("red", "green") > vec.similarity("red", "monday")
+
+
+class TestWord2Vec:
+    def test_fit_sentences_and_queries(self):
+        w2v = Word2Vec(layer_size=24, window=3, epochs=8, seed=1,
+                       negative=5, use_hs=False, batch_size=256,
+                       learning_rate=0.05, min_word_frequency=2)
+        w2v.fit_sentences(_corpus())
+        assert w2v.has_word("monday")
+        v = w2v.get_word_vector("monday")
+        assert v.shape == (24,)
+        assert w2v.similarity("monday", "monday") == pytest.approx(1.0, abs=1e-5)
+        assert w2v.similarity("blue", "yellow") > w2v.similarity("blue", "friday")
+
+    def test_stop_words(self):
+        w2v = Word2Vec(layer_size=8, epochs=1, stop_words={"the"})
+        w2v.fit_sentences(["the cat sat the mat down here now"] * 5)
+        assert not w2v.has_word("the")
+        assert w2v.has_word("cat")
+
+
+class TestParagraphVectors:
+    def test_dbow_label_prediction(self):
+        docs = []
+        for i in range(30):
+            docs.append(LabelledDocument(
+                "monday tuesday wednesday thursday friday", ["DAYS"]))
+            docs.append(LabelledDocument("red green blue yellow purple", ["COLORS"]))
+        pv = ParagraphVectors(layer_size=24, window=3, epochs=6, seed=1,
+                              use_hs=True, sequence_algo="dbow", batch_size=256,
+                              learning_rate=0.05)
+        pv.fit_documents(docs)
+        assert pv.get_label_vector("DAYS") is not None
+        assert pv.predict("wednesday friday monday") == "DAYS"
+        assert pv.predict("green purple blue") == "COLORS"
+
+    def test_dm_runs(self):
+        docs = [LabelledDocument("a b c d e", ["L1"]),
+                LabelledDocument("f g h i j", ["L2"])] * 10
+        pv = ParagraphVectors(layer_size=8, window=2, epochs=2, seed=1,
+                              sequence_algo="dm", use_hs=True, batch_size=64)
+        pv.fit_documents(docs)
+        assert pv.get_label_vector("L1").shape == (8,)
+
+    def test_infer_vector_near_label(self):
+        docs = [LabelledDocument("monday tuesday wednesday thursday friday", ["DAYS"]),
+                LabelledDocument("red green blue yellow purple", ["COLORS"])] * 20
+        pv = ParagraphVectors(layer_size=16, window=3, epochs=6, seed=1,
+                              use_hs=True, sequence_algo="dbow", batch_size=128,
+                              learning_rate=0.05)
+        pv.fit_documents(docs)
+        assert pv.similarity_to_label("tuesday thursday", "DAYS") > \
+            pv.similarity_to_label("tuesday thursday", "COLORS")
+
+
+class TestGlove:
+    def test_glove_clusters(self):
+        glove = Glove(layer_size=16, window=4, epochs=40, seed=1,
+                      learning_rate=0.05, batch_size=512)
+        glove.fit(_corpus())
+        assert glove.similarity("monday", "tuesday") > glove.similarity("monday", "blue")
+        assert glove.get_word_vector("red").shape == (16,)
+
+
+class TestSerialization:
+    def _small_model(self):
+        vec = SequenceVectors(layer_size=8, window=2, epochs=2, seed=1,
+                              use_hs=True, negative=0, batch_size=64)
+        vec.fit([s.split() for s in _corpus(5)])
+        return vec
+
+    def test_c_text_roundtrip(self, tmp_path):
+        vec = self._small_model()
+        path = str(tmp_path / "vecs.txt")
+        write_word_vectors(vec.lookup, path)
+        loaded = load_txt_vectors(path)
+        assert loaded.vocab.num_words() == vec.vocab.num_words()
+        np.testing.assert_allclose(
+            loaded.vector("monday"), vec.get_word_vector("monday"), atol=1e-5
+        )
+
+    def test_c_binary_roundtrip(self, tmp_path):
+        vec = self._small_model()
+        path = str(tmp_path / "vecs.bin")
+        write_binary_model(vec.lookup, path)
+        loaded = read_binary_model(path)
+        np.testing.assert_allclose(
+            loaded.vector("red"), vec.get_word_vector("red"), atol=1e-6
+        )
+
+    def test_zip_roundtrip_resumable(self, tmp_path):
+        vec = self._small_model()
+        path = str(tmp_path / "model.zip")
+        write_sequence_vectors(vec, path)
+        loaded = read_sequence_vectors(path)
+        np.testing.assert_array_equal(loaded.lookup.syn0, vec.lookup.syn0)
+        np.testing.assert_array_equal(loaded.lookup.syn1, vec.lookup.syn1)
+        # training can continue on the restored model
+        loaded.fit([s.split() for s in _corpus(2)])
+        assert loaded.similarity("monday", "tuesday") is not None
