@@ -1,0 +1,7 @@
+"""Clustering + spatial indexes (reference: deeplearning4j-core clustering/ —
+SURVEY.md §2.2)."""
+
+from .kmeans import KMeansClustering
+from .trees import KDTree, VPTree, QuadTree, SPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "QuadTree", "SPTree"]

@@ -1,0 +1,100 @@
+"""KMeans clustering.
+
+Reference: deeplearning4j-core clustering/kmeans/KMeansClustering.java (+ the
+cluster/ClusterSet machinery). TPU-native: kmeans++ seeding on host, Lloyd
+iterations as ONE jitted step — distance matrix [N,K] and the one-hot
+centroid update are both MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-6,
+                 seed: int = 0, distance: str = "euclidean"):
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"unsupported distance '{distance}'")
+        self.k = int(k)
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.distance = distance
+        self.cluster_centers_: Optional[np.ndarray] = None
+        self.labels_: Optional[np.ndarray] = None
+        self.inertia_: float = float("nan")
+
+    def _init_centers(self, x: np.ndarray) -> np.ndarray:
+        """kmeans++ seeding."""
+        rng = np.random.default_rng(self.seed)
+        n = x.shape[0]
+        centers = [x[rng.integers(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((x[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1), axis=1
+            )
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(x[rng.choice(n, p=probs)])
+        return np.asarray(centers)
+
+    def fit(self, points) -> "KMeansClustering":
+        import jax
+        import jax.numpy as jnp
+
+        x = np.asarray(points, np.float32)
+        if x.shape[0] < self.k:
+            raise ValueError(f"need >= k={self.k} points, got {x.shape[0]}")
+        centers = jnp.asarray(self._init_centers(x), jnp.float32)
+        xd = jnp.asarray(x)
+
+        if self.distance == "cosine":
+            xn = xd / jnp.maximum(jnp.linalg.norm(xd, axis=1, keepdims=True), 1e-12)
+
+        def step(centers):
+            if self.distance == "euclidean":
+                # ||x-c||² expanded: the xc term is one [N,K] matmul
+                d = (
+                    jnp.sum(xd * xd, 1)[:, None]
+                    - 2.0 * xd @ centers.T
+                    + jnp.sum(centers * centers, 1)[None]
+                )
+            else:
+                cn = centers / jnp.maximum(
+                    jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12
+                )
+                d = 1.0 - xn @ cn.T
+            assign = jnp.argmin(d, axis=1)
+            onehot = jax.nn.one_hot(assign, self.k, dtype=xd.dtype)  # [N, K]
+            sums = onehot.T @ xd  # [K, D] — MXU
+            counts = onehot.sum(0)[:, None]
+            new_centers = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), centers)
+            inertia = jnp.sum(jnp.min(d, axis=1))
+            return new_centers, assign, inertia
+
+        jstep = jax.jit(step)
+        prev_inertia = np.inf
+        for _ in range(self.max_iterations):
+            centers, assign, inertia = jstep(centers)
+            inertia = float(inertia)
+            if abs(prev_inertia - inertia) < self.tol * max(abs(prev_inertia), 1.0):
+                break
+            prev_inertia = inertia
+        self.cluster_centers_ = np.asarray(centers)
+        self.labels_ = np.asarray(assign)
+        self.inertia_ = inertia
+        return self
+
+    def predict(self, points) -> np.ndarray:
+        x = np.asarray(points, np.float32)
+        if self.distance == "euclidean":
+            d = ((x[:, None, :] - self.cluster_centers_[None]) ** 2).sum(-1)
+        else:
+            xn = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+            cn = self.cluster_centers_ / np.maximum(
+                np.linalg.norm(self.cluster_centers_, axis=1, keepdims=True), 1e-12
+            )
+            d = 1.0 - xn @ cn.T
+        return d.argmin(1)

@@ -1,0 +1,6 @@
+"""Visualization models (reference: deeplearning4j-core plot/ — Tsne.java,
+BarnesHutTsne.java)."""
+
+from .tsne import Tsne, BarnesHutTsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

@@ -1,0 +1,198 @@
+"""t-SNE: exact (device) + Barnes-Hut (SPTree-accelerated).
+
+Reference: deeplearning4j-core plot/Tsne.java (exact) and
+plot/BarnesHutTsne.java:64 (theta-approximation as a `Model`). TPU-native
+split: the exact O(N²) variant runs entirely on device — pairwise affinities,
+gradient and momentum update in ONE jitted step (N² elementwise + two matmuls
+is exactly what the MXU/VPU want); Barnes-Hut keeps the reference's
+O(N log N) tree traversal on host for large N.
+
+Both share the perplexity binary search (vectorized over all rows at once,
+replacing the reference's per-row loop in Tsne.hBeta).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _binary_search_perplexity(d2: np.ndarray, perplexity: float,
+                              tol: float = 1e-5, max_iter: int = 50) -> np.ndarray:
+    """Row-wise beta search so each row's entropy == log(perplexity).
+    d2: [N, M] squared distances (self excluded / inf). Returns P [N, M]."""
+    n = d2.shape[0]
+    beta = np.ones(n)
+    beta_min = np.full(n, -np.inf)
+    beta_max = np.full(n, np.inf)
+    log_u = np.log(perplexity)
+    p = np.zeros_like(d2)
+    finite = np.isfinite(d2)
+    d2f = np.where(finite, d2, 0.0)  # excluded entries get p=0 via the mask
+    for _ in range(max_iter):
+        p = np.exp(-d2f * beta[:, None]) * finite
+        sum_p = np.maximum(p.sum(1), 1e-12)
+        h = np.log(sum_p) + beta * (d2f * p).sum(1) / sum_p
+        diff = h - log_u
+        done = np.abs(diff) < tol
+        if done.all():
+            break
+        hi = diff > 0  # entropy too high -> increase beta
+        beta_min = np.where(hi, beta, beta_min)
+        beta_max = np.where(~hi, beta, beta_max)
+        beta = np.where(
+            hi,
+            np.where(np.isinf(beta_max), beta * 2, (beta + beta_max) / 2),
+            np.where(np.isinf(beta_min), beta / 2, (beta + beta_min) / 2),
+        )
+    return p / np.maximum(p.sum(1, keepdims=True), 1e-12)
+
+
+class Tsne:
+    """Exact t-SNE (reference: plot/Tsne.java — Builder: maxIter, perplexity,
+    learningRate, momentum switch at iteration 250, early exaggeration)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 max_iter: int = 500, learning_rate: float = 200.0,
+                 initial_momentum: float = 0.5, final_momentum: float = 0.8,
+                 momentum_switch: int = 250, early_exaggeration: float = 12.0,
+                 stop_lying_iteration: int = 100, seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.momentum_switch = momentum_switch
+        self.early_exaggeration = early_exaggeration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.seed = seed
+        self.embedding_: Optional[np.ndarray] = None
+
+    def _joint_p(self, x: np.ndarray) -> np.ndarray:
+        d2 = ((x[:, None, :] - x[None]) ** 2).sum(-1)
+        np.fill_diagonal(d2, np.inf)
+        p = _binary_search_perplexity(d2, self.perplexity)
+        p = (p + p.T) / (2 * p.shape[0])
+        return np.maximum(p, 1e-12)
+
+    def fit_transform(self, x) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        p_np = self._joint_p(x)
+        rng = np.random.default_rng(self.seed)
+        y = jnp.asarray(rng.normal(scale=1e-4, size=(n, self.n_components)))
+        vel = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        p_dev = jnp.asarray(p_np)
+
+        def step(y, vel, gains, p, momentum):
+            d2 = jnp.sum((y[:, None, :] - y[None]) ** 2, -1)
+            num = 1.0 / (1.0 + d2)
+            num = num.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+            q = jnp.maximum(num / jnp.sum(num), 1e-12)
+            pq = (p - q) * num  # [N, N]
+            grad = 4.0 * (jnp.diag(pq.sum(1)) - pq) @ y  # matmul — MXU
+            gains = jnp.where(jnp.sign(grad) != jnp.sign(vel),
+                              gains + 0.2, gains * 0.8)
+            gains = jnp.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            return y - y.mean(0), vel, gains
+
+        jstep = jax.jit(step)
+        for it in range(self.max_iter):
+            momentum = (
+                self.initial_momentum if it < self.momentum_switch
+                else self.final_momentum
+            )
+            p_iter = (
+                p_dev * self.early_exaggeration if it < self.stop_lying_iteration
+                else p_dev
+            )
+            y, vel, gains = jstep(y, vel, gains, p_iter, momentum)
+        self.embedding_ = np.asarray(y)
+        return self.embedding_
+
+
+class BarnesHutTsne(Tsne):
+    """Barnes-Hut t-SNE (reference: plot/BarnesHutTsne.java — theta-approx,
+    VPTree kNN input similarities, SPTree repulsive forces)."""
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        kwargs.setdefault("max_iter", 300)
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def _knn_p(self, x: np.ndarray) -> tuple:
+        from ..clustering.trees import VPTree
+
+        n = x.shape[0]
+        k = min(int(3 * self.perplexity) + 1, n - 1)
+        tree = VPTree(x)
+        rows, cols, d2 = [], [], np.zeros((n, k))
+        neighbor_idx = np.zeros((n, k), int)
+        for i in range(n):
+            nbrs = [t for t in tree.knn(x[i], k + 1) if t[0] != i][:k]
+            neighbor_idx[i] = [t[0] for t in nbrs]
+            d2[i] = [t[1] ** 2 for t in nbrs]
+        p = _binary_search_perplexity(d2, self.perplexity)
+        return neighbor_idx, p
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        if n - 1 <= int(3 * self.perplexity):
+            # too small for the sparse approximation; exact is cheap here
+            return super().fit_transform(x)
+        from ..clustering.trees import SPTree
+
+        neighbor_idx, p_cond = self._knn_p(x)
+        # symmetrize the sparse P
+        p_sym: dict = {}
+        for i in range(n):
+            for jpos, j in enumerate(neighbor_idx[i]):
+                key = (min(i, j), max(i, j))
+                p_sym[key] = p_sym.get(key, 0.0) + p_cond[i, jpos]
+        pairs = np.array(list(p_sym.keys()), int)
+        pvals = np.array(list(p_sym.values())) / (2 * n)
+        pvals = np.maximum(pvals, 1e-12)
+
+        rng = np.random.default_rng(self.seed)
+        y = rng.normal(scale=1e-4, size=(n, self.n_components))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+
+        for it in range(self.max_iter):
+            exag = self.early_exaggeration if it < self.stop_lying_iteration else 1.0
+            momentum = (
+                self.initial_momentum if it < self.momentum_switch
+                else self.final_momentum
+            )
+            # attractive (sparse, vectorized over edges)
+            diff = y[pairs[:, 0]] - y[pairs[:, 1]]
+            w = 1.0 / (1.0 + (diff**2).sum(1))
+            f = (exag * pvals * w)[:, None] * diff
+            attr = np.zeros_like(y)
+            np.add.at(attr, pairs[:, 0], f)
+            np.add.at(attr, pairs[:, 1], -f)
+            # repulsive via SPTree
+            tree = SPTree(y)
+            rep = np.zeros_like(y)
+            z_total = 0.0
+            for i in range(n):
+                neg, z = tree.compute_non_edge_forces(i, self.theta)
+                rep[i] = neg
+                z_total += z
+            grad = attr - rep / max(z_total, 1e-12)
+            gains = np.where(np.sign(grad) != np.sign(vel), gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y -= y.mean(0)
+        self.embedding_ = y
+        return y

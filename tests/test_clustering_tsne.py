@@ -1,0 +1,130 @@
+"""Clustering + t-SNE tests (reference suites under deeplearning4j-core:
+clustering/, plot/)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, QuadTree, SPTree, VPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(n_per=40, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[5.0] * d, [-5.0] * d, [5.0] * (d // 2) + [-5.0] * (d - d // 2)])
+    pts = np.concatenate([c + rng.normal(size=(n_per, d)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, labels = _blobs()
+        km = KMeansClustering(k=3, seed=1).fit(x)
+        assert km.cluster_centers_.shape == (3, 4)
+        # purity: each true cluster maps to one dominant predicted cluster
+        purity = 0
+        for c in range(3):
+            counts = np.bincount(km.labels_[labels == c], minlength=3)
+            purity += counts.max()
+        assert purity / len(labels) > 0.95
+        # predict consistent with fit labels
+        np.testing.assert_array_equal(km.predict(x), km.labels_)
+
+    def test_cosine_distance(self):
+        x, _ = _blobs()
+        km = KMeansClustering(k=3, distance="cosine", seed=1).fit(x)
+        assert np.isfinite(km.inertia_)
+
+    def test_k_too_large(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(k=10).fit(np.zeros((3, 2)))
+
+
+class TestTrees:
+    def test_kdtree_knn_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(200, 3))
+        tree = KDTree(pts)
+        q = rng.normal(size=3)
+        got = [i for i, _ in tree.knn(q, 5)]
+        want = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert set(got) == set(want)
+        nn_idx, nn_d = tree.nn(q)
+        assert nn_idx == want[0]
+        assert nn_d == pytest.approx(np.linalg.norm(pts[want[0]] - q))
+
+    def test_vptree_knn_matches_bruteforce(self):
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(150, 4))
+        tree = VPTree(pts)
+        q = rng.normal(size=4)
+        got = [i for i, _ in tree.knn(q, 7)]
+        want = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+        assert set(got) == set(want)
+
+    def test_vptree_cosine(self):
+        rng = np.random.default_rng(2)
+        pts = rng.normal(size=(80, 5))
+        tree = VPTree(pts, distance="cosine")
+        q = pts[3] * 2.0  # same direction as point 3
+        assert tree.knn(q, 1)[0][0] == 3
+
+    def test_sptree_center_of_mass(self):
+        pts = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+        tree = SPTree(pts)
+        assert tree.root.n_points == 4
+        np.testing.assert_allclose(tree.root.com, [0.5, 0.5])
+
+    def test_sptree_repulsion_approximates_exact(self):
+        rng = np.random.default_rng(3)
+        y = rng.normal(size=(60, 2))
+        tree = SPTree(y)
+        # exact repulsive force for point 0
+        diff = y[0] - y[1:]
+        q = 1.0 / (1.0 + (diff**2).sum(1))
+        exact = (q[:, None] * q[:, None] * diff).sum(0)
+        z_exact = q.sum()
+        neg, z = tree.compute_non_edge_forces(0, theta=0.2)
+        np.testing.assert_allclose(z, z_exact, rtol=0.1)
+        np.testing.assert_allclose(neg, exact, rtol=0.25, atol=0.02)
+
+    def test_quadtree_requires_2d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3)))
+
+    def test_sptree_duplicate_points(self):
+        pts = np.array([[1.0, 1.0]] * 5 + [[0.0, 0.0]])
+        tree = SPTree(pts)
+        assert tree.root.n_points == 6
+
+
+class TestTsne:
+    def test_exact_separates_blobs(self):
+        x, labels = _blobs(n_per=25)
+        ts = Tsne(perplexity=10, max_iter=250, seed=2)
+        y = ts.fit_transform(x)
+        assert y.shape == (75, 2)
+        # cluster separation in embedding: centroid distances >> intra spread
+        cents = np.array([y[labels == c].mean(0) for c in range(3)])
+        intra = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                    for c in range(3))
+        inter = min(np.linalg.norm(cents[a] - cents[b])
+                    for a in range(3) for b in range(a + 1, 3))
+        assert inter > 2 * intra, (inter, intra)
+
+    def test_barnes_hut_separates_blobs(self):
+        x, labels = _blobs(n_per=30)
+        ts = BarnesHutTsne(theta=0.5, perplexity=10, max_iter=250, seed=2)
+        y = ts.fit_transform(x)
+        assert y.shape == (90, 2)
+        cents = np.array([y[labels == c].mean(0) for c in range(3)])
+        intra = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                    for c in range(3))
+        inter = min(np.linalg.norm(cents[a] - cents[b])
+                    for a in range(3) for b in range(a + 1, 3))
+        assert inter > 2 * intra, (inter, intra)
+
+    def test_barnes_hut_small_n_falls_back(self):
+        x = np.random.default_rng(0).normal(size=(12, 3))
+        y = BarnesHutTsne(perplexity=5, max_iter=50).fit_transform(x)
+        assert y.shape == (12, 2)
