@@ -1,0 +1,135 @@
+"""Graph package tests (reference: deeplearning4j-graph src/test — DeepWalk,
+random walk, loader tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph_embeddings import (
+    DeepWalk,
+    EXCEPTION_ON_DISCONNECTED,
+    Graph,
+    GraphHuffman,
+    GraphVectors,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    generate_walks,
+    load_adjacency_list,
+    load_undirected_graph_edge_list,
+    load_weighted_edge_list,
+)
+
+
+def _two_cliques(k=5):
+    """Two k-cliques joined by one bridge edge — classic DeepWalk test shape."""
+    g = Graph(2 * k)
+    for a in range(k):
+        for b in range(a + 1, k):
+            g.add_edge(a, b)
+            g.add_edge(k + a, k + b)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+class TestGraph:
+    def test_edges_and_degree(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, directed=True)
+        assert g.get_connected_vertex_indices(0) == [1]
+        assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+        assert g.get_connected_vertex_indices(2) == []  # directed: no back edge
+        assert g.get_vertex_degree(1) == 2
+        with pytest.raises(ValueError):
+            g.add_edge(0, 9)
+
+    def test_loaders(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2\n")
+        g = load_undirected_graph_edge_list(str(p), 3)
+        assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+
+        pw = tmp_path / "weighted.txt"
+        pw.write_text("0 1 2.5\n1 2 0.5\n")
+        gw = load_weighted_edge_list(str(pw), 3)
+        assert gw.get_edges_out(0)[0].weight == 2.5
+
+        pa = tmp_path / "adj.txt"
+        pa.write_text("0 1 2\n1 0\n2 0\n")
+        ga = load_adjacency_list(str(pa))
+        assert ga.num_vertices() == 3
+        assert set(ga.get_connected_vertex_indices(0)) == {1, 2}
+
+
+class TestWalks:
+    def test_walk_properties(self):
+        g = _two_cliques()
+        it = RandomWalkIterator(g, walk_length=8, seed=1)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()  # one walk per start vertex
+        assert sorted(w[0] for w in walks) == list(range(10))
+        for w in walks:
+            assert len(w) == 8
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.get_connected_vertex_indices(a)
+
+    def test_disconnected_handling(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        # vertex 2 isolated: self-loop mode keeps walking in place
+        walks = list(RandomWalkIterator(g, walk_length=4, seed=0))
+        w2 = next(w for w in walks if w[0] == 2)
+        assert w2 == [2, 2, 2, 2]
+        with pytest.raises(RuntimeError):
+            list(RandomWalkIterator(g, 4, no_edge_handling=EXCEPTION_ON_DISCONNECTED))
+
+    def test_weighted_walk_bias(self):
+        g = Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.01)
+        counts = {1: 0, 2: 0}
+        it = WeightedRandomWalkIterator(g, walk_length=2, seed=3)
+        for _ in range(50):
+            it.reset()
+            for w in it:
+                if w[0] == 0:
+                    counts[w[1]] += 1
+        assert counts[1] > 40  # overwhelmingly to the heavy edge
+
+    def test_generate_walks_multi_pass(self):
+        g = _two_cliques()
+        walks = generate_walks(g, walk_length=5, walks_per_vertex=3, seed=0)
+        assert len(walks) == 3 * g.num_vertices()
+
+
+class TestGraphHuffman:
+    def test_degree_tree(self):
+        g = _two_cliques()
+        h = GraphHuffman.from_graph(g)
+        assert len(h.words) == g.num_vertices()
+        # bridge endpoints (highest degree) get the shortest codes
+        code_lens = {int(w.word): len(w.codes) for w in h.words}
+        assert code_lens[0] <= max(code_lens.values())
+
+
+class TestDeepWalk:
+    def test_clique_structure_recovered(self):
+        g = _two_cliques()
+        dw = DeepWalk(vector_size=16, window=3, walk_length=20,
+                      walks_per_vertex=8, epochs=3, learning_rate=0.05,
+                      batch_size=256, seed=1)
+        gv = dw.fit(g)
+        assert gv.num_vertices() == 10
+        # same-clique similarity should dominate cross-clique
+        same = np.mean([gv.similarity(1, j) for j in range(2, 5)])
+        cross = np.mean([gv.similarity(1, j) for j in range(6, 10)])
+        assert same > cross, (same, cross)
+        nearest = gv.vertices_nearest(2, top_n=4)
+        assert sum(v < 5 for v in nearest) >= 3, nearest
+
+    def test_graphvectors_save_load(self, tmp_path):
+        g = _two_cliques()
+        gv = GraphVectors(g, np.random.default_rng(0).normal(size=(10, 8)))
+        path = str(tmp_path / "gv")
+        gv.save(path)
+        loaded = GraphVectors.load(path, g)
+        np.testing.assert_allclose(loaded.vectors, gv.vectors)
