@@ -1,0 +1,30 @@
+"""Keras model import (reference: deeplearning4j-modelimport, SURVEY.md §2.7).
+
+TPU-native re-design: the reference reads Keras 1.x HDF5 archives through
+JavaCPP HDF5 bindings (modelimport/.../Hdf5Archive.java) and translates layer
+configs into DL4J confs (KerasModel.java:59, KerasSequentialModel.java:138).
+Here the archive is read with h5py and translated into our dataclass configs;
+weights land directly in param pytrees (no flat-vector copy step).
+"""
+
+from .hdf5 import Hdf5Archive
+from .keras import (
+    KerasImportError,
+    import_keras_model_and_weights,
+    import_keras_model_config,
+    import_keras_sequential_config,
+    import_keras_sequential_model_and_weights,
+)
+from .trained_models import TrainedModels, imagenet_labels, vgg16_configuration
+
+__all__ = [
+    "Hdf5Archive",
+    "KerasImportError",
+    "import_keras_model_and_weights",
+    "import_keras_model_config",
+    "import_keras_sequential_config",
+    "import_keras_sequential_model_and_weights",
+    "TrainedModels",
+    "vgg16_configuration",
+    "imagenet_labels",
+]

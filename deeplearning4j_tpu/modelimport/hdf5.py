@@ -1,0 +1,140 @@
+"""HDF5 archive access (reference: modelimport/.../Hdf5Archive.java).
+
+The reference wraps JavaCPP HDF5 (native dependency #2, SURVEY.md §2.9); the
+TPU-native build uses h5py, gated so the rest of the framework imports without
+it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _require_h5py():
+    try:
+        import h5py  # noqa: PLC0415
+    except ImportError as e:  # pragma: no cover - h5py is in the image
+        raise ImportError(
+            "h5py is required for Keras model import (reference parity: "
+            "Hdf5Archive.java)"
+        ) from e
+    return h5py
+
+
+def _decode(v: Any) -> Any:
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    if isinstance(v, np.ndarray) and v.dtype.kind in ("S", "O"):
+        return [_decode(x) for x in v.tolist()]
+    return v
+
+
+class Hdf5Archive:
+    """Read-only view of a Keras HDF5 archive.
+
+    Mirrors the query surface of the reference's ``Hdf5Archive``:
+    attributes-as-JSON, group listing, dataset reads — but returns numpy
+    arrays ready to drop into JAX pytrees.
+    """
+
+    def __init__(self, path: str):
+        h5py = _require_h5py()
+        self._f = h5py.File(path, "r")
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "Hdf5Archive":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- queries -------------------------------------------------------
+    def has_attribute(self, name: str, *groups: str) -> bool:
+        return name in self._group(*groups).attrs
+
+    def read_attribute_as_string(self, name: str, *groups: str) -> str:
+        return _decode(self._group(*groups).attrs[name])
+
+    def read_attribute_as_json(self, name: str, *groups: str) -> Any:
+        return json.loads(self.read_attribute_as_string(name, *groups))
+
+    def read_string_list_attribute(self, name: str, *groups: str) -> List[str]:
+        return [_decode(x) for x in self._group(*groups).attrs[name]]
+
+    def get_groups(self, *groups: str) -> List[str]:
+        import h5py  # noqa: PLC0415
+
+        g = self._group(*groups)
+        return [k for k in g.keys() if isinstance(g[k], h5py.Group)]
+
+    def get_data_sets(self, *groups: str) -> List[str]:
+        import h5py  # noqa: PLC0415
+
+        g = self._group(*groups)
+        return [k for k in g.keys() if isinstance(g[k], h5py.Dataset)]
+
+    def read_data_set(self, name: str, *groups: str) -> np.ndarray:
+        return np.asarray(self._group(*groups)[name])
+
+    def _group(self, *groups: str):
+        g = self._f
+        for name in groups:
+            g = g[name]
+        return g
+
+
+def read_layer_weights(path: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Read every layer's weights: {layer_name: {weight_name: array}}.
+
+    Handles both archive flavors the reference handles: full-model saves
+    (weights under ``/model_weights``) and weights-only saves (layers at the
+    root), each carrying ``layer_names`` / per-layer ``weight_names`` attrs.
+    """
+    h5py = _require_h5py()
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        layer_names: Optional[List[str]] = None
+        if "layer_names" in root.attrs:
+            layer_names = [_decode(x) for x in root.attrs["layer_names"]]
+        else:
+            layer_names = [k for k in root.keys() if isinstance(root[k], h5py.Group)]
+        for ln in layer_names:
+            g = root[ln]
+            if "weight_names" in g.attrs:
+                weight_names = [_decode(x) for x in g.attrs["weight_names"]]
+            else:
+                weight_names = list(g.keys())
+            weights = {}
+            for wn in weight_names:
+                node = g[wn]
+                if isinstance(node, h5py.Group):  # keras2 nested "{layer}/{var}:0"
+                    for sub in node.keys():
+                        weights[f"{wn}/{sub}"] = np.asarray(node[sub])
+                else:
+                    weights[wn] = np.asarray(node)
+            out[ln] = weights
+    return out
+
+
+def read_model_config(path: str) -> Optional[dict]:
+    """Read the ``model_config`` JSON attribute of a full-model save."""
+    h5py = _require_h5py()
+    with h5py.File(path, "r") as f:
+        if "model_config" not in f.attrs:
+            return None
+        return json.loads(_decode(f.attrs["model_config"]))
+
+
+def read_training_config(path: str) -> Optional[dict]:
+    h5py = _require_h5py()
+    with h5py.File(path, "r") as f:
+        if "training_config" not in f.attrs:
+            return None
+        return json.loads(_decode(f.attrs["training_config"]))

@@ -1,0 +1,45 @@
+"""Load any supported model artifact by sniffing (reference: util/ModelGuesser.java).
+
+The reference tries MultiLayerNetwork, then ComputationGraph, then bare conf
+JSON. Here we additionally recognize Keras HDF5 archives (modelimport tier).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+from typing import Any
+
+
+def guess_model(path: str) -> Any:
+    """Return a model (MultiLayerNetwork/ComputationGraph) or a configuration.
+
+    Order: our zip checkpoint → Keras HDF5 → conf JSON (MultiLayer then
+    ComputationGraph) — mirrors ModelGuesser.loadModelGuess.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+
+    if zipfile.is_zipfile(path):
+        from .serialization import restore_model  # noqa: PLC0415
+
+        return restore_model(path)
+
+    with open(path, "rb") as f:
+        magic = f.read(8)
+    if magic.startswith(b"\x89HDF\r\n\x1a\n"):
+        from ..modelimport.keras import import_keras_model_and_weights  # noqa: PLC0415
+
+        return import_keras_model_and_weights(path, enforce_training_config=False)
+
+    # conf JSON
+    with open(path) as f:
+        text = f.read()
+    d = json.loads(text)
+    from ..nn.conf.computation_graph import ComputationGraphConfiguration  # noqa: PLC0415
+    from ..nn.conf.multi_layer import MultiLayerConfiguration  # noqa: PLC0415
+
+    if "vertices" in d:
+        return ComputationGraphConfiguration.from_dict(d)
+    return MultiLayerConfiguration.from_dict(d)

@@ -1,0 +1,368 @@
+"""Keras import tests (reference test strategy: modelimport HDF5 fixture
+round-trips, SURVEY.md §4.5). Fixtures are written with h5py in exactly the
+Keras 1.x save format — keras itself is not needed."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import (
+    KerasImportError,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+from deeplearning4j_tpu.modelimport.keras import (
+    import_keras_model_config,
+    import_keras_sequential_config,
+)
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, LastTimeStepLayer
+from deeplearning4j_tpu.utils.model_guesser import guess_model
+
+
+def _write_keras_h5(path, model_config, training_config, layer_weights):
+    """layer_weights: {layer_name: [(weight_name, array), ...]}"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config).encode()
+        g = f.create_group("model_weights")
+        g.attrs["layer_names"] = np.array(
+            [n.encode() for n in layer_weights], dtype="S64"
+        )
+        for lname, weights in layer_weights.items():
+            lg = g.create_group(lname)
+            lg.attrs["weight_names"] = np.array(
+                [wn.encode() for wn, _ in weights], dtype="S64"
+            )
+            for wn, arr in weights:
+                lg.create_dataset(wn, data=arr)
+
+
+def _dense_cfg(name, n_out, activation, input_shape=None):
+    cfg = {"name": name, "output_dim": n_out, "activation": activation, "bias": True}
+    if input_shape is not None:
+        cfg["batch_input_shape"] = input_shape
+    return {"class_name": "Dense", "config": cfg}
+
+
+ADAM_TC = {
+    "optimizer_config": {"class_name": "Adam", "config": {"lr": 0.002, "beta_1": 0.9}},
+    "loss": "categorical_crossentropy",
+}
+
+
+def test_sequential_mlp_import_forward_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    W1 = rng.normal(size=(5, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    W2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            _dense_cfg("dense_1", 8, "relu", input_shape=[None, 5]),
+            _dense_cfg("dense_2", 3, "softmax"),
+        ],
+    }
+    path = str(tmp_path / "mlp.h5")
+    _write_keras_h5(
+        path,
+        model_config,
+        ADAM_TC,
+        {
+            "dense_1": [("dense_1_W", W1), ("dense_1_b", b1)],
+            "dense_2": [("dense_2_W", W2), ("dense_2_b", b2)],
+        },
+    )
+
+    net = import_keras_sequential_model_and_weights(path)
+    assert isinstance(net.conf.layers[-1], OutputLayer)
+    assert net.conf.layers[-1].loss == "mcxent"
+    assert net.conf.updater.updater == "adam"
+    assert net.conf.updater.learning_rate == pytest.approx(0.002)
+
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    h = np.maximum(x @ W1 + b1, 0.0)
+    z = h @ W2 + b2
+    expect = np.exp(z - z.max(-1, keepdims=True))
+    expect /= expect.sum(-1, keepdims=True)
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_sequential_cnn_th_ordering_transposes_kernel(tmp_path):
+    rng = np.random.default_rng(1)
+    # keras 'th' conv weights: (out, in, kh, kw)
+    Wc = rng.normal(size=(2, 1, 3, 3)).astype(np.float32)
+    bc = np.zeros((2,), dtype=np.float32)
+    Wd = rng.normal(size=(2 * 3 * 3, 4)).astype(np.float32)
+    bd = np.zeros((4,), dtype=np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {
+                "class_name": "Convolution2D",
+                "config": {
+                    "name": "conv1", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
+                    "subsample": [1, 1], "border_mode": "valid",
+                    "dim_ordering": "th", "activation": "relu",
+                    "batch_input_shape": [None, 1, 8, 8], "bias": True,
+                },
+            },
+            {
+                "class_name": "MaxPooling2D",
+                "config": {"name": "pool1", "pool_size": [2, 2], "strides": [2, 2],
+                           "border_mode": "valid", "dim_ordering": "th"},
+            },
+            {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+            _dense_cfg("dense_1", 4, "softmax"),
+        ],
+    }
+    path = str(tmp_path / "cnn.h5")
+    _write_keras_h5(
+        path,
+        model_config,
+        ADAM_TC,
+        {
+            "conv1": [("conv1_W", Wc), ("conv1_b", bc)],
+            "pool1": [],
+            "flatten_1": [],
+            "dense_1": [("dense_1_W", Wd), ("dense_1_b", bd)],
+        },
+    )
+    net = import_keras_sequential_model_and_weights(path)
+    # HWIO kernel must equal the OIHW source transposed
+    np.testing.assert_allclose(
+        np.asarray(net.params[0]["W"]), np.transpose(Wc, (2, 3, 1, 0))
+    )
+    out = net.output(np.zeros((2, 8, 8, 1), dtype=np.float32))
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_lstm_import_gate_concatenation(tmp_path):
+    rng = np.random.default_rng(2)
+    n_in, H = 4, 3
+    gates = {}
+    for g in ("i", "c", "f", "o"):
+        gates[f"W_{g}"] = rng.normal(size=(n_in, H)).astype(np.float32)
+        gates[f"U_{g}"] = rng.normal(size=(H, H)).astype(np.float32)
+        gates[f"b_{g}"] = rng.normal(size=(H,)).astype(np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {
+                "class_name": "LSTM",
+                "config": {
+                    "name": "lstm_1", "output_dim": H, "activation": "tanh",
+                    "inner_activation": "hard_sigmoid",
+                    "return_sequences": False,
+                    "batch_input_shape": [None, 6, n_in],
+                },
+            },
+            _dense_cfg("dense_1", 2, "softmax"),
+        ],
+    }
+    path = str(tmp_path / "lstm.h5")
+    _write_keras_h5(
+        path,
+        model_config,
+        ADAM_TC,
+        {
+            "lstm_1": [(f"lstm_1_{k}", v) for k, v in gates.items()],
+            "dense_1": [
+                ("dense_1_W", rng.normal(size=(H, 2)).astype(np.float32)),
+                ("dense_1_b", np.zeros(2, dtype=np.float32)),
+            ],
+        },
+    )
+    net = import_keras_sequential_model_and_weights(path)
+    assert isinstance(net.conf.layers[0], GravesLSTM)
+    assert isinstance(net.conf.layers[1], LastTimeStepLayer)
+    W = np.asarray(net.params[0]["W"])
+    # our gate column order [a(=keras c), f, o, i]
+    np.testing.assert_allclose(W[:, 0:H], gates["W_c"])
+    np.testing.assert_allclose(W[:, H : 2 * H], gates["W_f"])
+    np.testing.assert_allclose(W[:, 2 * H : 3 * H], gates["W_o"])
+    np.testing.assert_allclose(W[:, 3 * H :], gates["W_i"])
+    np.testing.assert_allclose(np.asarray(net.params[0]["pF"]), 0.0)
+    out = net.output(np.zeros((2, 6, n_in), dtype=np.float32))
+    assert out.shape == (2, 2)
+
+
+def test_batchnorm_running_stats_land_in_state(tmp_path):
+    n = 5
+    gamma = np.full(n, 2.0, np.float32)
+    beta = np.full(n, -1.0, np.float32)
+    mean = np.arange(n, dtype=np.float32)
+    var = np.full(n, 4.0, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            _dense_cfg("dense_1", n, "linear", input_shape=[None, n]),
+            {
+                "class_name": "BatchNormalization",
+                "config": {"name": "bn_1", "epsilon": 1e-3, "mode": 0, "momentum": 0.9},
+            },
+        ],
+    }
+    path = str(tmp_path / "bn.h5")
+    _write_keras_h5(
+        path,
+        model_config,
+        None,
+        {
+            "dense_1": [
+                ("dense_1_W", np.eye(n, dtype=np.float32)),
+                ("dense_1_b", np.zeros(n, np.float32)),
+            ],
+            "bn_1": [
+                ("bn_1_gamma", gamma),
+                ("bn_1_beta", beta),
+                ("bn_1_running_mean", mean),
+                ("bn_1_running_std", var),
+            ],
+        },
+    )
+    net = import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.params[1]["gamma"]), gamma)
+    np.testing.assert_allclose(np.asarray(net.state[1]["mean"]), mean)
+    np.testing.assert_allclose(np.asarray(net.state[1]["var"]), var)
+    # inference uses the imported moving stats
+    x = np.tile(mean, (3, 1)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, np.tile(beta, (3, 1)), atol=1e-2)
+
+
+def test_functional_model_with_merge(tmp_path):
+    rng = np.random.default_rng(3)
+    Wa = rng.normal(size=(4, 6)).astype(np.float32)
+    Wb = rng.normal(size=(4, 6)).astype(np.float32)
+    Wo = rng.normal(size=(6, 2)).astype(np.float32)
+    mk = lambda n: np.zeros(n, np.float32)  # noqa: E731
+    model_config = {
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {
+                    "class_name": "InputLayer", "name": "input_1",
+                    "config": {"name": "input_1", "batch_input_shape": [None, 4]},
+                    "inbound_nodes": [],
+                },
+                {
+                    "class_name": "Dense", "name": "branch_a",
+                    "config": {"name": "branch_a", "output_dim": 6, "activation": "relu", "bias": True},
+                    "inbound_nodes": [[["input_1", 0, 0]]],
+                },
+                {
+                    "class_name": "Dense", "name": "branch_b",
+                    "config": {"name": "branch_b", "output_dim": 6, "activation": "relu", "bias": True},
+                    "inbound_nodes": [[["input_1", 0, 0]]],
+                },
+                {
+                    "class_name": "Merge", "name": "merge_1",
+                    "config": {"name": "merge_1", "mode": "sum"},
+                    "inbound_nodes": [[["branch_a", 0, 0], ["branch_b", 0, 0]]],
+                },
+                {
+                    "class_name": "Dense", "name": "out",
+                    "config": {"name": "out", "output_dim": 2, "activation": "softmax", "bias": True},
+                    "inbound_nodes": [[["merge_1", 0, 0]]],
+                },
+            ],
+            "input_layers": [["input_1", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    }
+    path = str(tmp_path / "graph.h5")
+    _write_keras_h5(
+        path,
+        model_config,
+        None,
+        {
+            "branch_a": [("branch_a_W", Wa), ("branch_a_b", mk(6))],
+            "branch_b": [("branch_b_W", Wb), ("branch_b_b", mk(6))],
+            "out": [("out_W", Wo), ("out_b", mk(2))],
+        },
+    )
+    net = import_keras_model_and_weights(path)
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    h = np.maximum(x @ Wa, 0) + np.maximum(x @ Wb, 0)
+    z = h @ Wo
+    expect = np.exp(z - z.max(-1, keepdims=True))
+    expect /= expect.sum(-1, keepdims=True)
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_layer_raises():
+    with pytest.raises(KerasImportError):
+        import_keras_sequential_config(
+            {
+                "class_name": "Sequential",
+                "config": [{"class_name": "Lambda", "config": {"name": "l"}}],
+            }
+        )
+
+
+def test_config_only_import_no_weights():
+    conf, names = import_keras_sequential_config(
+        {
+            "class_name": "Sequential",
+            "config": [
+                _dense_cfg("d1", 16, "relu", input_shape=[None, 10]),
+                {"class_name": "Dropout", "config": {"name": "do", "p": 0.25}},
+                _dense_cfg("d2", 2, "softmax"),
+            ],
+        },
+        ADAM_TC,
+    )
+    assert isinstance(conf.layers[0], DenseLayer)
+    assert conf.layers[1].dropout == pytest.approx(0.25)
+    assert isinstance(conf.layers[-1], OutputLayer)
+    assert names[0] == "d1"
+
+
+def test_model_guesser_roundtrip(tmp_path):
+    # our own checkpoint zip
+    from deeplearning4j_tpu import (
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer as OL,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.utils.serialization import write_model
+
+    conf = MultiLayerConfiguration(
+        layers=[OL(n_out=3, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(4),
+        updater=UpdaterConfig(),
+    )
+    net = MultiLayerNetwork(conf).init()
+    zpath = str(tmp_path / "model.zip")
+    write_model(net, zpath)
+    restored = guess_model(zpath)
+    assert type(restored).__name__ == "MultiLayerNetwork"
+
+    # conf json
+    jpath = str(tmp_path / "conf.json")
+    with open(jpath, "w") as f:
+        f.write(conf.to_json())
+    conf2 = guess_model(jpath)
+    assert type(conf2).__name__ == "MultiLayerConfiguration"
+
+
+def test_vgg16_configuration_shapes():
+    from deeplearning4j_tpu.modelimport import vgg16_configuration
+
+    conf = vgg16_configuration()
+    types = conf.layer_input_types()
+    # input to the first dense layer: 7x7x512 flattened
+    dense_idx = len(conf.layers) - 3
+    assert types[dense_idx].kind == "ff"
+    assert types[dense_idx].size == 7 * 7 * 512
+    assert conf.output_type().size == 1000
