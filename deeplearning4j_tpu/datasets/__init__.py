@@ -1,0 +1,68 @@
+"""Datasets tier: DataSet/iterators, record readers, fetchers, normalizers."""
+
+from .iterators import (
+    AsyncDataSetIterator,
+    DataSet,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    IteratorDataSetIterator,
+    ListDataSetIterator,
+    MultiDataSet,
+    MultipleEpochsIterator,
+    NumpyDataSetIterator,
+    SamplingDataSetIterator,
+)
+from .records import (
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReader,
+    SequenceRecordReader,
+)
+from .record_iterators import (
+    ALIGN_END,
+    ALIGN_START,
+    EQUAL_LENGTH,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from .fetchers import (
+    CifarDataSetIterator,
+    CurvesDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+    MnistDataSetIterator,
+    load_cifar10,
+    load_iris,
+    load_mnist,
+    read_idx,
+)
+from .normalizers import (
+    DataNormalization,
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    NormalizingIterator,
+)
+
+__all__ = [
+    "AsyncDataSetIterator", "DataSet", "DataSetIterator",
+    "ExistingDataSetIterator", "IteratorDataSetIterator",
+    "ListDataSetIterator", "MultiDataSet", "MultipleEpochsIterator",
+    "NumpyDataSetIterator", "SamplingDataSetIterator",
+    "CollectionRecordReader", "CollectionSequenceRecordReader",
+    "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",
+    "LineRecordReader", "RecordReader", "SequenceRecordReader",
+    "ALIGN_END", "ALIGN_START", "EQUAL_LENGTH",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
+    "CifarDataSetIterator", "CurvesDataSetIterator", "IrisDataSetIterator",
+    "LFWDataSetIterator", "MnistDataSetIterator",
+    "load_cifar10", "load_iris", "load_mnist", "read_idx",
+    "DataNormalization", "ImagePreProcessingScaler",
+    "NormalizerMinMaxScaler", "NormalizerStandardize", "NormalizingIterator",
+]

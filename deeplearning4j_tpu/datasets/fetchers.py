@@ -1,0 +1,238 @@
+"""Dataset fetchers/iterators (reference: deeplearning4j-core datasets tier).
+
+MNIST (IDX-format reader — reference: datasets/mnist/MnistManager.java +
+base/MnistFetcher.java), Iris (IrisDataSetIterator), CIFAR-10 (binary-format
+reader — CifarDataSetIterator), LFW (LFWDataSetIterator over an image tree)
+and Curves.
+
+This build has zero network egress, so the download step of the reference's
+fetchers becomes: read from a local directory (``*_DIR`` env var or
+constructor arg). When no local copy exists the fetchers synthesize a
+deterministic, class-separable stand-in of identical shape — tests and
+examples stay hermetic, while real data drops in transparently on machines
+that have it.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .iterators import DataSet, DataSetIterator, NumpyDataSetIterator
+
+
+# ---------------------------------------------------------------------------
+# MNIST — IDX format
+# ---------------------------------------------------------------------------
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Read an IDX file (optionally .gz) — reference: MnistManager readers."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError(f"{path}: bad IDX magic")
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                  0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+        dt = np.dtype(dtypes[dtype_code]).newbyteorder(">")
+        data = np.frombuffer(f.read(), dtype=dt)
+    return data.reshape(dims)
+
+
+def _find_idx(root: str, names: List[str]) -> Optional[str]:
+    for n in names:
+        for cand in (os.path.join(root, n), os.path.join(root, n + ".gz")):
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def _synthetic_classification(n: int, n_features: int, n_classes: int,
+                              seed: int, image_hw: Optional[Tuple[int, int]] = None):
+    """Deterministic separable stand-in: class template + noise."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(size=(n_classes, n_features)).astype(np.float32)
+    y = rng.integers(0, n_classes, size=n)
+    x = templates[y] + 0.3 * rng.normal(size=(n, n_features)).astype(np.float32)
+    x = (x - x.min()) / (x.max() - x.min())
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def load_mnist(train: bool = True, root: Optional[str] = None):
+    """(images [N,784] float32 in [0,1], labels [N] int) — real if present."""
+    root = root or os.environ.get("MNIST_DIR", os.path.expanduser("~/.dl4j-tpu/mnist"))
+    img_names = (["train-images-idx3-ubyte", "train-images.idx3-ubyte"] if train
+                 else ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"])
+    lab_names = (["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"] if train
+                 else ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])
+    if os.path.isdir(root):
+        ip, lp = _find_idx(root, img_names), _find_idx(root, lab_names)
+        if ip and lp:
+            images = read_idx(ip).reshape(-1, 784).astype(np.float32) / 255.0
+            labels = read_idx(lp).astype(np.int64)
+            return images, labels
+    n = 4096 if train else 1024
+    return _synthetic_classification(n, 784, 10, seed=0 if train else 1)
+
+
+class MnistDataSetIterator(NumpyDataSetIterator):
+    """reference: datasets/iterator/impl/MnistDataSetIterator.java:30"""
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 123, root: Optional[str] = None,
+                 num_examples: Optional[int] = None):
+        x, y = load_mnist(train=train, root=root)
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        labels = np.eye(10, dtype=np.float32)[y]
+        super().__init__(x, labels, batch, shuffle=shuffle, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Iris
+# ---------------------------------------------------------------------------
+
+
+def load_iris():
+    """Real Fisher Iris via sklearn's bundled copy (no egress), else synthetic."""
+    try:
+        from sklearn.datasets import load_iris as _sk_iris  # noqa: PLC0415
+
+        d = _sk_iris()
+        return d.data.astype(np.float32), d.target.astype(np.int64)
+    except ImportError:
+        x, y = _synthetic_classification(150, 4, 3, seed=7)
+        return x, y
+
+
+class IrisDataSetIterator(NumpyDataSetIterator):
+    """reference: datasets/iterator/impl/IrisDataSetIterator.java"""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150,
+                 shuffle: bool = False, seed: int = 123):
+        x, y = load_iris()
+        x, y = x[:num_examples], y[:num_examples]
+        labels = np.eye(3, dtype=np.float32)[y]
+        super().__init__(x, labels, batch, drop_last=False, shuffle=shuffle, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 — binary batch format
+# ---------------------------------------------------------------------------
+
+
+def load_cifar10(train: bool = True, root: Optional[str] = None):
+    """(images [N,32,32,3] float32 in [0,1], labels [N]) — real if present.
+
+    Binary format (reference: CifarDataSetIterator backing loader): each
+    record is 1 label byte + 3072 pixel bytes, channel-planar RGB.
+    """
+    root = root or os.environ.get("CIFAR_DIR", os.path.expanduser("~/.dl4j-tpu/cifar10"))
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    paths = [os.path.join(root, n) for n in names]
+    # also look inside the standard extracted dir name
+    sub = os.path.join(root, "cifar-10-batches-bin")
+    if not all(os.path.exists(p) for p in paths) and os.path.isdir(sub):
+        paths = [os.path.join(sub, n) for n in names]
+    if all(os.path.exists(p) for p in paths):
+        xs, ys = [], []
+        for p in paths:
+            raw = np.fromfile(p, dtype=np.uint8).reshape(-1, 3073)
+            ys.append(raw[:, 0].astype(np.int64))
+            xs.append(
+                raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            )
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        return x, np.concatenate(ys)
+    n = 2048 if train else 512
+    x, y = _synthetic_classification(n, 32 * 32 * 3, 10, seed=2 if train else 3)
+    return x.reshape(-1, 32, 32, 3), y
+
+
+class CifarDataSetIterator(NumpyDataSetIterator):
+    """reference: CifarDataSetIterator.java (NHWC here — TPU-native layout)."""
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 123, root: Optional[str] = None,
+                 num_examples: Optional[int] = None):
+        x, y = load_cifar10(train=train, root=root)
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        labels = np.eye(10, dtype=np.float32)[y]
+        super().__init__(x, labels, batch, shuffle=shuffle, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# LFW — faces from an image tree
+# ---------------------------------------------------------------------------
+
+
+class LFWDataSetIterator(DataSetIterator):
+    """Labelled Faces in the Wild (reference: LFWDataSetIterator.java).
+
+    Reads ``root/<person>/<image>`` via ImageRecordReader when a local copy
+    exists; otherwise synthesizes ``num_labels`` separable image classes.
+    """
+
+    def __init__(self, batch: int, height: int = 64, width: int = 64,
+                 channels: int = 3, root: Optional[str] = None,
+                 num_labels: int = 10, examples_per_label: int = 8, seed: int = 5):
+        self.batch = int(batch)
+        root = root or os.environ.get("LFW_DIR", os.path.expanduser("~/.dl4j-tpu/lfw"))
+        if os.path.isdir(root) and any(
+            os.path.isdir(os.path.join(root, d)) for d in os.listdir(root)
+        ):
+            from .records import ImageRecordReader  # noqa: PLC0415
+
+            reader = ImageRecordReader(height, width, channels, root=root)
+            self._labels = reader.labels
+            n = len(self._labels)
+            feats, ys = [], []
+            for rec in reader:
+                feats.append(np.asarray(rec[:-1], np.float32).reshape(height, width, channels) / 255.0)
+                ys.append(int(rec[-1]))
+            self._x = np.stack(feats)
+            self._y = np.eye(n, dtype=np.float32)[np.asarray(ys)]
+        else:
+            n = num_labels
+            x, y = _synthetic_classification(
+                num_labels * examples_per_label, height * width * channels, n, seed
+            )
+            self._labels = [f"person_{i}" for i in range(n)]
+            self._x = x.reshape(-1, height, width, channels)
+            self._y = np.eye(n, dtype=np.float32)[y]
+
+    @property
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        for s in range(0, len(self._x) - self.batch + 1, self.batch):
+            yield DataSet(self._x[s : s + self.batch], self._y[s : s + self.batch])
+
+
+# ---------------------------------------------------------------------------
+# Curves — deterministic function-fitting set (reference: CurvesDataSetIterator)
+# ---------------------------------------------------------------------------
+
+
+class CurvesDataSetIterator(NumpyDataSetIterator):
+    """Sampled parametric curves for autoencoder pretraining demos."""
+
+    def __init__(self, batch: int, n: int = 1024, dim: int = 784, seed: int = 11):
+        rng = np.random.default_rng(seed)
+        t = np.linspace(0, 1, dim, dtype=np.float32)
+        phase = rng.uniform(0, 2 * np.pi, size=(n, 1)).astype(np.float32)
+        freq = rng.uniform(1.0, 4.0, size=(n, 1)).astype(np.float32)
+        x = 0.5 + 0.5 * np.sin(2 * np.pi * freq * t[None, :] + phase)
+        super().__init__(x.astype(np.float32), x.astype(np.float32), batch)

@@ -1,0 +1,164 @@
+"""Data normalizers (reference: the ND4J normalizer surface the iterators
+consume — SURVEY.md §2.9 "DataSet/MultiDataSet/iterators, normalizers").
+
+``fit(iterator)`` accumulates statistics host-side in one streaming pass
+(Chan et al. parallel-merge for mean/var so it works batch-by-batch), then
+``transform``/``preprocess`` is a cheap vectorized numpy op applied before
+the device transfer. Serializable so a checkpointed model can ship its
+normalizer, like the reference's NormalizerSerializer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from .iterators import DataSet, DataSetIterator
+
+
+class DataNormalization:
+    """SPI: fit(iterator) → transform(DataSet) (reference: ND4J DataNormalization)."""
+
+    def fit(self, data) -> "DataNormalization":
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def preprocess(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    # -- persistence ----------------------------------------------------
+    def to_json(self) -> str:
+        d = {k: v.tolist() if isinstance(v, np.ndarray) else v
+             for k, v in self.__dict__.items()}
+        d["@type"] = type(self).__name__
+        return json.dumps(d)
+
+    @staticmethod
+    def from_json(s: str) -> "DataNormalization":
+        d = json.loads(s)
+        cls = {c.__name__: c for c in (
+            NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler
+        )}[d.pop("@type")]
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            setattr(obj, k, np.asarray(v, np.float64) if isinstance(v, list) else v)
+        return obj
+
+
+def _batches(data):
+    if isinstance(data, DataSet):
+        return [data]
+    return data
+
+
+class NormalizerStandardize(DataNormalization):
+    """Zero-mean unit-variance per feature (reference: NormalizerStandardize)."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data) -> "NormalizerStandardize":
+        count, mean, m2 = 0, None, None
+        for ds in _batches(data):
+            x = ds.features.reshape(ds.features.shape[0], -1).astype(np.float64)
+            b_count = x.shape[0]
+            b_mean = x.mean(axis=0)
+            b_m2 = ((x - b_mean) ** 2).sum(axis=0)
+            if mean is None:
+                count, mean, m2 = b_count, b_mean, b_m2
+            else:  # Chan parallel merge
+                delta = b_mean - mean
+                tot = count + b_count
+                mean = mean + delta * (b_count / tot)
+                m2 = m2 + b_m2 + delta**2 * (count * b_count / tot)
+                count = tot
+        if mean is None:
+            raise ValueError("fit() saw no data")
+        self.mean = mean
+        self.std = np.sqrt(np.maximum(m2 / max(count, 1), 1e-12))
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        x = ds.features.reshape(shape[0], -1)
+        x = (x - self.mean) / self.std
+        return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        x = ds.features.reshape(shape[0], -1) * self.std + self.mean
+        return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+
+class NormalizerMinMaxScaler(DataNormalization):
+    """Scale features to [lo, hi] (reference: NormalizerMinMaxScaler)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.min: Optional[np.ndarray] = None
+        self.max: Optional[np.ndarray] = None
+
+    def fit(self, data) -> "NormalizerMinMaxScaler":
+        mn = mx = None
+        for ds in _batches(data):
+            x = ds.features.reshape(ds.features.shape[0], -1).astype(np.float64)
+            b_mn, b_mx = x.min(axis=0), x.max(axis=0)
+            mn = b_mn if mn is None else np.minimum(mn, b_mn)
+            mx = b_mx if mx is None else np.maximum(mx, b_mx)
+        if mn is None:
+            raise ValueError("fit() saw no data")
+        self.min, self.max = mn, mx
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        x = ds.features.reshape(shape[0], -1)
+        rng = np.maximum(self.max - self.min, 1e-12)
+        x = (x - self.min) / rng * (self.hi - self.lo) + self.lo
+        return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+
+class ImagePreProcessingScaler(DataNormalization):
+    """Pixel scaling [0,255] → [lo,hi] without a fit pass (reference:
+    ImagePreProcessingScaler)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0, max_pixel: float = 255.0):
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.max_pixel = float(max_pixel)
+
+    def fit(self, data) -> "ImagePreProcessingScaler":
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        x = ds.features / self.max_pixel * (self.hi - self.lo) + self.lo
+        return DataSet(x.astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+
+class NormalizingIterator(DataSetIterator):
+    """Wrap an iterator so every batch passes through a normalizer (the
+    reference attaches normalizers via DataSetIterator.setPreProcessor)."""
+
+    def __init__(self, base: DataSetIterator, normalizer: DataNormalization):
+        self.base = base
+        self.normalizer = normalizer
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        for ds in self.base:
+            yield self.normalizer.transform(ds)

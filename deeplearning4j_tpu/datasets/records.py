@@ -1,0 +1,190 @@
+"""Record readers — the DataVec-equivalent ingest tier.
+
+The reference consumes DataVec ``RecordReader``s (CSV/image/sequence) through
+``RecordReaderDataSetIterator`` (deeplearning4j-core/.../datasets/datavec/
+RecordReaderDataSetIterator.java — "the main real-data ingest path",
+SURVEY.md §2.2). DataVec itself is out of tree, so this module provides the
+reader SPI natively: a record is a list of python/numpy values; readers are
+restartable iterators over records. Batch assembly into device-ready arrays
+happens in :mod:`record_iterators` (and in native C++ for the hot CSV path —
+see runtime/).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Record = List[object]
+
+
+class RecordReader:
+    """Restartable stream of records (reference SPI: DataVec RecordReader)."""
+
+    def __iter__(self) -> Iterator[Record]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    @property
+    def labels(self) -> Optional[List[str]]:
+        """Class-label vocabulary, when the reader defines one (images)."""
+        return None
+
+
+class CollectionRecordReader(RecordReader):
+    """Iterate pre-built records (reference: CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Record]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class LineRecordReader(RecordReader):
+    """One record per line of text (reference: LineRecordReader)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path) as f:
+            for line in f:
+                yield [line.rstrip("\n")]
+
+
+class CSVRecordReader(RecordReader):
+    """CSV rows → records (reference: CSVRecordReader).
+
+    Values parse to float when possible, else stay strings — matching the
+    reference's Writable coercion at iterator time.
+    """
+
+    def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ","):
+        self.path = path
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        with open(self.path, newline="") as f:
+            reader = csv.reader(f, delimiter=self.delimiter)
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [_coerce(v) for v in row]
+
+
+def _coerce(v: str):
+    try:
+        return float(v)
+    except ValueError:
+        return v.strip()
+
+
+class SequenceRecordReader(RecordReader):
+    """Stream of sequences: each item is a list of records (time steps)."""
+
+    def __iter__(self) -> Iterator[List[Record]]:  # type: ignore[override]
+        raise NotImplementedError
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """Pre-built sequences (reference: CollectionSequenceRecordReader)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Record]]):
+        self._seqs = [[list(r) for r in seq] for seq in sequences]
+
+    def __iter__(self):
+        return iter(self._seqs)
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One CSV file per sequence (reference: CSVSequenceRecordReader).
+
+    ``paths`` may be a directory (files sorted by name) or an explicit list.
+    """
+
+    def __init__(self, paths, skip_lines: int = 0, delimiter: str = ","):
+        if isinstance(paths, str):
+            self.paths = [
+                os.path.join(paths, p) for p in sorted(os.listdir(paths))
+            ]
+        else:
+            self.paths = list(paths)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for p in self.paths:
+            yield list(CSVRecordReader(p, self.skip_lines, self.delimiter))
+
+
+class ImageRecordReader(RecordReader):
+    """Images under label directories → [flat pixels..., label_idx] records.
+
+    Reference: DataVec ImageRecordReader + ParentPathLabelGenerator. Decoding
+    uses PIL when present; `.npy` arrays always work (the hermetic path).
+    Output layout is HWC float32 in [0, 255] — normalization is the
+    normalizer tier's job, exactly as in the reference.
+    """
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 root: Optional[str] = None, paths: Optional[Sequence[str]] = None,
+                 append_label: bool = True):
+        self.height, self.width, self.channels = height, width, channels
+        self.append_label = append_label
+        if root is not None:
+            self._labels = sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))
+            )
+            self._files = [
+                (os.path.join(root, lab, f), i)
+                for i, lab in enumerate(self._labels)
+                for f in sorted(os.listdir(os.path.join(root, lab)))
+            ]
+        elif paths is not None:
+            self._labels = []
+            self._files = [(p, -1) for p in paths]
+        else:
+            raise ValueError("ImageRecordReader needs root= or paths=")
+
+    @property
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def _load(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            arr = np.load(path)
+        else:
+            try:
+                from PIL import Image  # noqa: PLC0415
+            except ImportError as e:
+                raise ImportError(
+                    f"PIL required to decode {path}; use .npy images otherwise"
+                ) from e
+            img = Image.open(path)
+            img = img.convert("L" if self.channels == 1 else "RGB")
+            img = img.resize((self.width, self.height))
+            arr = np.asarray(img)
+        arr = np.asarray(arr, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.shape != (self.height, self.width, self.channels):
+            raise ValueError(
+                f"{path}: shape {arr.shape} != "
+                f"{(self.height, self.width, self.channels)}"
+            )
+        return arr
+
+    def __iter__(self):
+        for path, label in self._files:
+            rec: Record = list(self._load(path).reshape(-1))
+            if self.append_label and label >= 0:
+                rec.append(float(label))
+            yield rec

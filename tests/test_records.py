@@ -1,0 +1,186 @@
+"""Record reader / fetcher / normalizer tests (reference strategy: DataVec
+bridge tests under deeplearning4j-core datasets/datavec, SURVEY.md §2.2)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ALIGN_END,
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    NormalizingIterator,
+    NumpyDataSetIterator,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+    load_cifar10,
+    read_idx,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+
+
+def test_csv_record_reader_classification(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("# header\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,0\n")
+    reader = CSVRecordReader(str(p), skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch=2, label_index=2, num_classes=3)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(batches[0].labels, [[1, 0, 0], [0, 1, 0]])
+    # reset + re-iterate gives same data
+    it.reset()
+    again = list(it)
+    np.testing.assert_allclose(again[0].features, batches[0].features)
+
+
+def test_record_reader_regression_multi_column():
+    recs = [[0.1, 0.2, 1.5, 2.5], [0.3, 0.4, 3.5, 4.5]]
+    it = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), batch=2, label_index=2, label_index_to=3
+    )
+    ds = next(iter(it))
+    np.testing.assert_allclose(ds.features, [[0.1, 0.2], [0.3, 0.4]])
+    np.testing.assert_allclose(ds.labels, [[1.5, 2.5], [3.5, 4.5]])
+
+
+def test_sequence_reader_align_end_masks():
+    feats = CollectionSequenceRecordReader(
+        [[[1.0], [2.0], [3.0]], [[4.0], [5.0]]]
+    )
+    labels = CollectionSequenceRecordReader([[[0]], [[1]]])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch=2, labels_reader=labels, num_classes=2, alignment=ALIGN_END
+    )
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 3, 1)
+    assert ds.labels.shape == (2, 3, 2)
+    # labels align to the END of each sequence
+    np.testing.assert_allclose(ds.labels_mask, [[0, 0, 1], [0, 0, 1]])
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [0, 1, 1]])
+    np.testing.assert_allclose(ds.labels[0, 2], [1, 0])
+    np.testing.assert_allclose(ds.features[1, 0, 0], 0.0)  # padded (align end)
+
+
+def test_sequence_equal_length_mismatch_raises():
+    feats = CollectionSequenceRecordReader([[[1.0], [2.0]]])
+    labels = CollectionSequenceRecordReader([[[0]]])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch=1, labels_reader=labels, num_classes=2
+    )
+    with pytest.raises(ValueError, match="EQUAL_LENGTH"):
+        next(iter(it))
+
+
+def test_csv_sequence_reader(tmp_path):
+    for i, rows in enumerate([["1,0", "2,1"], ["3,1", "4,0", "5,1"]]):
+        (tmp_path / f"seq_{i}.csv").write_text("\n".join(rows) + "\n")
+    reader = CSVSequenceRecordReader(str(tmp_path))
+    it = SequenceRecordReaderDataSetIterator(
+        reader, batch=2, label_index=1, num_classes=2, alignment="align_start"
+    )
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 3, 1)
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 0], [1, 1, 1]])
+
+
+def test_multi_dataset_iterator_builder():
+    recs = [[0.1, 0.2, 0.9, 1.0], [0.3, 0.4, 0.8, 2.0]]
+    it = (
+        RecordReaderMultiDataSetIterator(batch=2)
+        .add_reader("r", CollectionRecordReader(recs))
+        .add_input("r", 0, 1)
+        .add_output("r", 2, 2)
+        .add_output_one_hot("r", 3, 3)
+    )
+    mds = next(iter(it))
+    assert len(mds.features) == 1 and len(mds.labels) == 2
+    np.testing.assert_allclose(mds.features[0], [[0.1, 0.2], [0.3, 0.4]])
+    np.testing.assert_allclose(mds.labels[0], [[0.9], [0.8]])
+    np.testing.assert_allclose(mds.labels[1], [[0, 1, 0], [0, 0, 1]])
+
+
+def test_image_record_reader_npy_tree(tmp_path):
+    rng = np.random.default_rng(0)
+    for label in ("cat", "dog"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(2):
+            np.save(d / f"{i}.npy", rng.integers(0, 255, (4, 4, 1)).astype(np.uint8))
+    reader = ImageRecordReader(4, 4, 1, root=str(tmp_path))
+    assert reader.labels == ["cat", "dog"]
+    recs = list(reader)
+    assert len(recs) == 4
+    assert len(recs[0]) == 17  # 16 pixels + label
+    assert recs[0][-1] == 0.0 and recs[-1][-1] == 1.0
+
+
+def test_idx_reader_roundtrip(tmp_path):
+    data = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    p = tmp_path / "x.idx3-ubyte.gz"
+    with gzip.open(p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">III", 2, 3, 4))
+        f.write(data.tobytes())
+    out = read_idx(str(p))
+    np.testing.assert_array_equal(out, data)
+
+
+def test_mnist_iterator_shapes_and_fallback():
+    it = MnistDataSetIterator(batch=32, train=True, num_examples=256)
+    ds = next(iter(it))
+    assert ds.features.shape == (32, 784)
+    assert ds.labels.shape == (32, 10)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+
+def test_iris_iterator_real_data():
+    it = IrisDataSetIterator(batch=150)
+    ds = next(iter(it))
+    assert ds.features.shape == (150, 4)
+    assert ds.labels.sum() == 150  # one-hot
+
+
+def test_cifar_loader_shapes():
+    x, y = load_cifar10(train=False)
+    assert x.shape[1:] == (32, 32, 3)
+    assert x.shape[0] == y.shape[0]
+
+
+def test_normalizer_standardize_streaming_merge():
+    rng = np.random.default_rng(0)
+    x = rng.normal(loc=3.0, scale=2.0, size=(100, 5)).astype(np.float32)
+    it = NumpyDataSetIterator(x, np.zeros((100, 1), np.float32), batch=16, drop_last=False)
+    norm = NormalizerStandardize().fit(it)
+    np.testing.assert_allclose(norm.mean, x.astype(np.float64).mean(0), atol=1e-6)
+    np.testing.assert_allclose(
+        norm.std, x.astype(np.float64).std(0), rtol=1e-6, atol=1e-6
+    )
+    out = norm.transform(DataSet(x, np.zeros((100, 1), np.float32)))
+    assert abs(out.features.mean()) < 1e-5
+    # revert round-trips
+    back = norm.revert(out)
+    np.testing.assert_allclose(back.features, x, atol=1e-4)
+    # json round-trip
+    norm2 = NormalizerStandardize.from_json(norm.to_json())
+    np.testing.assert_allclose(norm2.mean, norm.mean)
+
+
+def test_minmax_and_normalizing_iterator():
+    x = np.array([[0.0, 10.0], [5.0, 20.0], [10.0, 30.0]], np.float32)
+    it = NumpyDataSetIterator(x, np.zeros((3, 1), np.float32), batch=3, drop_last=False)
+    norm = NormalizerMinMaxScaler().fit(it)
+    wrapped = NormalizingIterator(it, norm)
+    ds = next(iter(wrapped))
+    np.testing.assert_allclose(ds.features.min(0), [0, 0])
+    np.testing.assert_allclose(ds.features.max(0), [1, 1])
