@@ -53,8 +53,7 @@ def _find_idx(root: str, names: List[str]) -> Optional[str]:
     return None
 
 
-def _synthetic_classification(n: int, n_features: int, n_classes: int,
-                              seed: int, image_hw: Optional[Tuple[int, int]] = None):
+def _synthetic_classification(n: int, n_features: int, n_classes: int, seed: int):
     """Deterministic separable stand-in: class template + noise."""
     rng = np.random.default_rng(seed)
     templates = rng.normal(size=(n_classes, n_features)).astype(np.float32)

@@ -60,20 +60,19 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
 
     def _split(self, rec) -> Tuple[np.ndarray, np.ndarray]:
-        vals = [float(v) for v in rec]
+        # one vectorized conversion — records may already be flat ndarrays
+        # (ImageRecordReader) or lists of scalars (CSV)
+        vals = np.asarray(rec, dtype=np.float32)
         if self.label_index is None:
-            f = np.asarray(vals, dtype=np.float32)
-            return f, f
+            return vals, vals
         if self.regression:
             to = self.label_index_to if self.label_index_to is not None else self.label_index
-            label = np.asarray(vals[self.label_index : to + 1], dtype=np.float32)
-            feat = np.asarray(
-                vals[: self.label_index] + vals[to + 1 :], dtype=np.float32
-            )
+            label = vals[self.label_index : to + 1]
+            feat = np.concatenate([vals[: self.label_index], vals[to + 1 :]])
             return feat, label
         label = _one_hot(int(vals[self.label_index]), self.num_classes)
-        feat = np.asarray(
-            vals[: self.label_index] + vals[self.label_index + 1 :], dtype=np.float32
+        feat = np.concatenate(
+            [vals[: self.label_index], vals[self.label_index + 1 :]]
         )
         return feat, label
 

@@ -112,7 +112,9 @@ class CSVSequenceRecordReader(SequenceRecordReader):
     def __init__(self, paths, skip_lines: int = 0, delimiter: str = ","):
         if isinstance(paths, str):
             self.paths = [
-                os.path.join(paths, p) for p in sorted(os.listdir(paths))
+                os.path.join(paths, p)
+                for p in sorted(os.listdir(paths))
+                if not p.startswith(".") and os.path.isfile(os.path.join(paths, p))
             ]
         else:
             self.paths = list(paths)
@@ -184,7 +186,9 @@ class ImageRecordReader(RecordReader):
 
     def __iter__(self):
         for path, label in self._files:
-            rec: Record = list(self._load(path).reshape(-1))
+            # flat ndarray record (not boxed python floats) — consumers
+            # vectorize over it; label rides as the trailing element
+            flat = self._load(path).reshape(-1)
             if self.append_label and label >= 0:
-                rec.append(float(label))
-            yield rec
+                flat = np.append(flat, np.float32(label))
+            yield flat

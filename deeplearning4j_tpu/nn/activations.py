@@ -79,3 +79,13 @@ _REGISTRY.update(
         "exp": jnp.exp,
     }
 )
+
+# snapshot so dispatch tiers (ops/) can tell a user override from a builtin
+_BUILTINS = dict(_REGISTRY)
+
+
+def is_builtin(name: str) -> bool:
+    """True when ``name`` still resolves to the stock implementation (no
+    register_activation override) — helper kernels key on this."""
+    key = name.lower()
+    return key in _BUILTINS and _REGISTRY.get(key) is _BUILTINS[key]

@@ -88,13 +88,9 @@ class LocalResponseNormalization(BaseLayer):
         return False
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        # window-sum of squares over the channel axis (NHWC last axis)
-        half = self.n // 2
-        sq = x * x
-        # pad channels, then a small static unrolled window sum — XLA fuses this
-        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
-        acc = jnp.zeros_like(x)
-        for i in range(self.n):
-            acc = acc + jax.lax.slice_in_dim(padded, i, i + x.shape[-1], axis=-1)
-        denom = (self.k + self.alpha * acc) ** self.beta
-        return self._activate(x / denom), state
+        # cross-channel LRN (NHWC last axis); pallas-fused on TPU, unrolled
+        # XLA window-sum otherwise (ops dispatch — SURVEY.md §2.3 helper slot)
+        from ... import ops as _ops  # noqa: PLC0415
+
+        y = _ops.lrn(x, k=self.k, n=self.n, alpha=self.alpha, beta=self.beta)
+        return self._activate(y), state

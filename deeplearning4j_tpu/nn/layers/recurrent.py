@@ -49,6 +49,8 @@ def _lstm_scan(
     gate,
     mask: Optional[jnp.ndarray],  # [B, T] or None
     reverse: bool = False,
+    act_name: Optional[str] = None,
+    gate_name: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run one LSTM direction. Returns (y [B,T,H], h_T, c_T).
 
@@ -69,17 +71,31 @@ def _lstm_scan(
     else:
         mask_t = jnp.ones((xw_t.shape[0], 1, 1), xw.dtype)
 
+    # Recurrent cell: the pallas helper tier fuses the h@RW matmul + gate
+    # chain in VMEM when the activation pair is in its catalog AND neither
+    # name has been overridden via register_activation (the cuDNN-helper
+    # slot, SURVEY.md §2.3); otherwise the same math via the layer's own
+    # activation callables.
+    from ...nn.activations import is_builtin  # noqa: PLC0415
+    from ... import ops as _ops  # noqa: PLC0415
+    from ...ops.pallas_kernels import _cell_math  # noqa: PLC0415
+
+    act_key = (act_name or "").lower()
+    gate_key = (gate_name or "").lower()
+    use_helper = (
+        act_name is not None
+        and _ops.supported_lstm_activations(act_key, gate_key)
+        and is_builtin(act_name) and is_builtin(gate_name)
+    )
+
     def step(carry, inp):
         h_prev, c_prev = carry
         zx, m = inp
-        z = zx + h_prev @ RW  # [B, 4H]
-        a = act(z[..., :H])  # block input (reference "inputActivations")
-        f = gate(z[..., H : 2 * H] + c_prev * pF)  # forget gate + wFF peephole
-        o_pre = z[..., 2 * H : 3 * H]
-        i = gate(z[..., 3 * H : 4 * H] + c_prev * pI)  # input-mod gate + wGG peephole
-        c = f * c_prev + i * a
-        o = gate(o_pre + c * pO)  # output gate sees current cell (wOO)
-        h = o * act(c)
+        if use_helper:
+            h, c = _ops.lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
+                                  act_key, gate_key)
+        else:
+            h, c, *_ = _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate)
         h = m * h + (1.0 - m) * h_prev
         c = m * c + (1.0 - m) * c_prev
         return (h, c), h
@@ -156,7 +172,8 @@ class GravesLSTM(BaseLayer):
         gate = get_activation(self.gate_activation)
         h0 = rstate["h"].astype(x.dtype)
         c0 = rstate["c"].astype(x.dtype)
-        y, h, c = _lstm_scan("", params, x, h0, c0, act, gate, mask)
+        y, h, c = _lstm_scan("", params, x, h0, c0, act, gate, mask,
+                             act_name=self.activation, gate_name=self.gate_activation)
         return y, {"h": h, "c": c}
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
@@ -193,8 +210,10 @@ class GravesBidirectionalLSTM(GravesLSTM):
         gate = get_activation(self.gate_activation)
         B, H = x.shape[0], self.n_out
         zeros = jnp.zeros((B, H), x.dtype)
-        y_f, _, _ = _lstm_scan("", params, x, zeros, zeros, act, gate, mask)
-        y_b, _, _ = _lstm_scan("bwd_", params, x, zeros, zeros, act, gate, mask, reverse=True)
+        y_f, _, _ = _lstm_scan("", params, x, zeros, zeros, act, gate, mask,
+                               act_name=self.activation, gate_name=self.gate_activation)
+        y_b, _, _ = _lstm_scan("bwd_", params, x, zeros, zeros, act, gate, mask, reverse=True,
+                               act_name=self.activation, gate_name=self.gate_activation)
         return y_f + y_b, state
 
 

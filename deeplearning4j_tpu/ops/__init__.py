@@ -1,0 +1,104 @@
+"""Op dispatch: Pallas helpers on TPU, pure-XLA math elsewhere.
+
+Mirrors the reference's helper discovery (ConvolutionLayer.java:69-79 loads
+CudnnConvolutionHelper reflectively and falls back to builtin math): here the
+"helper" is a Pallas kernel, enabled when running on TPU (or forced via the
+``DL4J_TPU_PALLAS`` env var: "1" forces on — interpret mode off-TPU, for
+testing — and "0" forces off).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .pallas_kernels import (
+    _ACT,
+    _cell_math,
+    _window_sum,
+    fused_lrn,
+    fused_lstm_cell,
+    supported_lstm_activations,
+)
+
+_FORCED: Optional[bool] = None  # set_helpers_enabled override
+
+# keep every fused-cell buffer comfortably inside ~16MB VMEM
+_CELL_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+def set_helpers_enabled(enabled: Optional[bool]) -> None:
+    """Force pallas helpers on/off (None = auto). Auto = TPU backend only."""
+    global _FORCED
+    _FORCED = enabled
+
+
+def helpers_enabled() -> bool:
+    if _FORCED is not None:
+        return _FORCED
+    env = os.environ.get("DL4J_TPU_PALLAS")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _cell_fits(B: int, H: int, itemsize: int) -> bool:
+    # zx[B,4H] + 7×[B,H] + RW[H,4H] residuals/outputs
+    return (B * 4 * H + 7 * B * H + H * 4 * H) * itemsize < _CELL_VMEM_BUDGET_BYTES
+
+
+def lstm_helper_enabled() -> bool:
+    """The fused LSTM cell is opt-in only: measured on v5e, XLA's fused
+    scan-body beats the per-step pallas_call at every VMEM-fitting shape
+    (e.g. B=128,H=256: 3.3ms vs 4.5ms/grad-step), because the custom VJP
+    must spill 7 residual arrays per step that XLA instead rematerializes.
+    Kept for parity with the reference's helper tier and as the base for
+    future multi-step fusion; force with set_helpers_enabled(True) or
+    DL4J_TPU_PALLAS=1."""
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("DL4J_TPU_PALLAS") == "1"
+
+
+def lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
+              act_name: str = "tanh", gate_name: str = "sigmoid"):
+    """One LSTM step (h, c). Pallas-fused when available, XLA otherwise."""
+    B, H = c_prev.shape
+    if (
+        lstm_helper_enabled()
+        and supported_lstm_activations(act_name, gate_name)
+        and _cell_fits(B, H, zx.dtype.itemsize)
+    ):
+        return fused_lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
+                               act_name, gate_name)
+    act = _ACT.get(act_name)
+    gate = _ACT.get(gate_name)
+    if act is not None and gate is not None:
+        h, c, *_ = _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO,
+                              act[0], gate[0])
+        return h, c
+    raise ValueError(f"Unknown LSTM activations ({act_name}, {gate_name})")
+
+
+def lrn(x, k: float = 2.0, n: int = 5, alpha: float = 1e-4, beta: float = 0.75):
+    """Cross-channel LRN over the trailing axis."""
+    if helpers_enabled():
+        return fused_lrn(x, k, n, alpha, beta)
+    d = k + alpha * _window_sum(x * x, n)
+    return x * d**-beta
+
+
+__all__ = [
+    "fused_lrn",
+    "fused_lstm_cell",
+    "helpers_enabled",
+    "lrn",
+    "lstm_cell",
+    "lstm_helper_enabled",
+    "set_helpers_enabled",
+    "supported_lstm_activations",
+]

@@ -1,0 +1,289 @@
+"""Pallas TPU kernels — the architectural slot of the reference's cuDNN helper
+tier (SURVEY.md §2.3: CudnnConvolutionHelper etc.).
+
+On TPU, XLA already *is* the fast path for conv/BN/pooling, so unlike the
+reference there is no helper needed for those. What earns hand-written kernels
+here is what XLA fuses poorly (SURVEY.md §7):
+
+- the LSTM recurrent cell: the h_{t-1}@RW matmul + 4 gate nonlinearities +
+  peephole/cell update chain, executed T times under ``lax.scan``. One fused
+  VMEM kernel per step keeps every intermediate on-chip (the reference's hot
+  loop, LSTMHelpers.java:159-179).
+- cross-channel LRN: windowed sum-of-squares + pow, a bandwidth-bound chain
+  (CudnnLocalResponseNormalizationHelper's slot).
+
+Both ops carry a custom VJP whose backward is also a fused kernel, mirroring
+the reference pattern of helpers implementing both activate and
+backpropGradient. Everything falls back to pure-XLA math off-TPU or for
+unsupported activations — the same "helper absent → builtin math" fallback as
+ConvolutionLayer.java:69-79's reflective loading.
+
+Kernels run compiled on TPU; ``interpret=True`` (CPU tests) exercises
+identical code paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# gate/activation catalog usable inside kernels, with value-derivatives
+# (derivative expressed in terms of the *activated* value, so the backward
+# kernel needs no pre-activation residuals)
+_ACT = {
+    "tanh": (jnp.tanh, lambda y: 1.0 - y * y),
+    "sigmoid": (jax.nn.sigmoid, lambda y: y * (1.0 - y)),
+    "hardsigmoid": (
+        lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+        lambda y: jnp.where((y > 0.0) & (y < 1.0), 0.2, 0.0),
+    ),
+    "relu": (jax.nn.relu, lambda y: (y > 0.0).astype(y.dtype)),
+    "identity": (lambda x: x, lambda y: jnp.ones_like(y)),
+}
+
+
+def supported_lstm_activations(act: str, gate: str) -> bool:
+    return act in _ACT and gate in _ACT
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# fused LSTM cell
+# ---------------------------------------------------------------------------
+
+
+def _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate):
+    """Shared gate math (column order [a, f, o, i] — LSTMHelpers parity)."""
+    H = c_prev.shape[-1]
+    z = zx + jnp.dot(h_prev, RW, preferred_element_type=zx.dtype)
+    a = act(z[..., :H])
+    f = gate(z[..., H : 2 * H] + c_prev * pF)
+    i = gate(z[..., 3 * H :] + c_prev * pI)
+    c = f * c_prev + i * a
+    o = gate(z[..., 2 * H : 3 * H] + c * pO)
+    cact = act(c)
+    h = o * cact
+    return h, c, a, f, o, i, cact
+
+
+def _fwd_kernel(act, gate, zx_ref, h_ref, c_ref, rw_ref, pf_ref, pi_ref,
+                po_ref, h_out, c_out, a_out, f_out, o_out, i_out, cact_out):
+    h, c, a, f, o, i, cact = _cell_math(
+        zx_ref[:], h_ref[:], c_ref[:], rw_ref[:],
+        pf_ref[:], pi_ref[:], po_ref[:], act, gate,
+    )
+    h_out[:], c_out[:] = h, c
+    a_out[:], f_out[:], o_out[:], i_out[:], cact_out[:] = a, f, o, i, cact
+
+
+def _bwd_kernel(dact, dgate, a_ref, f_ref, o_ref, i_ref, cact_ref, cprev_ref,
+                c_ref, hprev_ref, rw_ref, pf_ref, pi_ref, po_ref,
+                dh_ref, dc_ref,
+                dzx_out, dhprev_out, dcprev_out, drw_out, dpf_out, dpi_out,
+                dpo_out):
+    a, f, o, i = a_ref[:], f_ref[:], o_ref[:], i_ref[:]
+    cact, c_prev, c = cact_ref[:], cprev_ref[:], c_ref[:]
+    dh, dc = dh_ref[:], dc_ref[:]
+    pF, pI, pO = pf_ref[:], pi_ref[:], po_ref[:]
+
+    do = dh * cact * dgate(o)
+    dc_tot = dc + dh * o * dact(cact) + do * pO
+    df = dc_tot * c_prev * dgate(f)
+    di = dc_tot * a * dgate(i)
+    da = dc_tot * i * dact(a)
+    dzx = jnp.concatenate([da, df, do, di], axis=-1)
+    dcprev_out[:] = dc_tot * f + df * pF + di * pI
+    dzx_out[:] = dzx
+    dhprev_out[:] = jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
+    drw_out[:] = jnp.dot(hprev_ref[:].T, dzx, preferred_element_type=dzx.dtype)
+    dpf_out[:] = jnp.sum(df * c_prev, axis=0)
+    dpi_out[:] = jnp.sum(di * c_prev, axis=0)
+    dpo_out[:] = jnp.sum(do * c, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def fused_lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
+                    act_name: str = "tanh", gate_name: str = "sigmoid"):
+    """One LSTM step, fused in VMEM. Returns (h, c).
+
+    ``zx`` is the precomputed input projection x_t@W + b for this step
+    ([B, 4H]); the kernel performs the recurrent matmul and every gate op
+    without round-tripping intermediates through HBM.
+    """
+    h, c, *_ = _cell_fwd_impl(zx, h_prev, c_prev, RW, pF, pI, pO,
+                              act_name, gate_name)
+    return h, c
+
+
+def _cell_fwd_impl(zx, h_prev, c_prev, RW, pF, pI, pO, act_name, gate_name):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    act, _ = _ACT[act_name]
+    gate, _ = _ACT[gate_name]
+    B, H = c_prev.shape
+    dt = zx.dtype
+    shapes = [jax.ShapeDtypeStruct((B, H), dt)] * 7
+    kernel = functools.partial(_fwd_kernel, act, gate)
+    return pl.pallas_call(
+        kernel,
+        out_shape=tuple(shapes),
+        interpret=_interpret(),
+    )(zx, h_prev, c_prev, RW, pF, pI, pO)
+
+
+def _cell_fwd(zx, h_prev, c_prev, RW, pF, pI, pO, act_name, gate_name):
+    h, c, a, f, o, i, cact = _cell_fwd_impl(
+        zx, h_prev, c_prev, RW, pF, pI, pO, act_name, gate_name
+    )
+    residuals = (a, f, o, i, cact, c_prev, c, h_prev, RW, pF, pI, pO)
+    return (h, c), residuals
+
+
+def _cell_bwd(act_name, gate_name, residuals, grads):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    a, f, o, i, cact, c_prev, c, h_prev, RW, pF, pI, pO = residuals
+    dh, dc = grads
+    _, dact = _ACT[act_name]
+    _, dgate = _ACT[gate_name]
+    B, H = c_prev.shape
+    dt = dh.dtype
+    out_shape = (
+        jax.ShapeDtypeStruct((B, 4 * H), dt),   # dzx
+        jax.ShapeDtypeStruct((B, H), dt),       # dh_prev
+        jax.ShapeDtypeStruct((B, H), dt),       # dc_prev
+        jax.ShapeDtypeStruct((H, 4 * H), dt),   # dRW
+        jax.ShapeDtypeStruct((H,), dt),         # dpF
+        jax.ShapeDtypeStruct((H,), dt),         # dpI
+        jax.ShapeDtypeStruct((H,), dt),         # dpO
+    )
+    kernel = functools.partial(_bwd_kernel, dact, dgate)
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(a, f, o, i, cact, c_prev, c, h_prev, RW, pF, pI, pO, dh, dc)
+
+
+fused_lstm_cell.defvjp(_cell_fwd, _cell_bwd)
+
+
+# ---------------------------------------------------------------------------
+# LRN
+# ---------------------------------------------------------------------------
+
+
+def _window_sum(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """sum over channel window W(c) = [c - n//2, c + n - 1 - n//2]."""
+    half = n // 2
+    C = x.shape[-1]
+    padded = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    acc = jnp.zeros_like(x)
+    for j in range(n):
+        acc = acc + jax.lax.slice_in_dim(padded, j, j + C, axis=-1)
+    return acc
+
+
+def _window_sum_adjoint(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Adjoint of _window_sum: channel c receives from every j with
+    c ∈ W(j), i.e. the window offsets flip sign. Identical to _window_sum
+    for odd n (symmetric window); shifted by one for even n."""
+    lo = n - 1 - n // 2  # pad so offset range becomes [-(n-1-half), half]
+    hi = n // 2
+    C = x.shape[-1]
+    padded = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(lo, hi)])
+    acc = jnp.zeros_like(x)
+    for j in range(n):
+        acc = acc + jax.lax.slice_in_dim(padded, j, j + C, axis=-1)
+    return acc
+
+
+def _lrn_fwd_kernel(k, n, alpha, beta, x_ref, y_ref, d_ref):
+    x = x_ref[:]
+    d = k + alpha * _window_sum(x * x, n)
+    d_ref[:] = d
+    y_ref[:] = x * d**-beta
+
+
+def _lrn_bwd_kernel(k, n, alpha, beta, x_ref, d_ref, g_ref, dx_ref):
+    x, d, g = x_ref[:], d_ref[:], g_ref[:]
+    # dx_c = g_c d_c^-b - 2ab x_c * Σ_{j: c∈W(j)} g_j x_j d_j^{-b-1}
+    dx_ref[:] = g * d**-beta - 2.0 * alpha * beta * x * _window_sum_adjoint(
+        g * x * d ** (-beta - 1.0), n
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def fused_lrn(x, k: float = 2.0, n: int = 5, alpha: float = 1e-4,
+              beta: float = 0.75):
+    """Cross-channel LRN on the trailing axis, one fused VMEM pass."""
+    y, _ = _lrn_fwd_impl(x, k, n, alpha, beta)
+    return y
+
+
+def _as2d(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+# rows per grid step: keeps each VMEM block ≲1MB for typical channel counts
+_LRN_TILE_ROWS = 1024
+
+
+def _lrn_specs(rows: int, C: int, n_arrays: int):
+    """Row-tiled grid so arbitrarily large activations never exceed VMEM.
+    The channel (window) axis stays whole inside each block."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    tile = min(_LRN_TILE_ROWS, rows)
+    grid = (pl.cdiv(rows, tile),)
+    spec = pl.BlockSpec((tile, C), lambda i: (i, 0))
+    return grid, [spec] * n_arrays, spec
+
+
+def _lrn_fwd_impl(x, k, n, alpha, beta):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    x2 = _as2d(x)
+    grid, in_specs, out_spec = _lrn_specs(x2.shape[0], x2.shape[1], 1)
+    kernel = functools.partial(_lrn_fwd_kernel, k, n, alpha, beta)
+    y, d = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(out_spec, out_spec),
+        out_shape=(jax.ShapeDtypeStruct(x2.shape, x2.dtype),) * 2,
+        interpret=_interpret(),
+    )(x2)
+    return y.reshape(x.shape), d
+
+
+def _lrn_fwd(x, k, n, alpha, beta):
+    y, d = _lrn_fwd_impl(x, k, n, alpha, beta)
+    return y, (x, d)
+
+
+def _lrn_bwd(k, n, alpha, beta, residuals, g):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    x, d = residuals
+    x2, g2 = _as2d(x), _as2d(g)
+    grid, in_specs, out_spec = _lrn_specs(x2.shape[0], x2.shape[1], 3)
+    kernel = functools.partial(_lrn_bwd_kernel, k, n, alpha, beta)
+    dx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=_interpret(),
+    )(x2, d, g2)
+    return (dx.reshape(x.shape),)
+
+
+fused_lrn.defvjp(_lrn_fwd, _lrn_bwd)

@@ -1,0 +1,170 @@
+"""Pallas helper-tier tests: fused kernels (interpret mode on CPU) must match
+pure-XLA math in value AND gradient — the same role the reference's
+CuDNNGradientChecks played for its cuDNN helpers (SURVEY.md §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ops
+from deeplearning4j_tpu.ops.pallas_kernels import (
+    _ACT,
+    _cell_math,
+    _window_sum,
+    fused_lrn,
+    fused_lstm_cell,
+)
+
+
+def _cell_inputs(seed=0, B=4, H=8):
+    rng = np.random.default_rng(seed)
+    r = lambda *s: jnp.asarray(rng.normal(size=s) * 0.5, jnp.float32)  # noqa: E731
+    return (r(B, 4 * H), r(B, H), r(B, H), r(H, 4 * H), r(H), r(H), r(H))
+
+
+@pytest.mark.parametrize("act,gate", [("tanh", "sigmoid"), ("tanh", "hardsigmoid")])
+def test_fused_lstm_cell_forward_matches_xla(act, gate):
+    args = _cell_inputs()
+    h_p, c_p = fused_lstm_cell(*args, act, gate)
+    h_x, c_x, *_ = _cell_math(*args, _ACT[act][0], _ACT[gate][0])
+    np.testing.assert_allclose(np.asarray(h_p), np.asarray(h_x), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_x), atol=1e-6)
+
+
+def test_fused_lstm_cell_gradients_match_autodiff():
+    args = _cell_inputs(seed=1)
+
+    def loss_fused(*a):
+        h, c = fused_lstm_cell(*a, "tanh", "sigmoid")
+        return jnp.sum(h * h) + jnp.sum(jnp.sin(c))
+
+    def loss_xla(*a):
+        h, c, *_ = _cell_math(*a, _ACT["tanh"][0], _ACT["sigmoid"][0])
+        return jnp.sum(h * h) + jnp.sum(jnp.sin(c))
+
+    g_fused = jax.grad(loss_fused, argnums=tuple(range(7)))(*args)
+    g_xla = jax.grad(loss_xla, argnums=tuple(range(7)))(*args)
+    for gf, gx, name in zip(g_fused, g_xla,
+                            ["zx", "h_prev", "c_prev", "RW", "pF", "pI", "pO"]):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gx), atol=1e-5, err_msg=f"grad {name}"
+        )
+
+
+def test_fused_lstm_cell_under_scan_trains():
+    """The fused cell must compose with lax.scan + jit + grad (the real
+    training topology)."""
+    args = _cell_inputs(seed=2)
+    zx, h0, c0, RW, pF, pI, pO = args
+    T = 5
+    zxs = jnp.stack([zx * (t + 1) / T for t in range(T)])
+
+    @jax.jit
+    def loss(RW, pF, pI, pO):
+        def step(carry, z):
+            h, c = fused_lstm_cell(z, carry[0], carry[1], RW, pF, pI, pO,
+                                   "tanh", "sigmoid")
+            return (h, c), h
+
+        (_, _), ys = jax.lax.scan(step, (h0, c0), zxs)
+        return jnp.mean(ys**2)
+
+    g = jax.grad(loss)(RW, pF, pI, pO)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_fused_lrn_matches_xla_value_and_grad():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 3, 3, 8)), jnp.float32)
+    k, n, alpha, beta = 2.0, 5, 1e-4, 0.75
+
+    def xla_lrn(x):
+        d = k + alpha * _window_sum(x * x, n)
+        return x * d**-beta
+
+    np.testing.assert_allclose(
+        np.asarray(fused_lrn(x, k, n, alpha, beta)), np.asarray(xla_lrn(x)),
+        atol=1e-6,
+    )
+    g_p = jax.grad(lambda v: jnp.sum(jnp.cos(fused_lrn(v, k, n, alpha, beta))))(x)
+    g_x = jax.grad(lambda v: jnp.sum(jnp.cos(xla_lrn(v))))(x)
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_x), atol=1e-5)
+
+
+def test_dispatch_fallback_off_tpu_and_force_on():
+    """Auto mode on CPU uses XLA math; forcing helpers on routes through
+    pallas interpret — results identical either way."""
+    args = _cell_inputs(seed=4)
+    assert jax.default_backend() != "tpu"
+    assert not ops.helpers_enabled()
+    h_auto, c_auto = ops.lstm_cell(*args, "tanh", "sigmoid")
+    try:
+        ops.set_helpers_enabled(True)
+        assert ops.helpers_enabled()
+        h_forced, c_forced = ops.lstm_cell(*args, "tanh", "sigmoid")
+    finally:
+        ops.set_helpers_enabled(None)
+    np.testing.assert_allclose(np.asarray(h_auto), np.asarray(h_forced), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_auto), np.asarray(c_forced), atol=1e-6)
+
+
+def test_lstm_layer_end_to_end_with_helpers_forced():
+    """A GravesLSTM network trains identically (numerics within tolerance)
+    with the helper tier forced on."""
+    from deeplearning4j_tpu import (
+        GravesLSTM,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        RnnOutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=(4, 6))]
+
+    def build():
+        conf = MultiLayerConfiguration(
+            layers=[
+                GravesLSTM(n_out=8),
+                RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.recurrent(3, 6),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+            seed=0,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    net_plain = build()
+    net_plain.fit(DataSet(x, y))
+    out_plain = np.asarray(net_plain.output(x))
+
+    try:
+        ops.set_helpers_enabled(True)
+        net_helper = build()
+        net_helper.fit(DataSet(x, y))
+        out_helper = np.asarray(net_helper.output(x))
+    finally:
+        ops.set_helpers_enabled(None)
+    np.testing.assert_allclose(out_plain, out_helper, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 5])
+def test_fused_lrn_grad_even_and_odd_windows(n):
+    """Even n makes the window asymmetric; the backward must use the adjoint
+    (flipped) window, not the forward one."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(3, 8)), jnp.float32)
+    k, alpha, beta = 2.0, 1e-2, 0.75
+
+    def xla_lrn(v):
+        d = k + alpha * _window_sum(v * v, n)
+        return v * d**-beta
+
+    g_p = jax.grad(lambda v: jnp.sum(jnp.sin(fused_lrn(v, k, n, alpha, beta))))(x)
+    g_x = jax.grad(lambda v: jnp.sum(jnp.sin(xla_lrn(v))))(x)
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_x), atol=1e-5)
