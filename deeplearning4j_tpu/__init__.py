@@ -54,6 +54,7 @@ from .nn.layers.recurrent import (
     LastTimeStepLayer,
 )
 from .nn.layers.normalization import BatchNormalization, LocalResponseNormalization
+from .nn.layers.attention import LayerNormLayer, SelfAttentionLayer
 from .datasets.iterators import (
     DataSet,
     MultiDataSet,

@@ -1,0 +1,131 @@
+"""Attention layers — the long-context tier's nn surface.
+
+No counterpart exists in the reference (2016: SURVEY.md §5.7 — sequence
+handling is TBPTT + masking only); these layers extend the framework beyond
+parity per the long-context-first design requirement. The math lives in
+:mod:`deeplearning4j_tpu.parallel.ring_attention`; a layer switches between
+the local kernel and ring/all-to-all sequence parallelism purely by the mesh
+context the trainer establishes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, Params, register_layer, maybe_dropout
+
+
+@register_layer
+@dataclass
+class LayerNormLayer(BaseLayer):
+    """Per-feature LayerNorm over the trailing axis (transformer building
+    block; the reference's closest relative is BatchNormalization)."""
+
+    eps: float = 1e-5
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    @property
+    def is_recurrent(self) -> bool:
+        return False  # shape-agnostic; works on [B,F] and [B,T,F]
+
+    def init_params(self, key, input_type) -> Params:
+        n = input_type.size if input_type.kind in ("ff", "rnn") else input_type.flat_size()
+        dt = jnp.result_type(float)
+        return {"gamma": jnp.ones((n,), dt), "beta": jnp.zeros((n,), dt)}
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        mean = x.mean(axis=-1, keepdims=True)
+        var = x.var(axis=-1, keepdims=True)
+        xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        return self._activate(xhat * params["gamma"] + params["beta"]), state
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head self-attention over [B,T,F] sequences.
+
+    ``sequence_parallel`` selects the mesh execution when the trainer has
+    installed one via :func:`set_attention_mesh`: "ring" (K/V circulate the
+    ICI ring — arbitrarily long sequences) or "all_to_all" (Ulysses-style
+    head swap). With no mesh installed the local fused kernel runs.
+    """
+
+    n_out: int = 0
+    n_heads: int = 4
+    causal: bool = False
+    sequence_parallel: str = "ring"  # ring | all_to_all
+
+    @property
+    def is_recurrent(self) -> bool:
+        return True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_params(self, key, input_type) -> Params:
+        n_in = input_type.size
+        d = self.n_out
+        if d % self.n_heads:
+            raise ValueError(f"n_out {d} not divisible by n_heads {self.n_heads}")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        return {
+            "Wq": self._init_weight(kq, (n_in, d), n_in, d),
+            "Wk": self._init_weight(kk, (n_in, d), n_in, d),
+            "Wv": self._init_weight(kv, (n_in, d), n_in, d),
+            "Wo": self._init_weight(ko, (d, d), d, d),
+            "bo": self._init_bias((d,)),
+        }
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from ...parallel.ring_attention import (  # noqa: PLC0415
+            all_to_all_attention,
+            attention,
+            ring_attention,
+        )
+
+        B, T, _unused = x.shape
+        H = self.n_heads
+        D = self.n_out // H
+
+        def split(w):
+            return (x @ w).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+
+        q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        # padded keys are excluded with -inf scores inside the kernel
+        key_mask = None if mask is None else mask.astype(x.dtype)
+
+        mesh_ctx = get_attention_mesh()
+        if mesh_ctx is None:
+            out = attention(q, k, v, causal=self.causal, key_mask=key_mask)
+        else:
+            mesh, axis = mesh_ctx
+            fn = (ring_attention if self.sequence_parallel == "ring"
+                  else all_to_all_attention)
+            out = fn(q, k, v, mesh, seq_axis=axis, causal=self.causal,
+                     key_mask=key_mask)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
+        out = out @ params["Wo"] + params["bo"]
+        out = maybe_dropout(out, self.dropout, train, rng)
+        return self._activate(out), state
+
+
+_ATTENTION_MESH: Optional[tuple] = None
+
+
+def set_attention_mesh(mesh, seq_axis: str = "seq") -> None:
+    """Install (or clear, with None) the mesh attention layers execute on —
+    called by the mesh trainer before jitting the sharded train step."""
+    global _ATTENTION_MESH
+    _ATTENTION_MESH = None if mesh is None else (mesh, seq_axis)
+
+
+def get_attention_mesh():
+    return _ATTENTION_MESH

@@ -14,6 +14,8 @@ from .training_master import (
     SyncAllReduceTrainingMaster,
     ParameterAveragingTrainingMaster,
 )
+from .ring_attention import all_to_all_attention, attention, ring_attention
+from .sharding import param_shardings, shard_params
 
 __all__ = [
     "make_mesh",
@@ -25,4 +27,9 @@ __all__ = [
     "TrainingStats",
     "SyncAllReduceTrainingMaster",
     "ParameterAveragingTrainingMaster",
+    "attention",
+    "ring_attention",
+    "all_to_all_attention",
+    "param_shardings",
+    "shard_params",
 ]

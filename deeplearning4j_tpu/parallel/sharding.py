@@ -1,0 +1,59 @@
+"""Tensor-parallel parameter sharding rules.
+
+The reference's only parallelism is replica data-parallelism (SURVEY.md §2.4);
+tensor parallelism is part of this framework's first-class distributed design.
+The TPU-native mechanism is GSPMD: annotate each parameter leaf with a
+NamedSharding over the mesh's ``model`` axis and let XLA partition every
+matmul and insert the reduce-scatter/all-gather collectives — no hand-written
+megatron forward/backward pair is needed.
+
+Default layout: 2-D kernels shard their output (last) dimension, biases and
+other 1-D vectors shard when divisible, everything else replicates. XLA's
+sharding propagation then picks column-parallel → row-parallel transitions
+automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def tree_shardings(tree, mesh, model_axis: str = "model"):
+    """NamedShardings for an arbitrary pytree by the shape rules above.
+    Works for params AND optimizer state (Adam moments share their param's
+    shape, so they land on the same sharding; scalar counts replicate)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: PLC0415
+
+    size = mesh.shape[model_axis]
+
+    def rule(a):
+        shape = np.shape(a)
+        if len(shape) >= 2 and shape[-1] % size == 0:
+            spec = P(*([None] * (len(shape) - 1)), model_axis)
+        elif len(shape) == 1 and shape[0] % size == 0 and shape[0] >= size:
+            spec = P(model_axis)
+        else:
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map(rule, tree)
+
+
+def param_shardings(params, mesh, model_axis: str = "model"):
+    """A pytree of NamedShardings matching ``params``' structure."""
+    return tree_shardings(params, mesh, model_axis)
+
+
+def shard_params(net, mesh, model_axis: str = "model"):
+    """device_put the net's params (and existing optimizer state) with
+    tensor-parallel shardings; returns the param sharding pytree so callers
+    can reuse it for checkpoint restore."""
+    net.init()
+    shardings = param_shardings(net.params, mesh, model_axis)
+    net.params = jax.device_put(net.params, shardings)
+    if net.opt_state is not None:
+        net.opt_state = jax.device_put(
+            net.opt_state, tree_shardings(net.opt_state, mesh, model_axis)
+        )
+    return shardings

@@ -63,10 +63,28 @@ class ParallelWrapper:
         report_score_after_averaging: bool = True,
         prefetch_buffer: int = 2,
         mesh=None,
+        model_axis: Optional[str] = None,
     ):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh(workers)
-        self.workers = int(np.prod(self.mesh.devices.shape))
+        # dp×tp: batch shards over "data", params over model_axis (GSPMD
+        # inserts the tensor-parallel collectives — parallel/sharding.py)
+        self.model_axis = model_axis
+        if model_axis is not None and model_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"model_axis '{model_axis}' not in mesh axes {self.mesh.axis_names}"
+            )
+        if model_axis is not None and averaging_frequency > 1:
+            raise ValueError(
+                "tensor parallelism (model_axis) requires sync mode "
+                "(averaging_frequency=1); periodic replica averaging would "
+                "silently replicate the model"
+            )
+        self._data_axes = tuple(n for n in self.mesh.axis_names if n != model_axis)
+        self.workers = int(
+            np.prod([self.mesh.shape[n] for n in self._data_axes]) if model_axis
+            else np.prod(self.mesh.devices.shape)
+        )
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
@@ -84,16 +102,29 @@ class ParallelWrapper:
         if net._train_step is None:
             net._train_step = net._build_train_step()
         rep = replicated_sharding(self.mesh)
-        net.params = jax.device_put(net.params, rep)
-        net.opt_state = jax.device_put(net.opt_state, rep)
+        if self.model_axis is not None:
+            from .sharding import shard_params  # noqa: PLC0415
+
+            # shards params AND the existing opt_state (moments follow their
+            # param's sharding; training state is preserved, not reset)
+            shard_params(net, self.mesh, self.model_axis)
+        else:
+            net.params = jax.device_put(net.params, rep)
+            net.opt_state = jax.device_put(net.opt_state, rep)
         if jax.tree_util.tree_leaves(net.state):
             net.state = jax.device_put(net.state, rep)
         self._sync_ready = True
 
+    def _batch_sharding(self):
+        """Batch-dim sharding over every non-model mesh axis."""
+        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
+
+        return NamedSharding(self.mesh, PartitionSpec(self._data_axes))
+
     def _fit_sync(self, global_ds) -> None:
         """One SPMD step on a globally-sharded batch; grads psum over ICI."""
         net = self.net
-        shard = data_sharding(self.mesh)
+        shard = self._batch_sharding()
         x = jax.device_put(jnp.asarray(global_ds.features), shard)
         y = jax.device_put(jnp.asarray(global_ds.labels), shard)
         net._rng, step_key = jax.random.split(net._rng)

@@ -1,0 +1,228 @@
+"""Long-context tier tests: ring / all-to-all sequence parallelism on the
+8-device CPU mesh (the SURVEY.md §4 'local[n] analog'), plus the attention
+layers and dp×tp ParallelWrapper mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import (
+    ParallelWrapper,
+    all_to_all_attention,
+    attention,
+    make_mesh,
+    param_shardings,
+    ring_attention,
+)
+
+
+def _qkv(seed=0, B=2, H=4, T=16, D=8):
+    rng = np.random.default_rng(seed)
+    r = lambda: jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)  # noqa: E731
+    return r(), r(), r()
+
+
+def _reference_softmax_attention(q, k, v, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        T = q.shape[2]
+        mask = np.tril(np.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_local_attention_matches_softmax_reference(causal):
+    q, k, v = _qkv()
+    np.testing.assert_allclose(
+        np.asarray(attention(q, k, v, causal=causal)),
+        np.asarray(_reference_softmax_attention(q, k, v, causal)),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_local(causal):
+    mesh = make_mesh(8, axis_names=("seq",))
+    q, k, v = _qkv(T=32)
+    out_ring = ring_attention(q, k, v, mesh, causal=causal)
+    out_local = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_local), atol=1e-5
+    )
+
+
+def test_ring_attention_gradients_match_local():
+    mesh = make_mesh(8, axis_names=("seq",))
+    q, k, v = _qkv(seed=1, T=16)
+
+    g_ring = jax.grad(
+        lambda q: jnp.sum(jnp.sin(ring_attention(q, k, v, mesh, causal=True)))
+    )(q)
+    g_local = jax.grad(
+        lambda q: jnp.sum(jnp.sin(attention(q, k, v, causal=True)))
+    )(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_local), atol=1e-4)
+
+
+def test_all_to_all_attention_matches_local():
+    mesh = make_mesh(8, axis_names=("seq",))
+    q, k, v = _qkv(seed=2, H=8, T=16)
+    np.testing.assert_allclose(
+        np.asarray(all_to_all_attention(q, k, v, mesh, causal=True)),
+        np.asarray(attention(q, k, v, causal=True)),
+        atol=1e-5,
+    )
+
+
+def test_self_attention_layer_trains_and_masks():
+    from deeplearning4j_tpu import (
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.nn.layers.attention import (
+        LayerNormLayer,
+        SelfAttentionLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 8, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=(4, 8))]
+    conf = MultiLayerConfiguration(
+        layers=[
+            SelfAttentionLayer(n_out=12, n_heads=3, causal=True),
+            LayerNormLayer(),
+            RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(6, 8),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(DataSet(x, y))
+    net.fit(DataSet(x, y))
+    for _ in range(10):
+        net.fit(DataSet(x, y))
+    assert net.score(DataSet(x, y)) < s0
+    # config JSON round-trip keeps attention fields
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.layers[0].n_heads == 3 and conf2.layers[0].causal
+
+
+def test_self_attention_layer_ring_equals_local():
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        SelfAttentionLayer,
+        set_attention_mesh,
+    )
+
+    layer = SelfAttentionLayer(n_out=8, n_heads=2, causal=True)
+    it = InputType.recurrent(8, 16)
+    params = layer.init_params(jax.random.PRNGKey(0), it)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 16, 8)), jnp.float32)
+    out_local, _ = layer.apply(params, x, {})
+    mesh = make_mesh(8, axis_names=("seq",))
+    try:
+        set_attention_mesh(mesh)
+        out_ring, _ = layer.apply(params, x, {})
+    finally:
+        set_attention_mesh(None)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_local), atol=1e-5)
+
+
+def test_parallel_wrapper_dp_tp():
+    """dp×tp mesh: batch over 'data' (4), params over 'model' (2)."""
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+
+    mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=32, activation="relu"),
+            OutputLayer(n_out=4, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(16),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    feats = (labels @ rng.normal(size=(4, 16)) + 0.1 * rng.normal(size=(64, 16))).astype(np.float32)
+    batches = [
+        DataSet(feats[i * 16 : (i + 1) * 16], labels[i * 16 : (i + 1) * 16])
+        for i in range(4)
+    ]
+    wrapper = ParallelWrapper(net, mesh=mesh, model_axis="model")
+    assert wrapper.workers == 4
+    for _ in range(10):
+        wrapper.fit(ListDataSetIterator(batches))
+    assert np.isfinite(float(net._last_loss))
+    # the dense kernel is actually sharded over the model axis
+    assert "model" in str(net.params[0]["W"].sharding.spec)
+    ev_x = feats[:16]
+    out = net.output(ev_x)
+    assert out.shape == (16, 4)
+
+
+@pytest.mark.parametrize("variant", ["local", "ring", "all_to_all"])
+def test_key_mask_excludes_padded_keys(variant):
+    """Padded keys must get -inf scores (zero softmax mass): masked result
+    equals attention over only the real prefix."""
+    rng = np.random.default_rng(6)
+    B, H, T, D, T_real = 2, 8, 16, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    key_mask = jnp.zeros((B, T), jnp.float32).at[:, :T_real].set(1.0)
+
+    if variant == "local":
+        out = attention(q, k, v, key_mask=key_mask)
+    else:
+        mesh = make_mesh(8, axis_names=("seq",))
+        fn = ring_attention if variant == "ring" else all_to_all_attention
+        out = fn(q, k, v, mesh, key_mask=key_mask)
+    expect = attention(q, k[:, :, :T_real], v[:, :, :T_real])
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :, :T_real], np.asarray(expect)[:, :, :T_real],
+        atol=1e-5,
+    )
+
+
+def test_wrapper_rejects_tp_with_periodic_averaging():
+    from deeplearning4j_tpu import (
+        DenseLayer, InputType, MultiLayerConfiguration, MultiLayerNetwork,
+        OutputLayer, UpdaterConfig,
+    )
+
+    mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+    conf = MultiLayerConfiguration(
+        layers=[OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(4), updater=UpdaterConfig(),
+    )
+    net = MultiLayerNetwork(conf)
+    with pytest.raises(ValueError, match="sync mode"):
+        ParallelWrapper(net, mesh=mesh, model_axis="model", averaging_frequency=2)
+
+
+def test_attention_layer_registered_for_json_roundtrip():
+    """SelfAttentionLayer must round-trip through bare package import
+    (registry populated by deeplearning4j_tpu/__init__)."""
+    import deeplearning4j_tpu as dl
+    from deeplearning4j_tpu.nn.layers.base import LAYER_REGISTRY
+
+    assert "SelfAttentionLayer" in LAYER_REGISTRY
+    assert "LayerNormLayer" in LAYER_REGISTRY
+    assert dl.SelfAttentionLayer is LAYER_REGISTRY["SelfAttentionLayer"]
