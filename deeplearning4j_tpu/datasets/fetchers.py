@@ -73,6 +73,16 @@ def load_mnist(train: bool = True, root: Optional[str] = None):
     if os.path.isdir(root):
         ip, lp = _find_idx(root, img_names), _find_idx(root, lab_names)
         if ip and lp:
+            if not ip.endswith(".gz") and not lp.endswith(".gz"):
+                try:  # native C++ IDX reader (runtime tier) when built
+                    from ..runtime import native_available, native_idx_read  # noqa: PLC0415
+
+                    if native_available():
+                        images = native_idx_read(ip, scale=255.0).reshape(-1, 784)
+                        labels = native_idx_read(lp).astype(np.int64).reshape(-1)
+                        return images.astype(np.float32), labels
+                except Exception:  # fall through to the Python reader
+                    pass
             images = read_idx(ip).reshape(-1, 784).astype(np.float32) / 255.0
             labels = read_idx(lp).astype(np.int64)
             return images, labels

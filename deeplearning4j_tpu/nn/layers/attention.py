@@ -36,7 +36,14 @@ class LayerNormLayer(BaseLayer):
         return False  # shape-agnostic; works on [B,F] and [B,T,F]
 
     def init_params(self, key, input_type) -> Params:
-        n = input_type.size if input_type.kind in ("ff", "rnn") else input_type.flat_size()
+        # normalization runs over the TRAILING axis, so gamma/beta size by it:
+        # features for ff/rnn, channels for NHWC conv activations
+        if input_type.kind in ("ff", "rnn"):
+            n = input_type.size
+        elif input_type.kind == "cnn":
+            n = input_type.channels
+        else:
+            n = input_type.flat_size()
         dt = jnp.result_type(float)
         return {"gamma": jnp.ones((n,), dt), "beta": jnp.zeros((n,), dt)}
 
@@ -120,11 +127,17 @@ class SelfAttentionLayer(BaseLayer):
 _ATTENTION_MESH: Optional[tuple] = None
 
 
-def set_attention_mesh(mesh, seq_axis: str = "seq") -> None:
+def set_attention_mesh(mesh, seq_axis: str = "seq", nets=()) -> None:
     """Install (or clear, with None) the mesh attention layers execute on —
-    called by the mesh trainer before jitting the sharded train step."""
+    call BEFORE the first fit/output: the choice is captured at jit trace
+    time. Pass already-traced models via ``nets`` to drop their cached
+    programs so the new mesh takes effect."""
     global _ATTENTION_MESH
     _ATTENTION_MESH = None if mesh is None else (mesh, seq_axis)
+    for net in nets:
+        for attr in ("_train_step", "_eval_forward", "_tbptt_step", "_rnn_step_fn"):
+            if hasattr(net, attr):
+                setattr(net, attr, None)
 
 
 def get_attention_mesh():

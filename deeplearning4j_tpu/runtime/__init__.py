@@ -1,0 +1,22 @@
+"""Native runtime tier: C++ data-loader + prefetcher behind ctypes.
+
+The compute path is XLA (no native math needed — SURVEY.md §2.3/§2.9); this
+package is the native *runtime around it*, mirroring how the reference rides
+on out-of-tree native code for its hot host paths. Falls back to pure Python
+when the toolchain is absent, exactly like the reference's reflective
+cuDNN-helper fallback (ConvolutionLayer.java:69-79).
+"""
+
+from .native_loader import (
+    NativeDataSetIterator,
+    native_available,
+    native_csv_read,
+    native_idx_read,
+)
+
+__all__ = [
+    "NativeDataSetIterator",
+    "native_available",
+    "native_csv_read",
+    "native_idx_read",
+]

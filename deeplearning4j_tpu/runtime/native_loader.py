@@ -1,0 +1,213 @@
+"""ctypes bindings + lazy build of the native data-loader (dataloader.cpp).
+
+No pybind11 in the image, so the ABI is plain C + ctypes. The shared library
+is compiled on first use with g++ (cached beside the source); when no
+compiler is available every entry point reports unavailable and callers use
+the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "dataloader.cpp")
+_LIB_PATH = os.path.join(_HERE, "native", "libdl4jtpu.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        _SRC, "-o", _LIB_PATH,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    fp = c.POINTER(c.c_float)
+    lib.dl4j_csv_read.restype = c.c_int
+    lib.dl4j_csv_read.argtypes = [c.c_char_p, c.c_int, c.c_char,
+                                  c.POINTER(fp), c.POINTER(c.c_int64),
+                                  c.POINTER(c.c_int64)]
+    lib.dl4j_idx_read.restype = c.c_int
+    lib.dl4j_idx_read.argtypes = [c.c_char_p, c.c_float, c.POINTER(fp),
+                                  c.POINTER(c.c_int32), c.POINTER(c.c_int64)]
+    lib.dl4j_free.restype = None
+    lib.dl4j_free.argtypes = [c.c_void_p]
+    lib.dl4j_shuffled_indices.restype = None
+    lib.dl4j_shuffled_indices.argtypes = [c.c_int64, c.c_uint64,
+                                          c.POINTER(c.c_int64)]
+    lib.dl4j_loader_create.restype = c.c_void_p
+    lib.dl4j_loader_create.argtypes = [fp, fp, c.c_int64, c.c_int64,
+                                       c.c_int64, c.c_int64, c.c_int,
+                                       c.c_uint64, c.c_int, c.c_int, c.c_int]
+    lib.dl4j_loader_num_batches.restype = c.c_int64
+    lib.dl4j_loader_num_batches.argtypes = [c.c_void_p]
+    lib.dl4j_loader_next.restype = c.c_int64
+    lib.dl4j_loader_next.argtypes = [c.c_void_p, fp, fp]
+    lib.dl4j_loader_reset.restype = None
+    lib.dl4j_loader_reset.argtypes = [c.c_void_p, c.c_int, c.c_uint64]
+    lib.dl4j_loader_destroy.restype = None
+    lib.dl4j_loader_destroy.argtypes = [c.c_void_p]
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_csv_read(path: str, skip_lines: int = 0,
+                    delimiter: str = ",") -> np.ndarray:
+    """Parse a numeric CSV to a float32 [rows, cols] matrix natively."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable (no g++?)")
+    out = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.dl4j_csv_read(path.encode(), skip_lines,
+                           delimiter.encode()[0:1], ctypes.byref(out),
+                           ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise IOError(f"dl4j_csv_read({path}) failed with code {rc}")
+    try:
+        n = rows.value * cols.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        lib.dl4j_free(out)
+    return arr.reshape(rows.value, cols.value)
+
+
+def native_idx_read(path: str, scale: float = 0.0) -> np.ndarray:
+    """Read an (uncompressed) IDX file natively; scale>0 divides (255 → [0,1])."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable (no g++?)")
+    out = ctypes.POINTER(ctypes.c_float)()
+    ndim = ctypes.c_int32()
+    dims = (ctypes.c_int64 * 8)()
+    rc = lib.dl4j_idx_read(path.encode(), scale, ctypes.byref(out),
+                           ctypes.byref(ndim), dims)
+    if rc != 0:
+        raise IOError(f"dl4j_idx_read({path}) failed with code {rc}")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape)) if shape else 0
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        lib.dl4j_free(out)
+    return arr.reshape(shape)
+
+
+class NativeDataSetIterator:
+    """DataSetIterator backed by the C++ prefetching loader.
+
+    Worker threads shuffle + gather batches into a native ring buffer while
+    the device computes — the native successor of AsyncDataSetIterator
+    (AsyncDataSetIterator.java:36). Epochs reshuffle with seed+epoch.
+    """
+
+    prefetch_supported = False  # already prefetches natively
+
+    def __init__(self, features, labels, batch: int, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True, queue_size: int = 4,
+                 workers: int = 2):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no g++?)")
+        self._lib = lib
+        # keep alive + enforce dense float32
+        self._features = np.ascontiguousarray(features, dtype=np.float32)
+        self._labels = np.ascontiguousarray(labels, dtype=np.float32)
+        if self._features.ndim < 2:
+            self._features = self._features.reshape(len(self._features), -1)
+        if self._labels.ndim < 2:
+            self._labels = self._labels.reshape(len(self._labels), -1)
+        self._feature_shape = self._features.shape[1:]
+        f2 = self._features.reshape(len(self._features), -1)
+        l2 = self._labels.reshape(len(self._labels), -1)
+        self.batch = int(batch)
+        self.shuffle = shuffle
+        self._epoch = 0
+        self._f2, self._l2 = f2, l2
+        fp = ctypes.POINTER(ctypes.c_float)
+        self._handle = lib.dl4j_loader_create(
+            f2.ctypes.data_as(fp), l2.ctypes.data_as(fp),
+            f2.shape[0], f2.shape[1], l2.shape[1], self.batch,
+            1 if shuffle else 0, seed, 1 if drop_last else 0,
+            queue_size, workers,
+        )
+
+    def batch_size(self) -> int:
+        return self.batch
+
+    def __len__(self) -> int:
+        return int(self._lib.dl4j_loader_num_batches(self._handle))
+
+    def reset(self) -> None:
+        self._epoch += 1
+        self._lib.dl4j_loader_reset(
+            self._handle, 1 if self.shuffle else 0, self._epoch
+        )
+
+    def __iter__(self):
+        from ..datasets.iterators import DataSet  # noqa: PLC0415
+
+        fp = ctypes.POINTER(ctypes.c_float)
+        fcols = self._f2.shape[1]
+        lcols = self._l2.shape[1]
+        while True:
+            feat = np.empty((self.batch, fcols), np.float32)
+            lab = np.empty((self.batch, lcols), np.float32)
+            n = self._lib.dl4j_loader_next(
+                self._handle, feat.ctypes.data_as(fp), lab.ctypes.data_as(fp)
+            )
+            if n == 0:
+                return
+            yield DataSet(
+                feat[:n].reshape((n,) + self._feature_shape), lab[:n]
+            )
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.dl4j_loader_destroy(handle)
+            self._handle = None

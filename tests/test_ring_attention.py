@@ -226,3 +226,17 @@ def test_attention_layer_registered_for_json_roundtrip():
     assert "SelfAttentionLayer" in LAYER_REGISTRY
     assert "LayerNormLayer" in LAYER_REGISTRY
     assert dl.SelfAttentionLayer is LAYER_REGISTRY["SelfAttentionLayer"]
+
+
+def test_layernorm_after_conv_uses_channel_axis():
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.nn.layers.attention import LayerNormLayer
+
+    layer = LayerNormLayer()
+    it = InputType.convolutional(4, 4, 3)
+    params = layer.init_params(jax.random.PRNGKey(0), it)
+    assert params["gamma"].shape == (3,)
+    x = jnp.asarray(np.random.default_rng(8).normal(size=(2, 4, 4, 3)), jnp.float32)
+    out, _ = layer.apply(params, x, {})
+    assert out.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out.mean(-1)), 0.0, atol=1e-5)
