@@ -80,7 +80,7 @@ def load_mnist(train: bool = True, root: Optional[str] = None):
                     if native_available():
                         images = native_idx_read(ip, scale=255.0).reshape(-1, 784)
                         labels = native_idx_read(lp).astype(np.int64).reshape(-1)
-                        return images.astype(np.float32), labels
+                        return images, labels
                 except Exception:  # fall through to the Python reader
                     pass
             images = read_idx(ip).reshape(-1, 784).astype(np.float32) / 255.0

@@ -1,0 +1,6 @@
+"""Interop tier: the Keras-backend gateway (reference: deeplearning4j-keras
+Py4J GatewayServer, SURVEY.md §2.7)."""
+
+from .gateway import GatewayClient, GatewayServer
+
+__all__ = ["GatewayClient", "GatewayServer"]

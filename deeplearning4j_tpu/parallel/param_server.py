@@ -1,0 +1,235 @@
+"""Push/pull parameter server — the reference's experimental DP-3 transport.
+
+Reference: ParameterServerParallelWrapper.java:159-216 embeds an Aeron
+MediaDriver + ParameterServerNode; trainer threads push gradients and pull
+parameters through ParameterServerClient (SURVEY.md §2.4). Here the transport
+is a length-prefixed TCP protocol on localhost/DCN; the server owns the flat
+parameter vector and applies pushed gradients with a plain SGD step, clients
+pull the latest snapshot. On TPU pods the first-class path is mesh
+collectives (wrapper.py) — this tier exists for reference parity and for
+CPU-host asynchronous topologies.
+
+Wire format: 1 op byte ('G' push grad, 'P' pull, 'Q' shutdown probe) +
+uint64 length + float32 payload. No pickle — fixed binary frames only.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_array(sock: socket.socket, arr: np.ndarray) -> None:
+    payload = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_array(sock: socket.socket) -> np.ndarray:
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return np.frombuffer(_recv_exact(sock, n), dtype=np.float32).copy()
+
+
+class ParameterServer:
+    """Owns the flat parameter vector; applies pushed gradients (SGD)."""
+
+    def __init__(self, initial_params: np.ndarray, learning_rate: float = 0.01,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._params = np.ascontiguousarray(initial_params, np.float32).copy()
+        self.learning_rate = float(learning_rate)
+        self._lock = threading.Lock()
+        self._updates = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dl4j-param-server")
+        self._thread.start()
+
+    # -- lifecycle ------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            # unblock accept()
+            poke = socket.create_connection((self.host, self.port), timeout=1)
+            poke.sendall(b"Q")
+            poke.close()
+        except OSError:
+            pass
+        self._srv.close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- state ----------------------------------------------------------
+    @property
+    def params(self) -> np.ndarray:
+        with self._lock:
+            return self._params.copy()
+
+    @property
+    def num_updates(self) -> int:
+        with self._lock:
+            return self._updates
+
+    # -- server loop ----------------------------------------------------
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                op = conn.recv(1)
+                if not op or op == b"Q":
+                    return
+                if op == b"G":
+                    grad = _recv_array(conn)
+                    with self._lock:
+                        if grad.shape != self._params.shape:
+                            conn.sendall(b"E")
+                            continue
+                        self._params -= self.learning_rate * grad
+                        self._updates += 1
+                    conn.sendall(b"A")  # ack
+                elif op == b"P":
+                    with self._lock:
+                        snapshot = self._params.copy()
+                    _send_array(conn, snapshot)
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+class ParameterServerClient:
+    """Reference: nd4j ParameterServerClient (push/pull over the transport)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+
+    def push_gradient(self, grad: np.ndarray) -> None:
+        self._sock.sendall(b"G")
+        _send_array(self._sock, grad)
+        ack = _recv_exact(self._sock, 1)
+        if ack != b"A":
+            raise RuntimeError("parameter server rejected gradient (shape mismatch)")
+
+    def pull_params(self) -> np.ndarray:
+        self._sock.sendall(b"P")
+        return _recv_array(self._sock)
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"Q")
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class ParameterServerParallelWrapper:
+    """Asynchronous data parallelism through the parameter server.
+
+    Reference: ParameterServerParallelWrapper.java — N trainer threads, each
+    with a model replica, pushing gradients and pulling fresh parameters
+    per minibatch (no barrier; the 'hogwild-over-transport' topology).
+    """
+
+    def __init__(self, net, workers: int = 2, learning_rate: float = 0.01,
+                 port: int = 0):
+        import jax  # noqa: PLC0415
+
+        self.net = net
+        net.init()
+        self.workers = int(workers)
+        leaves, self._treedef = jax.tree_util.tree_flatten(net.params)
+        self._shapes = [np.shape(l) for l in leaves]
+        self._sizes = [int(np.prod(s)) for s in self._shapes]
+        flat = np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
+        self.server = ParameterServer(flat, learning_rate=learning_rate, port=port)
+
+    def _unflatten(self, flat: np.ndarray):
+        import jax  # noqa: PLC0415
+
+        leaves, off = [], 0
+        for shape, size in zip(self._shapes, self._sizes):
+            leaves.append(flat[off : off + size].reshape(shape))
+            off += size
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _flatten_tree(self, tree) -> np.ndarray:
+        import jax  # noqa: PLC0415
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        return np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
+
+    def fit(self, data, epochs: int = 1) -> "ParameterServerParallelWrapper":
+        import jax  # noqa: PLC0415
+
+        from ..datasets.iterators import as_iterator
+
+        net = self.net
+        grad_fn = jax.jit(
+            lambda p, state, x, y, rng: jax.grad(
+                lambda pp: net._loss(pp, state, x, y, rng, True)[0]
+            )(p)
+        )
+
+        def worker(batches: List, seed: int):
+            client = ParameterServerClient(self.server.host, self.server.port)
+            rng = jax.random.PRNGKey(seed)
+            try:
+                for ds in batches:
+                    params = self._unflatten(client.pull_params())
+                    rng, k = jax.random.split(rng)
+                    grads = grad_fn(params, net.state, ds.features, ds.labels, k)
+                    client.push_gradient(self._flatten_tree(grads))
+            finally:
+                client.close()
+
+        for _ in range(epochs):
+            it = as_iterator(data)
+            if hasattr(it, "reset"):
+                it.reset()
+            shards: List[List] = [[] for _ in range(self.workers)]
+            for i, ds in enumerate(it):
+                shards[i % self.workers].append(ds)
+            threads = [
+                threading.Thread(target=worker, args=(shard, i), daemon=True)
+                for i, shard in enumerate(shards) if shard
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        net.params = self._unflatten(self.server.params)
+        return self
+
+    def shutdown(self) -> None:
+        self.server.shutdown()

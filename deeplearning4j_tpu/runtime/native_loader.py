@@ -153,6 +153,8 @@ class NativeDataSetIterator:
         lib = _load()
         if lib is None:
             raise RuntimeError("native runtime unavailable (no g++?)")
+        if int(batch) < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
         self._lib = lib
         # keep alive + enforce dense float32
         self._features = np.ascontiguousarray(features, dtype=np.float32)
@@ -167,6 +169,7 @@ class NativeDataSetIterator:
         self.batch = int(batch)
         self.shuffle = shuffle
         self._epoch = 0
+        self._consumed = 0
         self._f2, self._l2 = f2, l2
         fp = ctypes.POINTER(ctypes.c_float)
         self._handle = lib.dl4j_loader_create(
@@ -184,6 +187,7 @@ class NativeDataSetIterator:
 
     def reset(self) -> None:
         self._epoch += 1
+        self._consumed = 0
         self._lib.dl4j_loader_reset(
             self._handle, 1 if self.shuffle else 0, self._epoch
         )
@@ -191,6 +195,10 @@ class NativeDataSetIterator:
     def __iter__(self):
         from ..datasets.iterators import DataSet  # noqa: PLC0415
 
+        # iterator contract parity (NumpyDataSetIterator): iterating an
+        # exhausted epoch starts a fresh one (reshuffled)
+        if len(self) > 0 and self._consumed >= len(self):
+            self.reset()
         fp = ctypes.POINTER(ctypes.c_float)
         fcols = self._f2.shape[1]
         lcols = self._l2.shape[1]
@@ -202,6 +210,7 @@ class NativeDataSetIterator:
             )
             if n == 0:
                 return
+            self._consumed += 1
             yield DataSet(
                 feat[:n].reshape((n,) + self._feature_shape), lab[:n]
             )

@@ -1,0 +1,21 @@
+"""Streaming tier (reference: dl4j-streaming Kafka+Camel pipelines)."""
+
+from .pipeline import (
+    KafkaSource,
+    Route,
+    QueueSource,
+    RecordSource,
+    ServeRoute,
+    StreamingPipeline,
+    TrainRoute,
+)
+
+__all__ = [
+    "KafkaSource",
+    "Route",
+    "QueueSource",
+    "RecordSource",
+    "ServeRoute",
+    "StreamingPipeline",
+    "TrainRoute",
+]

@@ -1,0 +1,183 @@
+"""Streaming pipelines: records → DataSet → online fit / serving routes.
+
+Reference: dl4j-streaming (SURVEY.md §2.4) wires Kafka + Camel + Spark
+Streaming: ``BaseKafkaPipeline`` turns a record stream into ``DataSet``s,
+``DL4jServeRouteBuilder`` routes them into online ``fit`` or inference with
+results published back. The TPU-native shape: a ``RecordSource`` SPI feeding
+a background pipeline thread that micro-batches records and hands them to
+pluggable routes — ``TrainRoute`` (online fit; one jitted step per
+micro-batch) and ``ServeRoute`` (predictions to a sink callback/queue). A
+Kafka source is provided behind a gated import (kafka-python is not in the
+image; any broker client can implement ``RecordSource.poll``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RecordSource:
+    """SPI: poll() returns a record (features[, label]) or None when idle."""
+
+    def poll(self, timeout: float = 0.1):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class QueueSource(RecordSource):
+    """In-process source (tests / direct feeding; the 'direct:' Camel route)."""
+
+    def __init__(self, maxsize: int = 1024):
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+
+    def put(self, features, label=None) -> None:
+        self._q.put((np.asarray(features, np.float32),
+                     None if label is None else np.asarray(label, np.float32)))
+
+    def poll(self, timeout: float = 0.1):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class KafkaSource(RecordSource):
+    """Kafka consumer source (reference: kafka/NDArrayKafkaClient.java).
+
+    Gated: requires the ``kafka`` package (absent in this image — the SPI
+    keeps the seam; deserializer maps message bytes → (features, label)).
+    """
+
+    def __init__(self, topic: str, deserializer: Callable, **consumer_kwargs):
+        try:
+            from kafka import KafkaConsumer  # noqa: PLC0415
+        except ImportError as e:
+            raise ImportError(
+                "kafka-python is required for KafkaSource; implement "
+                "RecordSource.poll over your broker client instead"
+            ) from e
+        self._consumer = KafkaConsumer(topic, **consumer_kwargs)
+        self._deserializer = deserializer
+
+    def poll(self, timeout: float = 0.1):
+        polled = self._consumer.poll(timeout_ms=int(timeout * 1000), max_records=1)
+        for records in polled.values():
+            for rec in records:
+                return self._deserializer(rec.value)
+        return None
+
+    def close(self) -> None:
+        self._consumer.close()
+
+
+class Route:
+    """SPI: receives assembled micro-batches."""
+
+    def on_batch(self, features: np.ndarray, labels: Optional[np.ndarray]) -> None:
+        raise NotImplementedError
+
+
+class TrainRoute(Route):
+    """Online learning: one fit step per micro-batch (reference:
+    DL4jServeRouteBuilder's fit path)."""
+
+    def __init__(self, net):
+        self.net = net
+        self.batches_seen = 0
+
+    def on_batch(self, features, labels):
+        if labels is None:
+            raise ValueError("TrainRoute needs labelled records")
+        from ..datasets.iterators import DataSet  # noqa: PLC0415
+
+        self.net.fit(DataSet(features, labels))
+        self.batches_seen += 1
+
+
+class ServeRoute(Route):
+    """Inference: predictions go to the sink callback (reference: serving
+    route publishing results back to the transport)."""
+
+    def __init__(self, net, sink: Callable[[np.ndarray, np.ndarray], None]):
+        self.net = net
+        self.sink = sink
+
+    def on_batch(self, features, labels):
+        out = np.asarray(self.net.output(features))
+        self.sink(features, out)
+
+
+class StreamingPipeline:
+    """Micro-batching pump: source → (batch assembly) → routes.
+
+    ``batch`` records are grouped (padding is NOT applied — records must be
+    homogeneous) and every route sees each micro-batch. ``linger`` bounds the
+    wait before a short batch is flushed, keeping latency bounded like the
+    reference's Camel aggregator timeouts.
+    """
+
+    def __init__(self, source: RecordSource, routes: Sequence[Route],
+                 batch: int = 32, linger: float = 0.5):
+        self.source = source
+        self.routes = list(routes)
+        self.batch = int(batch)
+        self.linger = float(linger)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "StreamingPipeline":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dl4j-streaming-pipeline")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.source.close()
+        if self._error is not None:
+            raise self._error
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- pump -----------------------------------------------------------
+    def _run(self) -> None:
+        buf: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
+        deadline = None
+        try:
+            while not self._stop.is_set():
+                rec = self.source.poll(timeout=0.05)
+                now = time.monotonic()
+                if rec is not None:
+                    buf.append(rec)
+                    if deadline is None:
+                        deadline = now + self.linger
+                if buf and (len(buf) >= self.batch or now >= (deadline or now)):
+                    self._flush(buf)
+                    buf, deadline = [], None
+            if buf:
+                self._flush(buf)
+        except BaseException as e:  # surfaced on stop()
+            self._error = e
+
+    def _flush(self, buf) -> None:
+        feats = np.stack([f for f, _ in buf])
+        labels = None
+        if buf[0][1] is not None:
+            labels = np.stack([l for _, l in buf])
+        for route in self.routes:
+            route.on_batch(feats, labels)

@@ -54,7 +54,6 @@ def test_native_idx_matches_python_reader(tmp_path):
 
 
 def test_native_loader_covers_all_rows_shuffled():
-    rng = np.random.default_rng(2)
     n, fdim = 64, 5
     feats = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, fdim), np.float32)
     labels = np.arange(n, dtype=np.float32)[:, None]
@@ -105,8 +104,6 @@ def test_native_loader_image_shape_and_training():
     for _ in range(15):
         net.fit(it)
         it.reset()
-    from deeplearning4j_tpu.datasets.iterators import DataSet
-
     assert float(net._last_loss) < 0.5
 
 
@@ -117,3 +114,14 @@ def test_native_loader_drop_last_false_partial_batch():
                                drop_last=False)
     sizes = [ds.features.shape[0] for ds in it]
     assert sizes == [4, 4, 2]
+
+
+def test_native_loader_auto_restart_and_batch_guard():
+    feats = np.arange(24, dtype=np.float32).reshape(8, 3)
+    labels = np.zeros((8, 2), np.float32)
+    it = NativeDataSetIterator(feats, labels, batch=4, shuffle=False)
+    assert len(list(it)) == 2
+    # exhausted iterator restarts a fresh epoch without explicit reset()
+    assert len(list(it)) == 2
+    with pytest.raises(ValueError, match="batch"):
+        NativeDataSetIterator(feats, labels, batch=0)

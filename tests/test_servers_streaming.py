@@ -1,0 +1,194 @@
+"""Parameter server, keras gateway, streaming pipeline tests (reference
+strategy §4.3: distributed semantics exercised in one process)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+
+
+def _toy_net(n_in=8, n_classes=3, lr=0.1, seed=0):
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu"),
+            OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(n_in),
+        updater=UpdaterConfig(updater="sgd", learning_rate=lr),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(n=128, n_in=8, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, n)]
+    feats = (labels @ rng.normal(size=(n_classes, n_in))
+             + 0.1 * rng.normal(size=(n, n_in))).astype(np.float32)
+    return feats, labels
+
+
+# ---------------------------------------------------------------- param server
+
+def test_parameter_server_push_pull():
+    from deeplearning4j_tpu.parallel.param_server import (
+        ParameterServer,
+        ParameterServerClient,
+    )
+
+    init = np.arange(10, dtype=np.float32)
+    with ParameterServer(init, learning_rate=0.5) as srv:
+        c = ParameterServerClient(srv.host, srv.port)
+        np.testing.assert_allclose(c.pull_params(), init)
+        c.push_gradient(np.ones(10, np.float32))
+        np.testing.assert_allclose(c.pull_params(), init - 0.5)
+        assert srv.num_updates == 1
+        with pytest.raises(RuntimeError):
+            c.push_gradient(np.ones(3, np.float32))  # shape mismatch
+        c.close()
+
+
+def test_parameter_server_concurrent_pushes():
+    from deeplearning4j_tpu.parallel.param_server import (
+        ParameterServer,
+        ParameterServerClient,
+    )
+
+    with ParameterServer(np.zeros(4, np.float32), learning_rate=1.0) as srv:
+        def pusher():
+            c = ParameterServerClient(srv.host, srv.port)
+            for _ in range(25):
+                c.push_gradient(-np.ones(4, np.float32))
+            c.close()
+
+        threads = [threading.Thread(target=pusher) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # all 100 updates applied atomically
+        np.testing.assert_allclose(srv.params, 100.0)
+        assert srv.num_updates == 100
+
+
+def test_parameter_server_wrapper_trains():
+    from deeplearning4j_tpu.parallel.param_server import (
+        ParameterServerParallelWrapper,
+    )
+
+    net = _toy_net(lr=0.05)
+    feats, labels = _toy_data()
+    s0 = net.score(DataSet(feats, labels))
+    batches = [DataSet(feats[i::4], labels[i::4]) for i in range(4)]
+    wrapper = ParameterServerParallelWrapper(net, workers=2, learning_rate=0.05)
+    try:
+        wrapper.fit(ListDataSetIterator(batches), epochs=20)
+    finally:
+        wrapper.shutdown()
+    s1 = net.score(DataSet(feats, labels))
+    assert s1 < s0
+
+
+# -------------------------------------------------------------------- gateway
+
+def test_keras_gateway_fit_predict_roundtrip():
+    from deeplearning4j_tpu.interop import GatewayClient, GatewayServer
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Dense", "config": {
+                "name": "d1", "output_dim": 16, "activation": "relu",
+                "bias": True, "batch_input_shape": [None, 8]}},
+            {"class_name": "Dense", "config": {
+                "name": "d2", "output_dim": 3, "activation": "softmax",
+                "bias": True}},
+        ],
+    }
+    training_config = {
+        "loss": "categorical_crossentropy",
+        "optimizer_config": {"class_name": "SGD", "config": {"lr": 0.1}},
+    }
+    feats, labels = _toy_data()
+    with GatewayServer() as srv:
+        client = GatewayClient(srv.host, srv.port)
+        n_params = client.create_model("m1", model_config, training_config)
+        assert n_params == 8 * 16 + 16 + 16 * 3 + 3
+        s0 = client.evaluate("m1", feats, labels)
+        for _ in range(15):
+            client.fit("m1", feats, labels)
+        assert client.evaluate("m1", feats, labels) < s0
+        out = client.predict("m1", feats[:10])
+        assert out.shape == (10, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+        # error surface: unknown model
+        with pytest.raises(RuntimeError, match="unknown model_id"):
+            client.predict("nope", feats[:2])
+        client.close()
+
+
+# ------------------------------------------------------------------ streaming
+
+def test_streaming_train_and_serve_routes():
+    from deeplearning4j_tpu.streaming import (
+        QueueSource,
+        ServeRoute,
+        StreamingPipeline,
+        TrainRoute,
+    )
+
+    net = _toy_net(lr=0.1)
+    feats, labels = _toy_data(n=96)
+    served = []
+    source = QueueSource()
+    train = TrainRoute(net)
+    serve = ServeRoute(net, sink=lambda x, y: served.append(y))
+    pipeline = StreamingPipeline(source, [train, serve], batch=32, linger=0.2)
+    s0 = net.score(DataSet(feats, labels))
+    with pipeline:
+        for f, l in zip(feats, labels):
+            source.put(f, l)
+        deadline = time.time() + 15
+        while train.batches_seen < 3 and time.time() < deadline:
+            time.sleep(0.05)
+    assert train.batches_seen >= 3
+    assert len(served) >= 3
+    assert served[0].shape == (32, 3)
+    assert net.score(DataSet(feats, labels)) < s0
+
+
+def test_streaming_linger_flushes_short_batch():
+    from deeplearning4j_tpu.streaming import QueueSource, StreamingPipeline, Route
+
+    class Collect(Route):
+        def __init__(self):
+            self.batches = []
+
+        def on_batch(self, features, labels):
+            self.batches.append(features.shape[0])
+
+    source = QueueSource()
+    route = Collect()
+    with StreamingPipeline(source, [route], batch=64, linger=0.1):
+        for i in range(5):
+            source.put(np.ones(3))
+        time.sleep(0.8)
+    assert route.batches and route.batches[0] == 5  # flushed by linger, not size
+
+
+def test_kafka_source_gated():
+    from deeplearning4j_tpu.streaming import KafkaSource
+
+    with pytest.raises(ImportError, match="kafka"):
+        KafkaSource("topic", deserializer=lambda b: (b, None))
