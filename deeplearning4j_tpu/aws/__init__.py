@@ -1,0 +1,12 @@
+"""Cloud storage/provisioning utilities (reference: deeplearning4j-aws —
+S3Uploader/S3 readers + EC2 ClusterSetup, SURVEY.md §2.4)."""
+
+from .s3 import BaseS3DataSetIterator, S3Downloader, S3Uploader
+from .provision import ClusterSetup
+
+__all__ = [
+    "BaseS3DataSetIterator",
+    "S3Downloader",
+    "S3Uploader",
+    "ClusterSetup",
+]

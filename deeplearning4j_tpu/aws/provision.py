@@ -1,0 +1,59 @@
+"""Cluster provisioning (reference: aws/ec2/provision/ClusterSetup.java spins
+up EC2 workers for distributed training).
+
+The TPU-native equivalent provisions TPU slices; this class shells the
+gcloud CLI when present (no cloud SDKs are baked into this image) and
+otherwise raises with the exact command to run — keeping the capability
+surface documented and scriptable rather than silently absent.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import List, Optional
+
+
+class ClusterSetup:
+    """reference: ec2/provision/ClusterSetup.java (sizing + launch + wiring).
+
+    gcloud-backed: ``create()`` provisions a TPU pod slice whose hosts then
+    join one jax.distributed runtime (parallel/mesh.initialize_multihost).
+    """
+
+    def __init__(self, name: str, accelerator_type: str = "v5litepod-8",
+                 zone: str = "us-central1-a", version: str = "tpu-ubuntu2204-base"):
+        self.name = name
+        self.accelerator_type = accelerator_type
+        self.zone = zone
+        self.version = version
+
+    def _command(self, action: str, extra: Optional[List[str]] = None) -> List[str]:
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", action, self.name,
+            f"--zone={self.zone}",
+        ]
+        if action == "create":
+            cmd += [
+                f"--accelerator-type={self.accelerator_type}",
+                f"--version={self.version}",
+            ]
+        return cmd + (extra or [])
+
+    def _run(self, action: str, extra: Optional[List[str]] = None) -> str:
+        cmd = self._command(action, extra)
+        if shutil.which("gcloud") is None:
+            raise RuntimeError(
+                "gcloud CLI not available; run manually:\n  " + " ".join(cmd)
+            )
+        out = subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return out.stdout
+
+    def create(self) -> str:
+        return self._run("create")
+
+    def delete(self) -> str:
+        return self._run("delete", ["--quiet"])
+
+    def describe(self) -> str:
+        return self._run("describe")

@@ -1,0 +1,110 @@
+"""Object-store data plumbing (reference: deeplearning4j-aws s3/uploader/
+S3Uploader.java, s3/reader/BaseS3DataSetIterator.java).
+
+Cloud clients are NOT baked into this image, so all classes gate on their
+SDK at construction (boto3 for s3://, google-cloud-storage for gs:// — the
+TPU-native home). The iterator surface matches the rest of the datasets
+tier so object-store-resident corpora drop into fit() unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional
+
+
+def _client_for(scheme: str):
+    if scheme == "s3":
+        try:
+            import boto3  # noqa: PLC0415
+        except ImportError as e:
+            raise ImportError(
+                "boto3 is required for s3:// paths (not in this image); "
+                "install it or use local files"
+            ) from e
+        return ("s3", boto3.client("s3"))
+    if scheme == "gs":
+        try:
+            from google.cloud import storage  # noqa: PLC0415
+        except ImportError as e:
+            raise ImportError(
+                "google-cloud-storage is required for gs:// paths (not in "
+                "this image); install it or use local files"
+            ) from e
+        return ("gs", storage.Client())
+    raise ValueError(f"Unsupported scheme '{scheme}' (use s3:// or gs://)")
+
+
+def _split_url(url: str):
+    scheme, rest = url.split("://", 1)
+    bucket, _, key = rest.partition("/")
+    return scheme, bucket, key
+
+
+class S3Uploader:
+    """reference: s3/uploader/S3Uploader.java (multi-part upload of models/
+    datasets). upload(local_path, 's3://bucket/key' or 'gs://...')."""
+
+    def upload(self, local_path: str, url: str) -> None:
+        scheme, bucket, key = _split_url(url)
+        kind, client = _client_for(scheme)
+        if kind == "s3":
+            client.upload_file(local_path, bucket, key)
+        else:
+            client.bucket(bucket).blob(key).upload_from_filename(local_path)
+
+    def upload_directory(self, local_dir: str, url_prefix: str) -> List[str]:
+        uploaded = []
+        for root, _, files in os.walk(local_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                rel = os.path.relpath(p, local_dir)
+                target = url_prefix.rstrip("/") + "/" + rel.replace(os.sep, "/")
+                self.upload(p, target)
+                uploaded.append(target)
+        return uploaded
+
+
+class S3Downloader:
+    def download(self, url: str, local_path: str) -> str:
+        scheme, bucket, key = _split_url(url)
+        kind, client = _client_for(scheme)
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        if kind == "s3":
+            client.download_file(bucket, key, local_path)
+        else:
+            client.bucket(bucket).blob(key).download_to_filename(local_path)
+        return local_path
+
+    def list_keys(self, url_prefix: str) -> List[str]:
+        scheme, bucket, prefix = _split_url(url_prefix)
+        kind, client = _client_for(scheme)
+        if kind == "s3":
+            resp = client.list_objects_v2(Bucket=bucket, Prefix=prefix)
+            return [o["Key"] for o in resp.get("Contents", [])]
+        return [b.name for b in client.bucket(bucket).list_blobs(prefix=prefix)]
+
+
+class BaseS3DataSetIterator:
+    """Stream object-store keys as local files (reference:
+    s3/reader/BaseS3DataSetIterator.java); subclasses/callers parse each
+    downloaded file into DataSets (e.g. via CSVRecordReader)."""
+
+    def __init__(self, url_prefix: str, cache_dir: Optional[str] = None):
+        self.url_prefix = url_prefix
+        self.cache_dir = cache_dir or os.path.join(
+            os.path.expanduser("~/.dl4j-tpu"), "s3cache"
+        )
+        self._downloader = S3Downloader()
+        self._keys = self._downloader.list_keys(url_prefix)
+
+    def __iter__(self) -> Iterator[str]:
+        scheme, bucket, _ = _split_url(self.url_prefix)
+        for key in self._keys:
+            local = os.path.join(self.cache_dir, key.replace("/", "_"))
+            if not os.path.exists(local):
+                self._downloader.download(f"{scheme}://{bucket}/{key}", local)
+            yield local
+
+    def __len__(self) -> int:
+        return len(self._keys)

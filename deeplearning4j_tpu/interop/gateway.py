@@ -16,13 +16,16 @@ from __future__ import annotations
 
 import base64
 import io
-import json
 import socket
-import struct
 import threading
 from typing import Dict, Optional
 
 import numpy as np
+
+from ..utils.netio import (
+    recv_json_frame as _recv_frame,
+    send_json_frame as _send_frame,
+)
 
 
 def _encode_array(a: np.ndarray) -> str:
@@ -35,28 +38,6 @@ def _decode_array(s: str) -> np.ndarray:
     return np.load(io.BytesIO(base64.b64decode(s)), allow_pickle=False)
 
 
-def _send_frame(sock: socket.socket, obj: dict) -> None:
-    payload = json.dumps(obj).encode()
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
-    header = b""
-    while len(header) < 4:
-        chunk = sock.recv(4 - len(header))
-        if not chunk:
-            return None
-        header += chunk
-    (n,) = struct.unpack(">I", header)
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return json.loads(buf)
-
-
 class GatewayServer:
     """Entry point (reference: DeepLearning4jEntryPoint.java).
 
@@ -67,6 +48,10 @@ class GatewayServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._models: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # per-model locks: concurrent sessions hitting the same model_id
+        # serialize their fit/predict/evaluate (the Py4J reference entry
+        # point is effectively single-threaded per model)
+        self._model_locks: Dict[str, threading.Lock] = {}
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -130,10 +115,18 @@ class GatewayServer:
             net = MultiLayerNetwork(conf).init()
             with self._lock:
                 self._models[req["model_id"]] = net
+                self._model_locks[req["model_id"]] = threading.Lock()
             return {"ok": True, "num_params": net.num_params()}
-        net = self._models.get(req.get("model_id", ""))
+        model_id = req.get("model_id", "")
+        with self._lock:
+            net = self._models.get(model_id)
+            model_lock = self._model_locks.get(model_id)
         if net is None:
             raise KeyError(f"unknown model_id '{req.get('model_id')}'")
+        with model_lock:
+            return self._dispatch_model_op(op, req, net, model_id)
+
+    def _dispatch_model_op(self, op: str, req: dict, net, model_id: str) -> dict:
         if op == "fit":
             from ..datasets.iterators import DataSet  # noqa: PLC0415
 
@@ -152,7 +145,8 @@ class GatewayServer:
             return {"ok": True, "score": float(score)}
         if op == "close":
             with self._lock:
-                self._models.pop(req["model_id"], None)
+                self._models.pop(model_id, None)
+                self._model_locks.pop(model_id, None)
             return {"ok": True}
         raise ValueError(f"unknown op '{op}'")
 

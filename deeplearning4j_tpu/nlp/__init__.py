@@ -26,6 +26,15 @@ from .sequence_vectors import Sequence, SequenceVectors
 from .word2vec import Word2Vec
 from .paragraph_vectors import ParagraphVectors
 from .glove import Glove, AbstractCoOccurrences
+from .stopwords import STOP_WORDS
+from .tokenization_plugins import JapaneseTokenizerFactory, KoreanTokenizerFactory
+from .vectorizers import (
+    BagOfWordsVectorizer,
+    InvertedIndex,
+    TfidfVectorizer,
+    windows,
+)
+from .model_iterators import CnnSentenceDataSetIterator, Word2VecDataSetIterator
 from .serialization import (
     write_word_vectors,
     load_txt_vectors,
@@ -36,6 +45,9 @@ from .serialization import (
 )
 
 __all__ = [
+    "STOP_WORDS", "JapaneseTokenizerFactory", "KoreanTokenizerFactory",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex", "windows",
+    "CnnSentenceDataSetIterator", "Word2VecDataSetIterator",
     "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",
     "NGramTokenizerFactory", "TokenPreProcess", "CommonPreprocessor",
     "EndingPreProcessor",

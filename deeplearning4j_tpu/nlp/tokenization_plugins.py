@@ -1,0 +1,80 @@
+"""CJK tokenizer-factory plugins.
+
+Reference (SURVEY.md §2.5): deeplearning4j-nlp-japanese vendors Kuromoji
+(~20k LoC morphological analyzer) and deeplearning4j-nlp-korean wraps
+KoreanAnalyzer — both exposed ONLY as TokenizerFactory plugins. The
+TPU-native build keeps the same plugin seam with lightweight script-aware
+segmenters: dictionary-driven morphological analysis can be dropped in by
+implementing TokenizerFactory (e.g. over fugashi/mecab where available),
+while these defaults give correct script-run segmentation without vendored
+dictionaries.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import List, Optional
+
+from .tokenization import TokenPreProcess, Tokenizer, TokenizerFactory
+
+
+def _char_class(ch: str) -> str:
+    code = ord(ch)
+    if 0x3040 <= code <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= code <= 0x30FF or 0x31F0 <= code <= 0x31FF:
+        return "katakana"
+    if 0x4E00 <= code <= 0x9FFF or 0x3400 <= code <= 0x4DBF:
+        return "kanji"
+    if 0xAC00 <= code <= 0xD7A3 or 0x1100 <= code <= 0x11FF:
+        return "hangul"
+    if ch.isspace():
+        return "space"
+    if unicodedata.category(ch).startswith("P"):
+        return "punct"
+    return "latin"
+
+
+def _script_runs(text: str, split_classes) -> List[str]:
+    """Split into runs of uniform character class; drop space/punct runs."""
+    tokens: List[str] = []
+    cur, cur_cls = [], None
+    for ch in text:
+        cls = _char_class(ch)
+        if cls != cur_cls and cur:
+            tokens.append(("".join(cur), cur_cls))
+            cur = []
+        cur.append(ch)
+        cur_cls = cls
+    if cur:
+        tokens.append(("".join(cur), cur_cls))
+    return [t for t, c in tokens if c not in ("space", "punct")]
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Script-run segmentation for Japanese (reference plugin:
+    JapaneseTokenizerFactory over Kuromoji). Hiragana/katakana/kanji/latin
+    runs become tokens — the useful granularity for embedding models without
+    a morphological dictionary."""
+
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None):
+        self.pre_processor = pre_processor
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(_script_runs(text, None), self.pre_processor)
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Korean segmentation (reference plugin: KoreanTokenizerFactory over
+    KoreanAnalyzer): whitespace-delimited eojeol, with non-hangul script
+    runs split out."""
+
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None):
+        self.pre_processor = pre_processor
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for chunk in text.split():
+            runs = _script_runs(chunk, None)
+            tokens.extend(runs)
+        return Tokenizer(tokens, self.pre_processor)

@@ -1,0 +1,57 @@
+"""Center-loss output layer (reference: nn/layers/training/
+CenterLossOutputLayer.java + CenterLossParamInitializer).
+
+Loss = primary loss + (lambda/2)·mean ||f - c_{y}||²  where f is the input
+feature vector and c_y the running class center. As in the reference, the
+centers live IN the parameter pytree (CenterLossParamInitializer adds a
+[numClasses, nIn] CENTER_KEY matrix); unlike the reference's hand-written
+alpha-EMA update, autodiff produces the center gradient lambda·(c_y - f)
+directly, so the optimizer's step plays the alpha role — same fixed point
+(centers converge to class feature means), one less bespoke update rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from ..losses import get_loss
+from .base import Params, maybe_dropout, register_layer
+from .dense import OutputLayer
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """reference: conf/layers/CenterLossOutputLayer.java (alpha, lambda)."""
+
+    alpha: float = 0.05   # kept for config parity; see module docstring
+    lambda_: float = 2e-4
+
+    def init_params(self, key: jax.Array, input_type: InputType) -> Params:
+        p = super().init_params(key, input_type)
+        n_in = input_type.flat_size()
+        p["centers"] = jnp.zeros((self.n_out, n_in), jnp.result_type(float))
+        return p
+
+    def compute_loss(self, params, x, labels, mask=None, *, train=False,
+                     rng: Optional[jax.Array] = None):
+        x = maybe_dropout(x, self.dropout, train, rng)
+        preout = self.pre_output(
+            {k: v for k, v in params.items() if k != "centers"}, x
+        )
+        primary = get_loss(self.loss)(labels, preout, self.activation, mask)
+        # squared distance to each example's class center
+        centers_y = labels @ params["centers"]  # one-hot pick, MXU-friendly
+        dist = jnp.sum((x - centers_y) ** 2, axis=-1)
+        if mask is not None:
+            m = mask if mask.ndim == dist.ndim else mask[..., 0]
+            dist = dist * m
+            denom = jnp.maximum(m.sum(), 1.0)
+        else:
+            denom = dist.shape[0]
+        return primary + 0.5 * self.lambda_ * jnp.sum(dist) / denom

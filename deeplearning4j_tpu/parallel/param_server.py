@@ -16,31 +16,16 @@ uint64 length + float32 payload. No pickle — fixed binary frames only.
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 from typing import List, Optional
 
 import numpy as np
 
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
-
-
-def _send_array(sock: socket.socket, arr: np.ndarray) -> None:
-    payload = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
-    sock.sendall(struct.pack(">Q", len(payload)) + payload)
-
-
-def _recv_array(sock: socket.socket) -> np.ndarray:
-    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
-    return np.frombuffer(_recv_exact(sock, n), dtype=np.float32).copy()
+from ..utils.netio import (
+    recv_array as _recv_array,
+    recv_exact as _recv_exact,
+    send_array as _send_array,
+)
 
 
 class ParameterServer:

@@ -37,9 +37,21 @@ class QueueSource(RecordSource):
     def __init__(self, maxsize: int = 1024):
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
 
-    def put(self, features, label=None) -> None:
-        self._q.put((np.asarray(features, np.float32),
-                     None if label is None else np.asarray(label, np.float32)))
+    def put(self, features, label=None, timeout: float = 30.0) -> None:
+        """Bounded put: raises rather than blocking forever when the consumer
+        (pipeline pump) has died — see StreamingPipeline.alive."""
+        try:
+            self._q.put(
+                (np.asarray(features, np.float32),
+                 None if label is None else np.asarray(label, np.float32)),
+                timeout=timeout,
+            )
+        except queue.Full:
+            raise RuntimeError(
+                "QueueSource full after "
+                f"{timeout}s — is the StreamingPipeline stopped or dead? "
+                "(check pipeline.alive / pipeline.raise_if_failed())"
+            ) from None
 
     def poll(self, timeout: float = 0.1):
         try:
@@ -145,8 +157,17 @@ class StreamingPipeline:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.source.close()
+        self.raise_if_failed()
+
+    @property
+    def alive(self) -> bool:
+        """False once the pump thread exited (route error or stop())."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def raise_if_failed(self) -> None:
         if self._error is not None:
-            raise self._error
+            err, self._error = self._error, None
+            raise err
 
     def __enter__(self):
         return self.start()
@@ -163,6 +184,11 @@ class StreamingPipeline:
                 rec = self.source.poll(timeout=0.05)
                 now = time.monotonic()
                 if rec is not None:
+                    # labelled/unlabelled records never share a micro-batch:
+                    # flush the current one at a label-presence boundary
+                    if buf and (rec[1] is None) != (buf[0][1] is None):
+                        self._flush(buf)
+                        buf, deadline = [], None
                     buf.append(rec)
                     if deadline is None:
                         deadline = now + self.linger
@@ -171,7 +197,7 @@ class StreamingPipeline:
                     buf, deadline = [], None
             if buf:
                 self._flush(buf)
-        except BaseException as e:  # surfaced on stop()
+        except BaseException as e:  # surfaced on stop()/raise_if_failed()
             self._error = e
 
     def _flush(self, buf) -> None:

@@ -1,0 +1,49 @@
+"""Socket framing helpers shared by the network tiers (parameter server,
+keras gateway): read-exactly-n plus length-prefixed array/JSON frames."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def send_array(sock: socket.socket, arr: np.ndarray) -> None:
+    payload = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def recv_array(sock: socket.socket) -> np.ndarray:
+    (n,) = struct.unpack(">Q", recv_exact(sock, 8))
+    return np.frombuffer(recv_exact(sock, n), dtype=np.float32).copy()
+
+
+def send_json_frame(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_json_frame(sock: socket.socket) -> Optional[dict]:
+    """None on orderly close before/inside a frame."""
+    try:
+        header = recv_exact(sock, 4)
+    except ConnectionError:
+        return None
+    (n,) = struct.unpack(">I", header)
+    try:
+        return json.loads(recv_exact(sock, n))
+    except ConnectionError:
+        return None

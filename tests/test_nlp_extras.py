@@ -1,0 +1,121 @@
+"""NLP extras tests: vectorizers, inverted index, moving windows, CJK
+tokenizer plugins, CNN-sentence / Word2Vec model iterators."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CnnSentenceDataSetIterator,
+    InvertedIndex,
+    JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
+    STOP_WORDS,
+    TfidfVectorizer,
+    Word2Vec,
+    Word2VecDataSetIterator,
+    windows,
+)
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs are animals",
+]
+
+
+def test_bag_of_words_counts():
+    v = BagOfWordsVectorizer(stop_words=STOP_WORDS)
+    out = v.fit_transform(DOCS)
+    assert out.shape == (3, v.vocab_size)
+    assert "the" not in v.vocab  # stop word removed
+    j = v.vocab["sat"]
+    np.testing.assert_allclose(out[:, j], [1, 1, 0])
+
+
+def test_tfidf_downweights_common_terms():
+    v = TfidfVectorizer()
+    out = v.fit_transform(DOCS)
+    # "the" appears in 2/3 docs; "cat" in 1/3 → idf(cat) > idf(the)
+    assert v.idf("cat") > v.idf("the") > 0
+    assert out[0, v.vocab["cat"]] > 0
+    # word in every doc of a 1-doc corpus has idf 0
+    v2 = TfidfVectorizer().fit(["x x x"])
+    assert v2.idf("x") == 0.0
+
+
+def test_inverted_index_positions_and_search():
+    idx = InvertedIndex()
+    for d in DOCS:
+        idx.add_document(d)
+    assert idx.documents("sat") == [0, 1]
+    assert idx.positions("the", 0) == [0, 4]
+    assert idx.search("sat", "dog") == [1]
+    assert idx.search("sat", "animals") == []
+    assert idx.num_documents() == 3
+
+
+def test_moving_windows():
+    w = windows(["a", "b", "c", "d"], window_size=3)
+    assert len(w) == 4
+    assert w[0] == ["<PAD>", "a", "b"]
+    assert w[1] == ["a", "b", "c"]
+    assert w[-1] == ["c", "d", "<PAD>"]
+
+
+def test_japanese_tokenizer_script_runs():
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私はJAXが好きです。").get_tokens()
+    # kanji/hiragana/latin runs split; punctuation dropped
+    assert "JAX" in toks
+    assert "私" in toks
+    assert "。" not in "".join(toks)
+
+
+def test_korean_tokenizer():
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("안녕하세요 JAX 세계!").get_tokens()
+    assert "안녕하세요" in toks
+    assert "JAX" in toks
+    assert "!" not in toks
+
+
+def _tiny_word2vec():
+    sentences = [
+        "cat sat mat", "dog sat log", "cat dog play", "mat log flat",
+    ] * 10
+    w2v = Word2Vec(layer_size=8, min_word_frequency=1, seed=1,
+                   epochs=1, negative=2, use_hs=False, window=2)
+    w2v.fit_sentences(sentences)
+    return w2v
+
+
+def test_cnn_sentence_iterator_shapes():
+    w2v = _tiny_word2vec()
+    data = [("cat sat mat", "pets"), ("dog sat log", "pets"),
+            ("mat log flat", "things"), ("cat dog play", "pets")]
+    it = CnnSentenceDataSetIterator(data, w2v, batch=2, max_length=5,
+                                    format="cnn")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].features.shape == (2, 5, 8, 1)
+    assert batches[0].labels.shape == (2, 2)
+    # rnn format carries the mask
+    it2 = CnnSentenceDataSetIterator(data, w2v, batch=4, max_length=5,
+                                     format="rnn")
+    ds = next(iter(it2))
+    assert ds.features.shape == (4, 5, 8)
+    np.testing.assert_allclose(ds.features_mask.sum(axis=1), [3, 3, 3, 3])
+
+
+def test_word2vec_dataset_iterator_label_at_last_step():
+    w2v = _tiny_word2vec()
+    data = [("cat sat mat", "a"), ("dog sat", "b")]
+    it = Word2VecDataSetIterator(data, w2v, batch=2, max_length=4)
+    ds = next(iter(it))
+    assert ds.labels.shape == (2, 4, 2)
+    # label mass sits exactly at the last real token
+    np.testing.assert_allclose(ds.labels_mask[0], [0, 0, 1, 0])
+    np.testing.assert_allclose(ds.labels_mask[1], [0, 1, 0, 0])
+    np.testing.assert_allclose(ds.labels[0, 2], [1, 0])
+    np.testing.assert_allclose(ds.labels[1, 1], [0, 1])

@@ -192,3 +192,86 @@ def test_kafka_source_gated():
 
     with pytest.raises(ImportError, match="kafka"):
         KafkaSource("topic", deserializer=lambda b: (b, None))
+
+
+def test_streaming_route_error_surfaces_and_put_does_not_hang():
+    from deeplearning4j_tpu.streaming import QueueSource, Route, StreamingPipeline
+
+    class Boom(Route):
+        def on_batch(self, features, labels):
+            raise RuntimeError("route exploded")
+
+    source = QueueSource(maxsize=4)
+    pipeline = StreamingPipeline(source, [Boom()], batch=1, linger=0.05)
+    pipeline.start()
+    source.put(np.ones(2), np.ones(1))
+    deadline = time.time() + 10
+    while pipeline.alive and time.time() < deadline:
+        time.sleep(0.05)
+    assert not pipeline.alive
+    # producer sees a bounded error, not a deadlock
+    with pytest.raises(RuntimeError, match="pipeline"):
+        for _ in range(10):
+            source.put(np.ones(2), np.ones(1), timeout=0.1)
+    with pytest.raises(RuntimeError, match="route exploded"):
+        pipeline.stop()
+
+
+def test_streaming_mixed_label_batches_split():
+    from deeplearning4j_tpu.streaming import QueueSource, Route, StreamingPipeline
+
+    class Collect(Route):
+        def __init__(self):
+            self.batches = []
+
+        def on_batch(self, features, labels):
+            self.batches.append(labels is not None)
+
+    source = QueueSource()
+    route = Collect()
+    with StreamingPipeline(source, [route], batch=8, linger=0.2):
+        source.put(np.ones(2), np.ones(1))
+        source.put(np.ones(2))  # unlabeled → boundary flush
+        source.put(np.ones(2), np.ones(1))
+        time.sleep(1.0)
+    assert route.batches == [True, False, True]
+
+
+def test_gateway_concurrent_fit_serialized():
+    from deeplearning4j_tpu.interop import GatewayClient, GatewayServer
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Dense", "config": {
+                "name": "d1", "output_dim": 4, "activation": "softmax",
+                "bias": True, "batch_input_shape": [None, 6]}},
+        ],
+    }
+    tc = {"loss": "categorical_crossentropy",
+          "optimizer_config": {"class_name": "SGD", "config": {"lr": 0.05}}}
+    feats, labels = _toy_data(n=64, n_in=6, n_classes=4)
+    with GatewayServer() as srv:
+        c0 = GatewayClient(srv.host, srv.port)
+        c0.create_model("m", model_config, tc)
+        errors = []
+
+        def hammer():
+            c = GatewayClient(srv.host, srv.port)
+            try:
+                for _ in range(5):
+                    c.fit("m", feats, labels)
+                    c.predict("m", feats[:4])
+            except Exception as e:
+                errors.append(e)
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        assert np.isfinite(c0.evaluate("m", feats, labels))
+        c0.close()

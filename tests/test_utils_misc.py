@@ -1,0 +1,166 @@
+"""Misc util tier tests: math/Viterbi/time-series, collections, disk queue,
+center loss, distributed word2vec, gated cloud utils."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils.collections import (
+    AsyncIterator,
+    Counter,
+    CounterMap,
+    DiskBasedQueue,
+    MagicQueue,
+)
+from deeplearning4j_tpu.utils.mathutil import (
+    entropy,
+    last_time_step,
+    log_add,
+    log_add_all,
+    moving_average,
+    normalize,
+    pad_time_series,
+    viterbi,
+)
+
+
+def test_counter_and_countermap():
+    c = Counter("aabbbc")
+    assert c.arg_max() == "b"
+    assert c.total_count() == 6
+    c.normalize()
+    assert abs(c["b"] - 0.5) < 1e-12
+    c.keep_top_n(2)
+    assert set(c) == {"a", "b"}
+
+    cm = CounterMap()
+    cm.increment_count("x", "y", 2.0)
+    cm.increment_count("x", "z")
+    assert cm.get_count("x", "y") == 2.0
+    assert cm.total_count() == 3.0
+    cm.normalize()
+    assert abs(cm.get_count("x", "y") - 2 / 3) < 1e-12
+
+
+def test_disk_based_queue_spills_and_preserves_order(tmp_path):
+    q = DiskBasedQueue(memory_items=3, dir=str(tmp_path))
+    for i in range(10):
+        q.add({"i": i})
+    assert len(q) == 10
+    out = [q.poll()["i"] for _ in range(10)]
+    assert out == list(range(10))
+    assert q.is_empty()
+    with pytest.raises(IndexError):
+        q.poll()
+
+
+def test_magic_queue_round_robin():
+    q = MagicQueue(n_lanes=3)
+    for i in range(6):
+        q.add(i)
+    assert q.poll(0) == 0 and q.poll(0) == 3
+    assert q.poll(1) == 1 and q.poll(2) == 2
+    assert q.size() == 2
+
+
+def test_async_iterator_streams_and_propagates_errors():
+    assert list(AsyncIterator(range(100), queue_size=4)) == list(range(100))
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    with pytest.raises(RuntimeError, match="producer failed"):
+        list(AsyncIterator(boom()))
+
+
+def test_log_add_and_entropy():
+    a, b = math.log(0.3), math.log(0.2)
+    assert abs(log_add(a, b) - math.log(0.5)) < 1e-12
+    assert abs(log_add_all([math.log(0.25)] * 4)) < 1e-12
+    assert abs(entropy([0.5, 0.5]) - math.log(2)) < 1e-12
+
+
+def test_viterbi_decodes_known_path():
+    # 2-state HMM where state flips are unlikely; emissions identify states
+    log_start = np.log([0.9, 0.1])
+    log_trans = np.log([[0.9, 0.1], [0.1, 0.9]])
+    log_emit = np.log(
+        [[0.9, 0.1], [0.9, 0.1], [0.1, 0.9], [0.1, 0.9]]
+    )
+    path, score = viterbi(log_start, log_trans, log_emit)
+    assert path == [0, 0, 1, 1]
+    assert score < 0
+
+
+def test_time_series_utils():
+    x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    padded, mask = pad_time_series(x, 5, align_end=True)
+    assert padded.shape == (2, 5, 2)
+    np.testing.assert_allclose(mask[0], [0, 0, 1, 1, 1])
+    np.testing.assert_allclose(last_time_step(padded, mask), x[:, -1])
+    np.testing.assert_allclose(moving_average([1, 2, 3, 4], 2), [1.5, 2.5, 3.5])
+    np.testing.assert_allclose(normalize([2, 4, 6]), [0, 0.5, 1.0])
+
+
+def test_center_loss_output_layer_trains_and_tightens_clusters():
+    from deeplearning4j_tpu import (
+        DenseLayer, InputType, MultiLayerConfiguration, MultiLayerNetwork,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.nn.layers.center_loss import CenterLossOutputLayer
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    rng = np.random.default_rng(0)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 120)]
+    feats = (labels @ rng.normal(size=(3, 10)) + 0.2 * rng.normal(size=(120, 10))).astype(np.float32)
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=8, activation="relu"),
+            CenterLossOutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                                  lambda_=0.01),
+        ],
+        input_type=InputType.feed_forward(10),
+        updater=UpdaterConfig(updater="adam", learning_rate=5e-3),
+        seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    assert net.params[1]["centers"].shape == (3, 8)
+    s0 = net.score(DataSet(feats, labels))
+    for _ in range(30):
+        net.fit(DataSet(feats, labels))
+    assert net.score(DataSet(feats, labels)) < s0
+    # centers moved off the zero init toward class means
+    assert float(np.abs(np.asarray(net.params[1]["centers"])).sum()) > 0
+    # JSON round-trip keeps the center-loss hyperparams
+    from deeplearning4j_tpu import MultiLayerConfiguration as MLC
+
+    conf2 = MLC.from_json(conf.to_json())
+    assert conf2.layers[1].lambda_ == pytest.approx(0.01)
+
+
+def test_distributed_word2vec_partitioned_averaging():
+    from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+
+    sentences = ["cat sat mat", "dog sat log", "cat dog play",
+                 "mat log flat", "play sat cat"] * 8
+    w2v = DistributedWord2Vec(workers=3, layer_size=8, min_word_frequency=1,
+                              negative=2, use_hs=False, epochs=2, seed=3)
+    w2v.fit(sentences)
+    assert w2v.get_word_vector("cat") is not None
+    assert w2v.has_word("dog")
+    sim = w2v.similarity("cat", "dog")
+    assert -1.0 <= sim <= 1.0
+    near = w2v.words_nearest("cat", top_n=3)
+    assert len(near) == 3
+
+
+def test_cloud_utils_gated():
+    from deeplearning4j_tpu.aws import ClusterSetup, S3Uploader
+
+    with pytest.raises(ImportError, match="boto3"):
+        S3Uploader().upload("/tmp/x", "s3://bucket/key")
+    cs = ClusterSetup("pod1")
+    cmd = cs._command("create")
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
