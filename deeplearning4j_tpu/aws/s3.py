@@ -9,6 +9,7 @@ tier so object-store-resident corpora drop into fit() unchanged.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Iterator, List, Optional
 
@@ -101,7 +102,10 @@ class BaseS3DataSetIterator:
     def __iter__(self) -> Iterator[str]:
         scheme, bucket, _ = _split_url(self.url_prefix)
         for key in self._keys:
-            local = os.path.join(self.cache_dir, key.replace("/", "_"))
+            digest = hashlib.sha1(key.encode()).hexdigest()[:12]
+            local = os.path.join(
+                self.cache_dir, f"{digest}_{os.path.basename(key)}"
+            )
             if not os.path.exists(local):
                 self._downloader.download(f"{scheme}://{bucket}/{key}", local)
             yield local

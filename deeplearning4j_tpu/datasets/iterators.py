@@ -232,54 +232,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self.base.reset()
 
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
-        err: List[BaseException] = []
-        stop = threading.Event()
+        # the producer-thread/sentinel/drain machinery lives once, in
+        # utils.collections.AsyncIterator (the generic reference sibling)
+        from ..utils.collections import AsyncIterator  # noqa: PLC0415
 
-        def producer():
-            try:
-                for ds in self.base:
-                    while not stop.is_set():
-                        try:
-                            q.put(ds, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # surfaced on the consumer side
-                err.append(e)
-            finally:
-                # The sentinel MUST reach the consumer or it blocks forever on
-                # q.get() — so keep retrying while the consumer is alive (it
-                # drains the queue); bail only once stop is set (consumer gone).
-                while not stop.is_set():
-                    try:
-                        q.put(_SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-
-        t = threading.Thread(target=producer, daemon=True, name="async-dataset-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _SENTINEL:
-                    break
-                yield item
-        finally:
-            # Early consumer exit (exception in the train loop, break, GC of the
-            # generator) must not leave the producer blocked on a full queue.
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=5)
-        if err:
-            raise err[0]
+        yield from AsyncIterator(self.base, queue_size=self.queue_size)
 
 
 def as_iterator(data) -> Iterable[DataSet]:

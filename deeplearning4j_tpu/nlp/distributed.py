@@ -80,8 +80,12 @@ class DistributedWord2Vec(Word2Vec):
             min_learning_rate=self.min_learning_rate,
             subsampling=self.subsampling, batch_size=self.batch_size,
             seed=self.seed,
+            elements_algo=self.elements_algo,
+            sequence_algo=self.sequence_algo,
+            train_elements=self.train_elements,
             tokenizer_factory=self.tokenizer_factory,
         )
+        worker._kernels = self._kernels  # share jitted step cache across shards
         worker.vocab = self.vocab
         worker._codes_arr = self._codes_arr
         worker._points_arr = self._points_arr

@@ -133,7 +133,10 @@ class Word2VecDataSetIterator(DataSetIterator):
             labels_seq = np.zeros((B, T, n_labels), np.float32)
             labels_mask = np.zeros((B, T), np.float32)
             for i in range(B):
-                last = max(int(ds.features_mask[i].sum()) - 1, 0)
+                n_real = int(ds.features_mask[i].sum())
+                if n_real == 0:
+                    continue  # all-OOV sentence: contributes no loss
+                last = n_real - 1
                 labels_seq[i, last] = ds.labels[i]
                 labels_mask[i, last] = 1.0
             yield DataSet(ds.features, labels_seq,

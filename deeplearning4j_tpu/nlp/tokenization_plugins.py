@@ -35,7 +35,7 @@ def _char_class(ch: str) -> str:
     return "latin"
 
 
-def _script_runs(text: str, split_classes) -> List[str]:
+def _script_runs(text: str) -> List[str]:
     """Split into runs of uniform character class; drop space/punct runs."""
     tokens: List[str] = []
     cur, cur_cls = [], None
@@ -61,7 +61,7 @@ class JapaneseTokenizerFactory(TokenizerFactory):
         self.pre_processor = pre_processor
 
     def create(self, text: str) -> Tokenizer:
-        return Tokenizer(_script_runs(text, None), self.pre_processor)
+        return Tokenizer(_script_runs(text), self.pre_processor)
 
 
 class KoreanTokenizerFactory(TokenizerFactory):
@@ -75,6 +75,6 @@ class KoreanTokenizerFactory(TokenizerFactory):
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
         for chunk in text.split():
-            runs = _script_runs(chunk, None)
+            runs = _script_runs(chunk)
             tokens.extend(runs)
         return Tokenizer(tokens, self.pre_processor)

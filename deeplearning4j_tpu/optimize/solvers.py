@@ -1,0 +1,228 @@
+"""Batch optimizers: Solver facade, line search, LBFGS, conjugate gradient.
+
+Reference (SURVEY.md §2.1 "Training loop (Solver)"): optimize/Solver.java
+builds a ConvexOptimizer — StochasticGradientDescent.java:51-72 (the default,
+covered by our optax-based per-batch path), plus the line-search family:
+LBFGS.java, ConjugateGradient.java, LineGradientDescent.java, all stepping
+through BackTrackLineSearch.java (Armijo backtracking, 354 LoC).
+
+TPU-native design: the objective is the net's pure ``loss_fn`` on a fixed
+batch; parameters flatten once via ``ravel_pytree``; value+gradient is ONE
+jitted XLA call, and the optimizer logic (two-loop recursion, β_PR, Armijo
+loop) runs host-side between device calls — the standard shape for
+full-batch second-order-ish methods on accelerators.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def back_track_line_search(
+    f: Callable[[np.ndarray], float],
+    x: np.ndarray,
+    fx: float,
+    grad: np.ndarray,
+    direction: np.ndarray,
+    initial_step: float = 1.0,
+    c1: float = 1e-4,
+    rho: float = 0.5,
+    max_iterations: int = 20,
+    min_step: float = 1e-12,
+) -> Tuple[float, float]:
+    """Armijo backtracking (reference: BackTrackLineSearch.optimize).
+
+    Returns (step, f(x + step·direction)); step 0.0 when no decrease found.
+    """
+    slope = float(np.dot(grad, direction))
+    if slope >= 0:
+        return 0.0, fx  # not a descent direction
+    step = initial_step
+    for _ in range(max_iterations):
+        fnew = f(x + step * direction)
+        if np.isfinite(fnew) and fnew <= fx + c1 * step * slope:
+            return step, float(fnew)
+        step *= rho
+        if step < min_step:
+            break
+    return 0.0, fx
+
+
+class _BatchOptimizer:
+    """Shared machinery: flatten params, jit value_and_grad on a batch."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5):
+        self.max_iterations = int(max_iterations)
+        self.tolerance = float(tolerance)
+        self.score_history: List[float] = []
+
+    def _setup(self, net, x, y):
+        from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+
+        net.init()
+        flat0, unravel = ravel_pytree(net.params)
+
+        @jax.jit
+        def vg(flat):
+            loss, grads = jax.value_and_grad(
+                lambda p: net.loss_fn(p, x, y, train=False)
+            )(unravel(flat))
+            gflat, _ = ravel_pytree(grads)
+            return loss, gflat
+
+        def value(flat_np):
+            return float(vg(jnp.asarray(flat_np, jnp.float32))[0])
+
+        def value_grad(flat_np):
+            loss, g = vg(jnp.asarray(flat_np, jnp.float32))
+            return float(loss), np.asarray(g, np.float64)
+
+        return np.asarray(flat0, np.float64), unravel, value, value_grad
+
+    def _finish(self, net, flat, unravel):
+        net.init(params=jax.tree_util.tree_map(
+            lambda a, b: jnp.asarray(b, a.dtype),
+            net.params, unravel(jnp.asarray(flat, jnp.float32))
+        ), force=True)
+
+    def optimize(self, net, x, y) -> float:
+        raise NotImplementedError
+
+
+class LineGradientDescent(_BatchOptimizer):
+    """Steepest descent + Armijo line search (reference: LineGradientDescent.java)."""
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, value_grad = self._setup(net, x, y)
+        fx, g = value_grad(flat)
+        for _ in range(self.max_iterations):
+            self.score_history.append(fx)
+            step, fnew = back_track_line_search(value, flat, fx, g, -g)
+            if step > 0.0 and fnew < fx:  # apply the final accepted step too
+                flat = flat + step * (-g)
+            if step == 0.0 or fx - fnew < self.tolerance:
+                fx = min(fx, fnew)
+                break
+            fx, g = value_grad(flat)
+        self._finish(net, flat, unravel)
+        return fx
+
+
+class ConjugateGradient(_BatchOptimizer):
+    """Nonlinear CG, Polak-Ribière with automatic restart (reference:
+    ConjugateGradient.java)."""
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, value_grad = self._setup(net, x, y)
+        fx, g = value_grad(flat)
+        d = -g
+        for _ in range(self.max_iterations):
+            self.score_history.append(fx)
+            step, fnew = back_track_line_search(value, flat, fx, g, d)
+            if step > 0.0 and fnew < fx:  # apply the final accepted step too
+                flat = flat + step * d
+            if step == 0.0 or fx - fnew < self.tolerance:
+                fx = min(fx, fnew)
+                break
+            fx, g_new = value_grad(flat)
+            beta = float(np.dot(g_new, g_new - g) / max(np.dot(g, g), 1e-30))
+            beta = max(beta, 0.0)  # PR+ restart
+            d = -g_new + beta * d
+            g = g_new
+        self._finish(net, flat, unravel)
+        return fx
+
+
+class LBFGS(_BatchOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference: LBFGS.java,
+    default history m=4)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 m: int = 4):
+        super().__init__(max_iterations, tolerance)
+        self.m = int(m)
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, value_grad = self._setup(net, x, y)
+        fx, g = value_grad(flat)
+        s_hist: List[np.ndarray] = []
+        y_hist: List[np.ndarray] = []
+        for it in range(self.max_iterations):
+            self.score_history.append(fx)
+            # two-loop recursion
+            q = g.copy()
+            alphas = []
+            for s, yv in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / max(np.dot(yv, s), 1e-30)
+                a = rho * np.dot(s, q)
+                alphas.append((a, rho, s, yv))
+                q -= a * yv
+            if y_hist:
+                gamma = np.dot(s_hist[-1], y_hist[-1]) / max(
+                    np.dot(y_hist[-1], y_hist[-1]), 1e-30
+                )
+                q *= gamma
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * np.dot(yv, q)
+                q += (a - b) * s
+            d = -q
+            step, fnew = back_track_line_search(
+                value, flat, fx, g, d, initial_step=1.0 if it > 0 else min(
+                    1.0, 1.0 / max(np.linalg.norm(g), 1e-30)
+                ),
+            )
+            flat_new = flat + step * d
+            if step == 0.0 or fx - fnew < self.tolerance:
+                if step > 0.0 and fnew < fx:
+                    flat = flat_new
+                fx = min(fx, fnew)
+                break
+            fx, g_new = value_grad(flat_new)
+            s_hist.append(flat_new - flat)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            flat, g = flat_new, g_new
+        self._finish(net, flat, unravel)
+        return fx
+
+
+_OPTIMIZERS = {
+    "lbfgs": LBFGS,
+    "conjugate_gradient": ConjugateGradient,
+    "line_gradient_descent": LineGradientDescent,
+}
+
+
+class Solver:
+    """Facade (reference: optimize/Solver.java Builder): picks the
+    ConvexOptimizer by algorithm name and runs it on a batch. The
+    "stochastic_gradient_descent" algorithm is the networks' own per-batch
+    optax path (fit()); this class covers the batch/line-search family."""
+
+    def __init__(self, algorithm: str = "lbfgs", max_iterations: int = 100,
+                 tolerance: float = 1e-5, **kwargs):
+        if algorithm not in _OPTIMIZERS:
+            raise ValueError(
+                f"Unknown algorithm '{algorithm}'; available: "
+                f"{sorted(_OPTIMIZERS)} (stochastic gradient descent = net.fit)"
+            )
+        self.optimizer = _OPTIMIZERS[algorithm](
+            max_iterations=max_iterations, tolerance=tolerance, **kwargs
+        )
+
+    def optimize(self, net, data) -> float:
+        from ..datasets.iterators import DataSet  # noqa: PLC0415
+
+        if isinstance(data, tuple):
+            data = DataSet(np.asarray(data[0]), np.asarray(data[1]))
+        return self.optimizer.optimize(net, data.features, data.labels)
+
+    @property
+    def score_history(self) -> List[float]:
+        return self.optimizer.score_history
