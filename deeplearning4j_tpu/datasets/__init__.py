@@ -4,6 +4,7 @@ from .iterators import (
     AsyncDataSetIterator,
     DataSet,
     DataSetIterator,
+    DevicePrefetchIterator,
     ExistingDataSetIterator,
     IteratorDataSetIterator,
     ListDataSetIterator,
@@ -51,7 +52,7 @@ from .normalizers import (
 
 __all__ = [
     "AsyncDataSetIterator", "DataSet", "DataSetIterator",
-    "ExistingDataSetIterator", "IteratorDataSetIterator",
+    "DevicePrefetchIterator", "ExistingDataSetIterator", "IteratorDataSetIterator",
     "ListDataSetIterator", "MultiDataSet", "MultipleEpochsIterator",
     "NumpyDataSetIterator", "SamplingDataSetIterator",
     "CollectionRecordReader", "CollectionSequenceRecordReader",

@@ -239,6 +239,50 @@ class AsyncDataSetIterator(DataSetIterator):
         yield from AsyncIterator(self.base, queue_size=self.queue_size)
 
 
+class DevicePrefetchIterator(DataSetIterator):
+    """Stage each batch on device ONE step ahead of consumption.
+
+    ``jax.device_put`` is asynchronous, so staging batch i+1 while the
+    consumer computes on batch i overlaps the host→HBM transfer with device
+    compute — the device-side complement of AsyncDataSetIterator's host-side
+    prefetch (together they form the reference's AsyncDataSetIterator +
+    GridExecutioner pipeline, SURVEY.md §2.9, TPU-style).
+    """
+
+    prefetch_supported = False  # device staging subsumes host prefetch wrapping
+
+    def __init__(self, base: DataSetIterator, device=None):
+        self.base = base
+        self.device = device
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def reset(self):
+        self.base.reset()
+
+    def _stage(self, ds: DataSet) -> DataSet:
+        import jax  # noqa: PLC0415
+
+        put = (lambda a: jax.device_put(a, self.device)) if self.device else jax.device_put
+        return DataSet(
+            put(ds.features),
+            put(ds.labels),
+            None if ds.features_mask is None else put(ds.features_mask),
+            None if ds.labels_mask is None else put(ds.labels_mask),
+        )
+
+    def __iter__(self):
+        prev: Optional[DataSet] = None
+        for ds in self.base:
+            staged = self._stage(ds)  # async: overlaps with compute on `prev`
+            if prev is not None:
+                yield prev
+            prev = staged
+        if prev is not None:
+            yield prev
+
+
 def as_iterator(data) -> Iterable[DataSet]:
     """Normalize fit() input: (x, y) tuple, DataSet, MultiDataSet, or iterator."""
     if isinstance(data, (DataSet, MultiDataSet)):

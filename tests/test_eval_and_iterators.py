@@ -144,3 +144,22 @@ class TestIterators:
         assert a.num_examples() == 7 and b.num_examples() == 3
         sh = ds.shuffle(seed=3)
         assert sorted(sh.features.ravel()) == list(range(10))
+
+
+def test_device_prefetch_iterator_preserves_stream():
+    import numpy as np
+    from deeplearning4j_tpu.datasets.iterators import (
+        DataSet, DevicePrefetchIterator, ListDataSetIterator,
+    )
+
+    batches = [
+        DataSet(np.full((2, 3), i, np.float32), np.full((2, 1), i, np.float32))
+        for i in range(5)
+    ]
+    it = DevicePrefetchIterator(ListDataSetIterator(batches))
+    out = list(it)
+    assert len(out) == 5
+    for i, ds in enumerate(out):
+        np.testing.assert_allclose(np.asarray(ds.features), i)
+    # re-iterable
+    assert len(list(it)) == 5
