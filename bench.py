@@ -47,7 +47,7 @@ def bench_resnet50(batch: int = 128, steps: int = 30, warmup: int = 2) -> dict:
     )
     key = jax.random.PRNGKey(0)
     p, o, s = net.params, net.opt_state, net.state
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # >=1: binds loss + compiles before timing
         p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
     jax.block_until_ready(loss)
 

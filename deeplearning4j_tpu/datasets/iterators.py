@@ -261,19 +261,28 @@ class DevicePrefetchIterator(DataSetIterator):
     def reset(self):
         self.base.reset()
 
-    def _stage(self, ds: DataSet) -> DataSet:
+    def _stage(self, ds):
         import jax  # noqa: PLC0415
 
         put = (lambda a: jax.device_put(a, self.device)) if self.device else jax.device_put
+
+        def opt(a):
+            return None if a is None else put(a)
+
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet(
+                [put(f) for f in ds.features],
+                [put(l) for l in ds.labels],
+                None if ds.features_masks is None else [opt(m) for m in ds.features_masks],
+                None if ds.labels_masks is None else [opt(m) for m in ds.labels_masks],
+            )
         return DataSet(
-            put(ds.features),
-            put(ds.labels),
-            None if ds.features_mask is None else put(ds.features_mask),
-            None if ds.labels_mask is None else put(ds.labels_mask),
+            put(ds.features), put(ds.labels),
+            opt(ds.features_mask), opt(ds.labels_mask),
         )
 
     def __iter__(self):
-        prev: Optional[DataSet] = None
+        prev = None
         for ds in self.base:
             staged = self._stage(ds)  # async: overlaps with compute on `prev`
             if prev is not None:

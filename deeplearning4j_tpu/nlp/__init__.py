@@ -26,7 +26,9 @@ from .sequence_vectors import Sequence, SequenceVectors
 from .word2vec import Word2Vec
 from .paragraph_vectors import ParagraphVectors
 from .glove import Glove, AbstractCoOccurrences
+from .stemming import PorterStemmer, StemmingPreprocessor
 from .stopwords import STOP_WORDS
+from .distributed import DistributedWord2Vec
 from .tokenization_plugins import JapaneseTokenizerFactory, KoreanTokenizerFactory
 from .vectorizers import (
     BagOfWordsVectorizer,
@@ -45,7 +47,7 @@ from .serialization import (
 )
 
 __all__ = [
-    "STOP_WORDS", "JapaneseTokenizerFactory", "KoreanTokenizerFactory",
+    "STOP_WORDS", "PorterStemmer", "StemmingPreprocessor", "DistributedWord2Vec", "JapaneseTokenizerFactory", "KoreanTokenizerFactory",
     "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex", "windows",
     "CnnSentenceDataSetIterator", "Word2VecDataSetIterator",
     "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",

@@ -14,6 +14,12 @@ from .training_master import (
     SyncAllReduceTrainingMaster,
     ParameterAveragingTrainingMaster,
 )
+from .front_end import MeshComputationGraph, MeshDl4jMultiLayer
+from .param_server import (
+    ParameterServer,
+    ParameterServerClient,
+    ParameterServerParallelWrapper,
+)
 from .ring_attention import all_to_all_attention, attention, ring_attention
 from .sharding import param_shardings, shard_params
 
@@ -27,6 +33,11 @@ __all__ = [
     "TrainingStats",
     "SyncAllReduceTrainingMaster",
     "ParameterAveragingTrainingMaster",
+    "MeshDl4jMultiLayer",
+    "MeshComputationGraph",
+    "ParameterServer",
+    "ParameterServerClient",
+    "ParameterServerParallelWrapper",
     "attention",
     "ring_attention",
     "all_to_all_attention",

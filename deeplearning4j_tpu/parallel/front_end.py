@@ -1,0 +1,55 @@
+"""Distributed training front-ends.
+
+Reference (SURVEY.md §2.4 "Spark front-ends"): SparkDl4jMultiLayer.java (656
+LoC: fit/evaluate/scoring on RDDs through a TrainingMaster) and
+SparkComputationGraph.java. The TPU-native analog wraps a model + a
+TrainingMaster strategy over the device mesh: fit routes through the master
+(sync all-reduce or periodic averaging), evaluation/scoring run on the
+trained replica — the same one-stop surface without a cluster framework in
+the middle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .training_master import SyncAllReduceTrainingMaster, TrainingMaster
+
+
+class MeshDl4jMultiLayer:
+    """reference: spark/impl/multilayer/SparkDl4jMultiLayer.java."""
+
+    def __init__(self, net, training_master: Optional[TrainingMaster] = None):
+        self.net = net
+        self.training_master = training_master or SyncAllReduceTrainingMaster()
+
+    def fit(self, data, epochs: int = 1):
+        """reference: SparkDl4jMultiLayer.fit(JavaRDD<DataSet>)."""
+        self.training_master.execute_training(self.net, data, epochs=epochs)
+        return self.net
+
+    def evaluate(self, data, top_n: int = 1):
+        """reference: SparkDl4jMultiLayer.evaluate → Evaluation."""
+        return self.net.evaluate(data, top_n=top_n)
+
+    def score(self, data) -> float:
+        """reference: SparkDl4jMultiLayer.calculateScore."""
+        from ..datasets.iterators import as_iterator  # noqa: PLC0415
+
+        total, n = 0.0, 0
+        for ds in as_iterator(data):
+            b = ds.num_examples()
+            total += float(self.net.score(ds)) * b
+            n += b
+        return total / max(n, 1)
+
+    def get_network(self):
+        return self.net
+
+    def get_training_master_stats(self):
+        return self.training_master.get_stats()
+
+
+class MeshComputationGraph(MeshDl4jMultiLayer):
+    """reference: spark/impl/graph/SparkComputationGraph.java — identical
+    surface over a ComputationGraph (the master SPI is model-agnostic)."""

@@ -243,8 +243,42 @@ class ParallelWrapper:
                 else:
                     self._fit_periodic(_stack_group(group))
                 group = []
-            # trailing partial group dropped: static shapes for XLA (the
-            # reference blocks at the barrier and processes stragglers)
+            if group:
+                # Trailing partial group. Sync mode can still shard it as one
+                # global batch when the example count divides the data axes;
+                # otherwise (and always in periodic mode, which needs exactly
+                # one batch per replica) it is dropped — warn instead of the
+                # silent drop that made small iterators train zero steps.
+                import warnings  # noqa: PLC0415
+
+                partial = _concat_group(group)
+                if sync and partial.num_examples() % self.workers == 0:
+                    if partial.num_examples() != self.workers * (
+                        group[0].num_examples()
+                    ) and self.iteration > len(group):
+                        warnings.warn(
+                            "ParallelWrapper: trailing partial group trains at "
+                            f"a new global batch shape ({partial.num_examples()} "
+                            "examples) — XLA compiles the train step a second "
+                            "time for this shape",
+                            stacklevel=2,
+                        )
+                    self._fit_sync(partial)
+                elif sync:
+                    warnings.warn(
+                        "ParallelWrapper dropped a trailing partial group: its "
+                        f"{partial.num_examples()} examples do not divide the "
+                        f"{self.workers}-way data sharding; pad the final "
+                        "minibatches or size the epoch accordingly",
+                        stacklevel=2,
+                    )
+                else:
+                    warnings.warn(
+                        f"ParallelWrapper dropped a trailing partial group of "
+                        f"{len(group)} minibatch(es) (periodic mode needs "
+                        f"exactly {self.workers}, one per replica)",
+                        stacklevel=2,
+                    )
         if not sync:
             self._finalize_periodic()
         return self

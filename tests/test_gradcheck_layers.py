@@ -1,0 +1,200 @@
+"""Per-layer-family gradient checks (reference: the gradientcheck/ suites —
+CNNGradientCheckTest, BNGradientCheckTest, LRNGradientCheckTests,
+GlobalPoolingGradientCheckTests, GradientCheckTestsComputationGraph,
+GradientCheckTestsMasking — SURVEY.md §4.1). Autodiff vs central differences
+in float64 on tiny shapes."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    InputType,
+    LocalResponseNormalization,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    SelfAttentionLayer,
+    SubsamplingLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.nn.layers.center_loss import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.utils.gradcheck import gradient_check
+
+RNG = np.random.default_rng(12345)
+
+
+def _check_net(layers, input_type, x, y, train=True, **kw):
+    conf = MultiLayerConfiguration(
+        layers=layers, input_type=input_type,
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1), seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    passed, failures, max_rel = gradient_check(
+        lambda p, xx, yy: net.loss_fn(p, xx, yy, train=train),
+        net.params, np.asarray(x, np.float64), np.asarray(y, np.float64), **kw
+    )
+    assert passed, f"{failures} gradient failures (max rel {max_rel:.3g})"
+
+
+def _labels(n, k, seed=0):
+    return np.eye(k)[np.random.default_rng(seed).integers(0, k, n)]
+
+
+@pytest.mark.parametrize("mode", ["truncate", "same"])
+def test_cnn_gradients(mode):
+    x = RNG.normal(size=(3, 6, 6, 2))
+    _check_net(
+        [
+            ConvolutionLayer(n_out=3, kernel=(3, 3), stride=(1, 1),
+                             convolution_mode=mode, activation="tanh"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            GlobalPoolingLayer(pooling_type="avg"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ],
+        InputType.convolutional(6, 6, 2), x, _labels(3, 2),
+    )
+
+
+def test_batchnorm_train_mode_gradients():
+    x = RNG.normal(size=(4, 5))
+    _check_net(
+        [
+            DenseLayer(n_out=6, activation="identity"),
+            BatchNormalization(),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        InputType.feed_forward(5), x, _labels(4, 3),
+    )
+
+
+def test_lrn_gradients():
+    x = RNG.normal(size=(2, 4, 4, 6))
+    _check_net(
+        [
+            ConvolutionLayer(n_out=6, kernel=(1, 1), activation="sigmoid"),
+            LocalResponseNormalization(n=5),
+            GlobalPoolingLayer(pooling_type="max"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ],
+        InputType.convolutional(4, 4, 6), x, _labels(2, 2),
+    )
+
+
+@pytest.mark.parametrize("cls", [GravesLSTM, GravesBidirectionalLSTM])
+def test_lstm_gradients_including_peepholes(cls):
+    x = RNG.normal(size=(2, 5, 3))
+    y = np.stack([_labels(5, 2, seed=i) for i in range(2)])
+    conf = MultiLayerConfiguration(
+        layers=[
+            cls(n_out=4),
+            RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(3, 5),
+        updater=UpdaterConfig(), seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    # nonzero peepholes so their gradients are exercised
+    p0 = dict(net.params[0])
+    for k in list(p0):
+        if k.endswith(("pF", "pI", "pO")):
+            p0[k] = p0[k] + 0.3
+    net.init(params=(p0,) + tuple(net.params[1:]), force=True)
+    passed, failures, max_rel = gradient_check(
+        lambda p, xx, yy: net.loss_fn(p, xx, yy, train=True),
+        net.params, np.asarray(x, np.float64), np.asarray(y, np.float64),
+    )
+    assert passed, f"{failures} failures (max rel {max_rel:.3g})"
+
+
+def test_masked_rnn_gradients():
+    """reference: GradientCheckTestsMasking — per-step masks in the loss."""
+    x = RNG.normal(size=(2, 4, 3))
+    y = np.stack([_labels(4, 2, seed=9), _labels(4, 2, seed=10)])
+    mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], np.float64)
+    conf = MultiLayerConfiguration(
+        layers=[
+            GravesLSTM(n_out=3),
+            RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(3, 4),
+        updater=UpdaterConfig(), seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    passed, failures, max_rel = gradient_check(
+        lambda p, xx, yy: net.loss_fn(
+            p, xx, yy, train=True, labels_mask=mask, features_mask=mask
+        ),
+        net.params, np.asarray(x, np.float64), np.asarray(y, np.float64),
+    )
+    assert passed, f"{failures} failures (max rel {max_rel:.3g})"
+
+
+def test_attention_gradients():
+    x = RNG.normal(size=(2, 6, 4))
+    y = np.stack([_labels(6, 3, seed=4), _labels(6, 3, seed=5)])
+    _check_net(
+        [
+            SelfAttentionLayer(n_out=8, n_heads=2, causal=True),
+            RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        InputType.recurrent(4, 6), x, y,
+    )
+
+
+def test_center_loss_gradients():
+    x = RNG.normal(size=(4, 5))
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=6, activation="tanh"),
+            CenterLossOutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                                  lambda_=0.1),
+        ],
+        input_type=InputType.feed_forward(5),
+        updater=UpdaterConfig(), seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    # non-zero centers so the distance term has gradients both ways
+    p1 = dict(net.params[1])
+    p1["centers"] = p1["centers"] + RNG.normal(size=p1["centers"].shape) * 0.2
+    net.init(params=(net.params[0], p1), force=True)
+    passed, failures, max_rel = gradient_check(
+        lambda p, xx, yy: net.loss_fn(p, xx, yy, train=True),
+        net.params, np.asarray(x, np.float64), np.asarray(_labels(4, 3), np.float64),
+    )
+    assert passed, f"{failures} failures (max rel {max_rel:.3g})"
+
+
+def test_computation_graph_vertex_gradients():
+    """reference: GradientCheckTestsComputationGraph — merge + elementwise."""
+    from deeplearning4j_tpu import ComputationGraphConfiguration, ElementWiseVertex, MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    b = ComputationGraphConfiguration.builder()
+    b.add_inputs("in")
+    b.set_input_types(InputType.feed_forward(4))
+    b.add_layer("a", DenseLayer(n_out=5, activation="tanh"), "in")
+    b.add_layer("b", DenseLayer(n_out=5, activation="sigmoid"), "in")
+    b.add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+    b.add_vertex("cat", MergeVertex(), "sum", "a")
+    b.add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "cat")
+    b.set_outputs("out")
+    b.updater(UpdaterConfig())
+    net = ComputationGraph(b.build()).init()
+    x = RNG.normal(size=(3, 4))
+    y = _labels(3, 2)
+
+    def loss(p, xx, yy):
+        l, _ = net._loss(p, net.state, [xx], [yy], None, True, None, None)
+        return l
+
+    passed, failures, max_rel = gradient_check(
+        loss, net.params, np.asarray(x, np.float64), np.asarray(y, np.float64),
+    )
+    assert passed, f"{failures} failures (max rel {max_rel:.3g})"

@@ -164,3 +164,68 @@ def test_cloud_utils_gated():
     cs = ClusterSetup("pod1")
     cmd = cs._command("create")
     assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+
+
+def test_porter_stemmer_classics():
+    from deeplearning4j_tpu.nlp import PorterStemmer, StemmingPreprocessor
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+    st = PorterStemmer()
+    cases = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "agreed": "agre", "plastered": "plaster", "motoring": "motor",
+        "happy": "happi", "relational": "relat", "conditional": "condit",
+        "rational": "ration", "formaliti": "formal", "adjustable": "adjust",
+        "probate": "probat", "rate": "rate", "controll": "control",
+    }
+    for word, expect in cases.items():
+        assert st.stem(word) == expect, (word, st.stem(word), expect)
+
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(StemmingPreprocessor())
+    assert tf.create("the ponies agreed").get_tokens() == ["the", "poni", "agre"]
+
+
+def test_time_sources():
+    import time
+    from deeplearning4j_tpu.utils.time_source import (
+        OffsetTimeSource, SystemTimeSource,
+    )
+
+    now = SystemTimeSource().current_time_millis()
+    assert abs(now - time.time() * 1000) < 2000
+    off = OffsetTimeSource(5000)
+    assert off.current_time_millis() - now >= 4500
+    synced = OffsetTimeSource.from_reference(now + 10_000)
+    assert abs(synced.current_time_millis() - (now + 10_000)) < 2000
+
+
+def test_mesh_front_ends():
+    from deeplearning4j_tpu.parallel import (
+        MeshDl4jMultiLayer, ParameterAveragingTrainingMaster,
+    )
+    from deeplearning4j_tpu import (
+        DenseLayer, InputType, MultiLayerConfiguration, MultiLayerNetwork,
+        OutputLayer, UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+
+    rng = np.random.default_rng(0)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    feats = (labels @ rng.normal(size=(3, 8)) + 0.1 * rng.normal(size=(64, 8))).astype(np.float32)
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1), seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    batches = [DataSet(feats[i::4], labels[i::4]) for i in range(4)]
+    front = MeshDl4jMultiLayer(net)
+    s0 = front.score(ListDataSetIterator(batches))
+    for _ in range(10):
+        front.fit(ListDataSetIterator(batches))
+    assert front.score(ListDataSetIterator(batches)) < s0
+    ev = front.evaluate(ListDataSetIterator(batches))
+    assert ev.accuracy() > 0.5
+    assert front.get_training_master_stats() is not None
