@@ -11,25 +11,21 @@ matching the reference's double-precision GradientCheckUtil runs.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force-override: the environment pins JAX_PLATFORMS=axon (the real TPU tunnel)
 # and sitecustomize PRE-IMPORTS jax at interpreter startup, so env vars set here
-# are latched too late. jax.config.update works post-import as long as no
-# backend has been initialized yet.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# are latched too late. One audited implementation of the recipe lives in
+# __graft_entry__._force_cpu_mesh (fails loudly if a backend beat us to init).
+from __graft_entry__ import _force_cpu_mesh
+
+_force_cpu_mesh(8)
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-# config.update is a SILENT no-op if a backend was already initialized
-# (e.g. an import-time jax.devices() anywhere) — fail loudly instead.
-assert jax.default_backend() == "cpu", (
-    f"tests must run on the virtual CPU mesh, got {jax.default_backend()!r}; "
-    "a jax backend was initialized before conftest could switch platforms"
-)
 # Persistent compilation cache: repeated test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
