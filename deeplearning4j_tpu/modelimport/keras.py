@@ -207,19 +207,26 @@ def _translate_layer(class_name: str, cfg: dict):
     if class_name == "Dense":
         return DenseLayer(
             name=name,
-            n_out=int(cfg["output_dim"]),
+            # keras 1: output_dim/bias; keras 2: units/use_bias
+            n_out=int(cfg["output_dim"] if "output_dim" in cfg else cfg["units"]),
             activation=act or "identity",
-            has_bias=bool(cfg.get("bias", True)),
+            has_bias=bool(cfg.get("bias", cfg.get("use_bias", True))),
         )
     if class_name in ("Convolution2D", "Conv2D"):
+        n_out = cfg["nb_filter"] if "nb_filter" in cfg else cfg["filters"]
+        kernel = (
+            (int(cfg["nb_row"]), int(cfg["nb_col"]))
+            if "nb_row" in cfg
+            else _pair(cfg["kernel_size"])
+        )
         return ConvolutionLayer(
             name=name,
-            n_out=int(cfg["nb_filter"]),
-            kernel=(int(cfg["nb_row"]), int(cfg["nb_col"])),
-            stride=_pair(cfg.get("subsample"), (1, 1)),
-            convolution_mode=_conv_mode(cfg.get("border_mode", "valid")),
+            n_out=int(n_out),
+            kernel=kernel,
+            stride=_pair(cfg.get("subsample") or cfg.get("strides"), (1, 1)),
+            convolution_mode=_conv_mode(cfg.get("border_mode", cfg.get("padding", "valid"))),
             activation=act or "identity",
-            has_bias=bool(cfg.get("bias", True)),
+            has_bias=bool(cfg.get("bias", cfg.get("use_bias", True))),
         )
     if class_name in ("MaxPooling2D", "AveragePooling2D"):
         return SubsamplingLayer(
@@ -227,7 +234,7 @@ def _translate_layer(class_name: str, cfg: dict):
             pooling_type="max" if class_name.startswith("Max") else "avg",
             kernel=_pair(cfg.get("pool_size"), (2, 2)),
             stride=_pair(cfg.get("strides") or cfg.get("pool_size"), (2, 2)),
-            convolution_mode=_conv_mode(cfg.get("border_mode", "valid")),
+            convolution_mode=_conv_mode(cfg.get("border_mode", cfg.get("padding", "valid"))),
         )
     if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
                       "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
@@ -326,14 +333,16 @@ def import_keras_sequential_config(
     pending_flatten = False
     current_kind: Optional[str] = None  # "cnn" | "ff" | "rnn"
 
+    input_ordering = (
+        "th" if _model_channels_first(layer_dicts, _dim_orderings(layer_dicts)) else "tf"
+    )
     for ld in layer_dicts:
         class_name = ld["class_name"]
         cfg = ld.get("config", ld)
-        dim_ordering = cfg.get("dim_ordering", "th")
         if input_type is None:
             shape = cfg.get("batch_input_shape")
             if shape is not None:
-                input_type = _input_type_from_shape(shape, dim_ordering)
+                input_type = _input_type_from_shape(shape, input_ordering)
             elif "input_dim" in cfg:
                 input_type = InputType.feed_forward(int(cfg["input_dim"]))
         translated = _translate_layer(class_name, cfg)
@@ -452,6 +461,9 @@ def import_keras_model_config(
     input_types: Dict[str, InputType] = {}
     # kind of each vertex's output, for Flatten/preprocessor decisions
     kind: Dict[str, str] = {}
+    input_ordering = (
+        "th" if _model_channels_first(layer_dicts, _dim_orderings(layer_dicts)) else "tf"
+    )
 
     for ld in layer_dicts:
         class_name = ld["class_name"]
@@ -461,9 +473,7 @@ def import_keras_model_config(
 
         if class_name == "InputLayer":
             shape = lcfg.get("batch_input_shape")
-            input_types[lname] = _input_type_from_shape(
-                shape, lcfg.get("dim_ordering", "th")
-            )
+            input_types[lname] = _input_type_from_shape(shape, input_ordering)
             kind[lname] = input_types[lname].kind
             continue
 
@@ -556,22 +566,107 @@ def _find(weights: Dict[str, np.ndarray], layer_name: str, *suffixes: str):
     return None
 
 
-def _dim_orderings(model_config: Any) -> Dict[str, str]:
-    """{keras layer name: dim_ordering} ('th' default, matching Keras 1.x)."""
+# Keras 2 renamed the conv classes; their kernels are always stored HWIO
+# regardless of data_format (only Keras 1 'th' kernels are OIHW).
+_KERAS2_CONV_CLASSES = {"Conv1D", "Conv2D", "Conv3D", "SeparableConv2D", "Conv2DTranspose"}
+_CONV_CLASSES = _KERAS2_CONV_CLASSES | {"Convolution1D", "Convolution2D", "Convolution3D", "AtrousConvolution2D"}
+
+
+def _layer_dicts_of(model_config: Any) -> list:
     if isinstance(model_config, str):
         model_config = json.loads(model_config)
     if isinstance(model_config, dict):
         cfgs = model_config.get("config")
         if isinstance(cfgs, dict):
             cfgs = cfgs.get("layers", [])
-    else:
-        cfgs = model_config
+        return cfgs or []
+    return model_config or []
+
+
+def _dim_orderings(model_config: Any) -> Dict[str, str]:
+    """{keras layer name: layout tag}.
+
+    - ``'th'``    Keras 1 channels-first: OIHW conv kernels AND channels-first
+      activations (this is the only tag that triggers a kernel transpose).
+    - ``'th-k2'`` Keras 2 ``data_format=channels_first``: kernels already HWIO,
+      activations channels-first (flatten order still needs permuting).
+    - ``'tf'``    channels-last throughout.
+
+    Keras 1 layers (``dim_ordering`` key, or no marker at all) default to
+    'th'; Keras 2 layers (``data_format`` key or Keras-2 conv class names)
+    default to 'tf' — a channels-last Conv2D kernel must NOT be transposed.
+    """
     out: Dict[str, str] = {}
-    for ld in cfgs or []:
+    for ld in _layer_dicts_of(model_config):
         c = ld.get("config", ld)
         name = ld.get("name") or c.get("name")
-        if name:
-            out[name] = c.get("dim_ordering", "th")
+        if not name:
+            continue
+        cls = ld.get("class_name", "")
+        if "dim_ordering" in c:
+            out[name] = "th" if c["dim_ordering"] == "th" else "tf"
+        elif c.get("data_format") == "channels_first":
+            out[name] = "th-k2"
+        elif "data_format" in c or cls in _KERAS2_CONV_CLASSES:
+            out[name] = "tf"
+        else:
+            out[name] = "th"
+    return out
+
+
+def _model_channels_first(model_config: Any, orderings: Dict[str, str]) -> bool:
+    """Are this model's image activations channels-first? Decided by the conv
+    stack when one exists; a conv-free model is channels-first only when it
+    carries no Keras 2 markers at all (Keras 1 'th' default)."""
+    lds = _layer_dicts_of(model_config)
+    if any(ld.get("class_name") in _CONV_CLASSES for ld in lds):
+        return _channels_first_flatten(model_config, orderings)
+    for ld in lds:
+        c = ld.get("config", ld)
+        if "data_format" in c or ld.get("class_name") in _KERAS2_CONV_CLASSES:
+            return False
+    return True
+
+
+def _channels_first_flatten(model_config: Any, orderings: Dict[str, str]) -> bool:
+    """True if the model's conv stack is channels-first, i.e. a Keras Flatten
+    emitted rows in C,H,W order while our CnnToFeedForwardPreProcessor flattens
+    NHWC (H,W,C) — the following Dense kernel's rows must be permuted."""
+    for ld in _layer_dicts_of(model_config):
+        if ld.get("class_name") in _CONV_CLASSES:
+            c = ld.get("config", ld)
+            name = ld.get("name") or c.get("name")
+            if orderings.get(name, "th") in ("th", "th-k2"):
+                return True
+    return False
+
+
+def _permute_th_flatten_dense_kernel(w: np.ndarray, h: int, wd: int, c: int) -> np.ndarray:
+    """Reorder Dense kernel rows from channels-first flatten order (C,H,W) to
+    our NHWC flatten order (H,W,C). Shapes coincide (C*H*W == H*W*C) so this
+    corruption is silent without the permutation (ADVICE round 1, high)."""
+    n_out = w.shape[-1]
+    return np.ascontiguousarray(
+        w.reshape(c, h, wd, n_out).transpose(1, 2, 0, 3).reshape(h * wd * c, n_out)
+    )
+
+
+def _cnn_flatten_dense_indices(conf) -> Dict[int, Tuple[int, int, int]]:
+    """{layer idx: (h, w, c)} for Dense-family layers that consume a
+    CnnToFeedForwardPreProcessor flatten of a CNN activation."""
+    out: Dict[int, Tuple[int, int, int]] = {}
+    cur = conf.input_type
+    for i, layer in enumerate(conf.layers):
+        pre = conf.preprocessors.get(i)
+        if (
+            isinstance(pre, CnnToFeedForwardPreProcessor)
+            and cur.kind == "cnn"
+            and isinstance(layer, DenseLayer)
+        ):
+            out[i] = (cur.height, cur.width, cur.channels)
+        if pre is not None:
+            cur = pre.get_output_type(cur)
+        cur = layer.get_output_type(cur)
     return out
 
 
@@ -682,6 +777,8 @@ def import_keras_sequential_model_and_weights(
 
     all_weights = hdf5.read_layer_weights(path)
     orderings = _dim_orderings(model_config)
+    flatten_dense = _cnn_flatten_dense_indices(conf)
+    th_flatten = _channels_first_flatten(model_config, orderings)
     new_params = list(net.params)
     new_state = list(net.state)
     for i, (layer, kname) in enumerate(zip(conf.layers, keras_names)):
@@ -690,6 +787,9 @@ def import_keras_sequential_model_and_weights(
         p_upd, s_upd = _convert_layer_weights(
             layer, all_weights[kname], kname, orderings.get(kname, "th")
         )
+        if th_flatten and i in flatten_dense and p_upd.get("W") is not None:
+            h, wd, c = flatten_dense[i]
+            p_upd["W"] = _permute_th_flatten_dense_kernel(np.asarray(p_upd["W"]), h, wd, c)
         new_params[i], new_state[i] = _apply_updates(
             new_params[i], new_state[i], p_upd, s_upd
         )
@@ -716,6 +816,11 @@ def import_keras_model_and_weights(path: str, enforce_training_config: bool = Tr
 
     all_weights = hdf5.read_layer_weights(path)
     orderings = _dim_orderings(model_config)
+    th_flatten = _channels_first_flatten(model_config, orderings)
+    try:
+        vtypes = conf.vertex_input_types() if conf.input_types else {}
+    except ValueError:
+        vtypes = {}
     new_params = dict(net.params)
     new_state = dict(net.state)
     for vname, kname in name_map.items():
@@ -728,6 +833,17 @@ def import_keras_model_and_weights(path: str, enforce_training_config: bool = Tr
         p_upd, s_upd = _convert_layer_weights(
             layer, all_weights[kname], kname, orderings.get(kname, "th")
         )
+        if th_flatten and isinstance(layer, DenseLayer) and p_upd.get("W") is not None:
+            srcs = conf.vertex_inputs.get(vname, [])
+            sv = conf.vertices.get(srcs[0]) if len(srcs) == 1 else None
+            if isinstance(sv, PreprocessorVertex) and isinstance(
+                getattr(sv, "preprocessor", None), CnnToFeedForwardPreProcessor
+            ):
+                it = (vtypes.get(srcs[0]) or [None])[0]
+                if it is not None and it.kind == "cnn":
+                    p_upd["W"] = _permute_th_flatten_dense_kernel(
+                        np.asarray(p_upd["W"]), it.height, it.width, it.channels
+                    )
         new_params[vname], new_state[vname] = _apply_updates(
             new_params[vname], new_state[vname], p_upd, s_upd
         )

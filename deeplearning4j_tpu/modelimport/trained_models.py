@@ -120,6 +120,9 @@ def _load_vgg16_weights_only(weights_path: str):
             f"Archive has {len(weighted)} weighted layers; VGG16 expects "
             f"{len(targets)}"
         )
+    from .keras import _cnn_flatten_dense_indices, _permute_th_flatten_dense_kernel  # noqa: PLC0415
+
+    flatten_dense = _cnn_flatten_dense_indices(conf)
     for idx, (lname, wdict) in zip(targets, weighted):
         arrs = [wdict[k] for k in sorted(wdict)]  # param_0, param_1
         if len(arrs) != 2:
@@ -129,6 +132,11 @@ def _load_vgg16_weights_only(weights_path: str):
         w, b = (arrs if arrs[0].ndim > arrs[1].ndim else (arrs[1], arrs[0]))
         if w.ndim == 4:  # 'th' OIHW → HWIO
             w = np.transpose(w, (2, 3, 1, 0))
+        elif idx in flatten_dense:
+            # The canonical 'th' archive's first FC kernel has rows in C,H,W
+            # flatten order; our flatten is H,W,C (ADVICE round 1, high).
+            h, wd, c = flatten_dense[idx]
+            w = _permute_th_flatten_dense_kernel(w, h, wd, c)
         expect = tuple(new_params[idx]["W"].shape)
         if tuple(w.shape) != expect:
             raise KerasImportError(

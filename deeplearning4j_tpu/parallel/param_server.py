@@ -32,8 +32,17 @@ class ParameterServer:
     """Owns the flat parameter vector; applies pushed gradients (SGD)."""
 
     def __init__(self, initial_params: np.ndarray, learning_rate: float = 0.01,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_frame_bytes: Optional[int] = None):
         self._params = np.ascontiguousarray(initial_params, np.float32).copy()
+        # Frame cap (DoS guard) sized to the model: a legit gradient is exactly
+        # params-sized, so default to that (+slack) rather than the global cap,
+        # which a VGG16-scale (~553MB) model would exceed.
+        self.max_frame_bytes = int(
+            max_frame_bytes
+            if max_frame_bytes is not None
+            else max(self._params.nbytes * 2, 1 << 20)
+        )
         self.learning_rate = float(learning_rate)
         self._lock = threading.Lock()
         self._updates = 0
@@ -93,7 +102,7 @@ class ParameterServer:
                 if not op or op == b"Q":
                     return
                 if op == b"G":
-                    grad = _recv_array(conn)
+                    grad = _recv_array(conn, max_bytes=self.max_frame_bytes)
                     with self._lock:
                         if grad.shape != self._params.shape:
                             conn.sendall(b"E")
@@ -116,8 +125,9 @@ class ParameterServer:
 class ParameterServerClient:
     """Reference: nd4j ParameterServerClient (push/pull over the transport)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, max_frame_bytes: Optional[int] = None):
         self._sock = socket.create_connection((host, port))
+        self.max_frame_bytes = max_frame_bytes
 
     def push_gradient(self, grad: np.ndarray) -> None:
         self._sock.sendall(b"G")
@@ -128,6 +138,8 @@ class ParameterServerClient:
 
     def pull_params(self) -> np.ndarray:
         self._sock.sendall(b"P")
+        if self.max_frame_bytes is not None:
+            return _recv_array(self._sock, max_bytes=self.max_frame_bytes)
         return _recv_array(self._sock)
 
     def close(self) -> None:

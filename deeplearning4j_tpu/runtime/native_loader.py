@@ -188,21 +188,42 @@ class NativeDataSetIterator:
     def reset(self) -> None:
         self._epoch += 1
         self._consumed = 0
+        # Invalidate any suspended generator: it must not resume and drain the
+        # freshly reset cursor (stale-generation check in _drain).
+        self._generation = getattr(self, "_generation", 0) + 1
+        self._iterating = False
         self._lib.dl4j_loader_reset(
             self._handle, 1 if self.shuffle else 0, self._epoch
         )
 
     def __iter__(self):
-        from ..datasets.iterators import DataSet  # noqa: PLC0415
-
         # iterator contract parity (NumpyDataSetIterator): iterating an
         # exhausted epoch starts a fresh one (reshuffled)
         if len(self) > 0 and self._consumed >= len(self):
             self.reset()
+        # One shared native consume cursor backs every generator: a second
+        # active generator would silently steal this one's batches.
+        if getattr(self, "_iterating", False):
+            raise RuntimeError(
+                "NativeDataSetIterator supports one active iterator at a time "
+                "(single C++ consume cursor); exhaust or discard the previous "
+                "generator (or call reset()) before starting another"
+            )
+        self._iterating = True
+        gen = getattr(self, "_generation", 0)
+        try:
+            yield from self._drain(gen)
+        finally:
+            if getattr(self, "_generation", 0) == gen:
+                self._iterating = False
+
+    def _drain(self, gen: int):
+        from ..datasets.iterators import DataSet  # noqa: PLC0415
+
         fp = ctypes.POINTER(ctypes.c_float)
         fcols = self._f2.shape[1]
         lcols = self._l2.shape[1]
-        while True:
+        while getattr(self, "_generation", 0) == gen:
             feat = np.empty((self.batch, fcols), np.float32)
             lab = np.empty((self.batch, lcols), np.float32)
             n = self._lib.dl4j_loader_next(

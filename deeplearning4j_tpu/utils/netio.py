@@ -10,6 +10,20 @@ from typing import Optional
 
 import numpy as np
 
+# Length prefixes come from an unauthenticated peer — cap them so a hostile
+# or corrupt frame can't force a multi-GB allocation (memory-exhaustion DoS).
+MAX_ARRAY_BYTES = 256 * 1024 * 1024  # a 64M-param float32 vector
+MAX_JSON_BYTES = 16 * 1024 * 1024
+
+
+class FrameTooLargeError(ConnectionError):
+    """Peer announced a frame exceeding the configured cap."""
+
+
+def _check_frame(n: int, cap: int, kind: str) -> None:
+    if n > cap:
+        raise FrameTooLargeError(f"{kind} frame of {n} bytes exceeds cap {cap}")
+
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -26,8 +40,9 @@ def send_array(sock: socket.socket, arr: np.ndarray) -> None:
     sock.sendall(struct.pack(">Q", len(payload)) + payload)
 
 
-def recv_array(sock: socket.socket) -> np.ndarray:
+def recv_array(sock: socket.socket, max_bytes: int = MAX_ARRAY_BYTES) -> np.ndarray:
     (n,) = struct.unpack(">Q", recv_exact(sock, 8))
+    _check_frame(n, max_bytes, "array")
     return np.frombuffer(recv_exact(sock, n), dtype=np.float32).copy()
 
 
@@ -36,13 +51,17 @@ def send_json_frame(sock: socket.socket, obj: dict) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-def recv_json_frame(sock: socket.socket) -> Optional[dict]:
-    """None on orderly close before/inside a frame."""
+def recv_json_frame(
+    sock: socket.socket, max_bytes: int = MAX_JSON_BYTES
+) -> Optional[dict]:
+    """None on orderly close before/inside a frame; raises FrameTooLargeError
+    (a ConnectionError — callers should drop the connection) on oversize."""
     try:
         header = recv_exact(sock, 4)
     except ConnectionError:
         return None
     (n,) = struct.unpack(">I", header)
+    _check_frame(n, max_bytes, "json")
     try:
         return json.loads(recv_exact(sock, n))
     except ConnectionError:

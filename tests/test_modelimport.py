@@ -366,3 +366,118 @@ def test_vgg16_configuration_shapes():
     assert types[dense_idx].kind == "ff"
     assert types[dense_idx].size == 7 * 7 * 512
     assert conf.output_type().size == 1000
+
+
+# ---------------------------------------------------------------------------
+# channels-first flatten → Dense row-order parity (ADVICE round 1, high)
+# ---------------------------------------------------------------------------
+
+
+def _conv_chw_valid(x_chw, w_oihw, b):
+    """Naive channels-first valid conv, stride 1 — the Keras/Theano reference."""
+    o_n, _, kh, kw = w_oihw.shape
+    h, w = x_chw.shape[1], x_chw.shape[2]
+    out = np.zeros((o_n, h - kh + 1, w - kw + 1), np.float32)
+    for o in range(o_n):
+        for i in range(out.shape[1]):
+            for j in range(out.shape[2]):
+                out[o, i, j] = np.sum(w_oihw[o] * x_chw[:, i : i + kh, j : j + kw]) + b[o]
+    return out
+
+
+def test_th_conv_flatten_dense_numeric_parity(tmp_path):
+    """Keras 1 'th' Conv→Flatten→Dense: the Dense kernel rows are in C,H,W
+    flatten order; import must permute them to our NHWC (H,W,C) flatten order.
+    Shapes coincide either way, so only a numeric check catches it."""
+    rng = np.random.default_rng(3)
+    C, H, W, O = 2, 5, 5, 3
+    wc = rng.normal(size=(O, C, 2, 2)).astype(np.float32)  # OIHW ('th')
+    bc = rng.normal(size=(O,)).astype(np.float32)
+    n_flat = O * 4 * 4
+    wd = rng.normal(size=(n_flat, 4)).astype(np.float32)
+    bd = rng.normal(size=(4,)).astype(np.float32)
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {
+                "class_name": "Convolution2D",
+                "config": {
+                    "name": "conv_1", "nb_filter": O, "nb_row": 2, "nb_col": 2,
+                    "subsample": [1, 1], "border_mode": "valid",
+                    "dim_ordering": "th", "activation": "relu", "bias": True,
+                    "batch_input_shape": [None, C, H, W],
+                },
+            },
+            {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+            _dense_cfg("dense_1", 4, "linear"),
+        ],
+    }
+    path = str(tmp_path / "th_cnn.h5")
+    _write_keras_h5(
+        path, model_config, None,
+        {
+            "conv_1": [("conv_1_W", wc), ("conv_1_b", bc)],
+            "flatten_1": [],
+            "dense_1": [("dense_1_W", wd), ("dense_1_b", bd)],
+        },
+    )
+    net = import_keras_sequential_model_and_weights(path)
+
+    x_chw = rng.normal(size=(C, H, W)).astype(np.float32)
+    # Keras/Theano reference: channels-first conv, relu, C-major flatten, dense
+    ref = np.maximum(_conv_chw_valid(x_chw, wc, bc), 0.0).reshape(-1) @ wd + bd
+    got = np.asarray(net.output(x_chw.transpose(1, 2, 0)[None]))[0]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_keras2_channels_last_conv_kernel_not_transposed(tmp_path):
+    """Keras 2 Conv2D channels_last: kernel is already HWIO and activations are
+    channels-last — no transpose, no Dense-row permutation (ADVICE medium)."""
+    rng = np.random.default_rng(4)
+    C, H, W, O = 2, 5, 5, 3
+    w_hwio = rng.normal(size=(2, 2, C, O)).astype(np.float32)
+    bc = rng.normal(size=(O,)).astype(np.float32)
+    n_flat = 4 * 4 * O
+    wd = rng.normal(size=(n_flat, 4)).astype(np.float32)
+    bd = rng.normal(size=(4,)).astype(np.float32)
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": {
+            "layers": [
+                {
+                    "class_name": "Conv2D",
+                    "config": {
+                        "name": "conv_1", "filters": O, "kernel_size": [2, 2],
+                        "strides": [1, 1], "padding": "valid",
+                        "data_format": "channels_last", "activation": "relu",
+                        "use_bias": True, "batch_input_shape": [None, H, W, C],
+                    },
+                },
+                {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+                {"class_name": "Dense",
+                 "config": {"name": "dense_1", "units": 4, "activation": "linear",
+                            "use_bias": True}},
+            ]
+        },
+    }
+    path = str(tmp_path / "k2_cnn.h5")
+    _write_keras_h5(
+        path, model_config, None,
+        {
+            "conv_1": [("conv_1/kernel:0", w_hwio), ("conv_1/bias:0", bc)],
+            "flatten_1": [],
+            "dense_1": [("dense_1/kernel:0", wd), ("dense_1/bias:0", bd)],
+        },
+    )
+    net = import_keras_sequential_model_and_weights(path)
+
+    x_hwc = rng.normal(size=(H, W, C)).astype(np.float32)
+    # channels-last reference: conv as OIHW over transposed input, then
+    # channels-LAST flatten (H,W,C-major) — identical to our layout
+    w_oihw = w_hwio.transpose(3, 2, 0, 1)
+    conv = np.maximum(_conv_chw_valid(x_hwc.transpose(2, 0, 1), w_oihw, bc), 0.0)
+    ref = conv.transpose(1, 2, 0).reshape(-1) @ wd + bd
+    got = np.asarray(net.output(x_hwc[None]))[0]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

@@ -125,3 +125,34 @@ def test_native_loader_auto_restart_and_batch_guard():
     assert len(list(it)) == 2
     with pytest.raises(ValueError, match="batch"):
         NativeDataSetIterator(feats, labels, batch=0)
+
+
+def test_native_loader_rejects_second_concurrent_iterator():
+    feats = np.ones((32, 3), np.float32)
+    labels = np.ones((32, 1), np.float32)
+    it = NativeDataSetIterator(feats, labels, batch=8)
+    gen1 = iter(it)
+    next(gen1)
+    gen2 = iter(it)
+    with pytest.raises(RuntimeError, match="one active iterator"):
+        next(gen2)
+    # the original generator keeps draining the shared cursor undisturbed
+    remaining = sum(1 for _ in gen1)
+    assert remaining == 3
+    # after exhaustion, a fresh pass is allowed again
+    assert sum(1 for _ in it) == 4
+
+
+def test_native_loader_reset_recovers_from_active_iterator():
+    """reset() must clear the active-iterator latch AND invalidate the old
+    suspended generator (it must not drain the fresh cursor)."""
+    feats = np.ones((32, 3), np.float32)
+    labels = np.ones((32, 1), np.float32)
+    it = NativeDataSetIterator(feats, labels, batch=8)
+    gen1 = iter(it)
+    next(gen1)
+    it.reset()
+    # old generator is invalidated, not stealing from the fresh epoch
+    assert list(gen1) == []
+    # and a new pass works immediately, seeing the full epoch
+    assert sum(1 for _ in it) == 4

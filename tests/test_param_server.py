@@ -1,0 +1,53 @@
+"""Network-tier framing hardening tests."""
+
+import pytest
+
+
+
+def test_frame_length_caps_reject_hostile_prefixes():
+    """An unauthenticated peer announcing a huge frame must not trigger the
+    allocation (ADVICE round 1: memory-exhaustion DoS)."""
+    import socket
+    import threading
+
+    from deeplearning4j_tpu.utils.netio import (
+        FrameTooLargeError,
+        recv_array,
+        recv_json_frame,
+    )
+
+    import struct as _struct
+
+    def _serve(payloads, port_holder, started):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port_holder.append(srv.getsockname()[1])
+        started.set()
+        conn, _ = srv.accept()
+        for p in payloads:
+            conn.sendall(p)
+        conn.close()
+        srv.close()
+
+    # hostile uint64 array-length prefix (16 GB) and uint32 json prefix (3 GB)
+    payloads = [_struct.pack(">Q", 16 << 30), _struct.pack(">I", 3 << 30)]
+    port_holder, started = [], threading.Event()
+    t = threading.Thread(target=_serve, args=(payloads, port_holder, started))
+    t.start()
+    started.wait(5)
+    c = socket.create_connection(("127.0.0.1", port_holder[0]), timeout=5)
+    with pytest.raises(FrameTooLargeError):
+        recv_array(c)
+    c.close()
+
+    port_holder2, started2 = [], threading.Event()
+    t2 = threading.Thread(target=_serve, args=(payloads[1:], port_holder2, started2))
+    t2.start()
+    started2.wait(5)
+    c2 = socket.create_connection(("127.0.0.1", port_holder2[0]), timeout=5)
+    with pytest.raises(FrameTooLargeError):
+        recv_json_frame(c2)
+    c2.close()
+    t.join(5)
+    t2.join(5)
