@@ -239,7 +239,6 @@ class MultiLayerNetwork:
         if (
             self.conf.backprop_type == "tbptt"
             and np.ndim(ds.features) == 3
-            and ds.features.shape[1] > self.conf.tbptt_fwd_length
         ):
             self._fit_tbptt(ds)
             return
@@ -265,11 +264,37 @@ class MultiLayerNetwork:
 
     def _build_tbptt_step(self):
         tx = self._tx
+        back_len = int(self.conf.tbptt_back_length or 0)
 
         def step(params, opt_state, state, rnn, x, y, rng, labels_mask, features_mask):
+            seg_len = x.shape[1]
+            k = seg_len if back_len <= 0 else min(back_len, seg_len)
+            if k < seg_len:
+                # tbptt_back_length < fwd_length: the first seg_len-k steps
+                # evolve hidden state (and BN stats) but contribute no
+                # gradient — the reference's backward loop caps at
+                # tbpttBackwardLength (LSTMHelpers.backpropGradientHelper),
+                # discarding epsilons from earlier outputs entirely.
+                split = seg_len - k
+                pre_rng, rng = jax.random.split(rng)
+                fm_pre = None if features_mask is None else features_mask[:, :split]
+                _, state_in, rnn_in = jax.lax.stop_gradient(
+                    self._forward(
+                        params, x[:, :split], state, True, pre_rng,
+                        upto=len(self.conf.layers) - 1,
+                        features_mask=fm_pre, rnn_state=rnn,
+                    )
+                )
+                x_g, y_g = x[:, split:], y[:, split:]
+                lm_g = None if labels_mask is None else labels_mask[:, split:]
+                fm_g = None if features_mask is None else features_mask[:, split:]
+            else:
+                x_g, y_g, lm_g, fm_g = x, y, labels_mask, features_mask
+                state_in, rnn_in = state, rnn
+
             def loss_of(p):
                 loss, new_state, new_rnn = self._loss(
-                    p, state, x, y, rng, True, labels_mask, features_mask, rnn_state=rnn
+                    p, state_in, x_g, y_g, rng, True, lm_g, fm_g, rnn_state=rnn_in
                 )
                 return loss, (new_state, new_rnn)
 
@@ -290,28 +315,21 @@ class MultiLayerNetwork:
         """Truncated BPTT over time segments (reference: doTruncatedBPTT:1080).
 
         The sequence is split into ``tbptt_fwd_length`` chunks; one param update
-        per chunk; LSTM h/c carry across chunks with gradients stopped. Trailing
-        partial chunks are dropped (static shapes for XLA; the reference
-        processes them — pad sequences to a multiple to keep every step).
+        per chunk; LSTM h/c carry across chunks with gradients stopped. A
+        trailing partial chunk trains too (the reference processes it) — XLA
+        compiles the step once more for the tail shape. ``tbptt_back_length <
+        tbptt_fwd_length`` truncates the backward window inside each chunk
+        (reference: tbpttBackwardLength in LSTMHelpers.backpropGradientHelper).
         """
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
-            if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
-                import warnings
-
-                warnings.warn(
-                    "tbptt_back_length != tbptt_fwd_length: gradients truncate at "
-                    "segment boundaries (= tbptt_fwd_length); a shorter backward "
-                    "window is not yet supported and tbptt_back_length is ignored.",
-                    stacklevel=3,
-                )
         x, y = np.asarray(ds.features), np.asarray(ds.labels)
         fmask = getattr(ds, "features_mask", None)
         lmask = getattr(ds, "labels_mask", None)
         T, L = x.shape[1], self.conf.tbptt_fwd_length
         rnn = self._init_rnn_states(x.shape[0])
-        for t0 in range(0, T - L + 1, L):
-            seg = slice(t0, t0 + L)
+        for t0 in range(0, T, L):
+            seg = slice(t0, t0 + min(L, T - t0))
             self._rng, step_key = jax.random.split(self._rng)
             (self.params, self.opt_state, self.state, rnn, loss) = self._tbptt_step(
                 self.params, self.opt_state, self.state, rnn,

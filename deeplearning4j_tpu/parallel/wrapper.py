@@ -156,9 +156,11 @@ class ParallelWrapper:
 
         tx = net._tx
 
-        def one_step(params, opt_state, state, x, y, rng):
+        def one_step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
             def loss_of(p):
-                loss, new_state, _ = net._loss(p, state, x, y, rng, True)
+                loss, new_state, _ = net._loss(
+                    p, state, x, y, rng, True, labels_mask, features_mask
+                )
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
@@ -192,7 +194,17 @@ class ParallelWrapper:
         shard0 = data_sharding(self.mesh)
         x = jax.device_put(jnp.asarray(stacked_ds.features), shard0)
         y = jax.device_put(jnp.asarray(stacked_ds.labels), shard0)
-        params, opt_state, state, losses = self._vstep(params, opt_state, state, x, y, keys)
+        # Masks ride the replica axis too — each replica's loss must see its
+        # own masks exactly as its net.fit would (round-1 weak #4: periodic
+        # mode silently computed unmasked loss). None passes through vmap as
+        # an empty pytree.
+        lm = getattr(stacked_ds, "labels_mask", None)
+        fm = getattr(stacked_ds, "features_mask", None)
+        lm = None if lm is None else jax.device_put(jnp.asarray(lm), shard0)
+        fm = None if fm is None else jax.device_put(jnp.asarray(fm), shard0)
+        params, opt_state, state, losses = self._vstep(
+            params, opt_state, state, x, y, keys, lm, fm
+        )
         self.iteration += 1
         net.iteration += 1
         if self.iteration % self.averaging_frequency == 0:
@@ -307,12 +319,18 @@ def _stack_group(group):
     return DataSet(
         np.stack([np.asarray(d.features) for d in group]),
         np.stack([np.asarray(d.labels) for d in group]),
+        _merge_masks([getattr(d, "features_mask", None) for d in group], np.stack),
+        _merge_masks([getattr(d, "labels_mask", None) for d in group], np.stack),
     )
 
 
-def _cat_masks(masks):
+def _merge_masks(masks, combine):
     if all(m is None for m in masks):
         return None
     if any(m is None for m in masks):
         raise ValueError("mixed masked/unmasked minibatches in one group")
-    return np.concatenate([np.asarray(m) for m in masks])
+    return combine([np.asarray(m) for m in masks])
+
+
+def _cat_masks(masks):
+    return _merge_masks(masks, np.concatenate)

