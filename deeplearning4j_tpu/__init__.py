@@ -81,6 +81,7 @@ from .nn.layers.variational import (
 from .nn.transferlearning import (
     TransferLearning,
     TransferLearningBuilder,
+    TransferLearningGraphBuilder,
     FineTuneConfiguration,
 )
 from .optimize.listeners import (
@@ -157,6 +158,7 @@ __all__ = [
     "LossFunctionWrapper",
     "TransferLearning",
     "TransferLearningBuilder",
+    "TransferLearningGraphBuilder",
     "FineTuneConfiguration",
     "IterationListener",
     "TrainingListener",

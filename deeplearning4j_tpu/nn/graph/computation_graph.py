@@ -41,6 +41,9 @@ class ComputationGraph:
         self._eval_forward = None
         self._last_loss = None
         self._topo = conf.topological_order()
+        self._rnn_state = None  # streaming rnnTimeStep state, one entry per vertex
+        self._rnn_step_fn = None
+        self._tbptt_step = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "ComputationGraph":
@@ -63,6 +66,9 @@ class ComputationGraph:
         self.iteration = 0
         self._train_step = None
         self._eval_forward = None
+        self._tbptt_step = None  # closes over self._tx — must follow it
+        self._rnn_step_fn = None
+        self._rnn_state = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -75,11 +81,14 @@ class ComputationGraph:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 
     # ------------------------------------------------------- functional core
-    def _activations(self, params, inputs, state, train, rng, masks):
-        """Run the topological forward; returns (acts dict, new_state dict).
+    def _activations(self, params, inputs, state, train, rng, masks, rnn_state=None):
+        """Run the topological forward; returns (acts, new_state, new_rnn).
 
         ``inputs``: list of arrays aligned with conf.network_inputs.
         ``masks``: dict network-input-name -> [b, t] mask (or None).
+        ``rnn_state``: dict vertex-name -> recurrent h/c ({} for stateless),
+        threading LSTM state across TBPTT segments / rnnTimeStep calls
+        (reference: ComputationGraph.rnnActivateUsingStoredState).
         (reference: ComputationGraph feed-forward loop :1051-1060)
         """
         conf = self.conf
@@ -101,20 +110,28 @@ class ComputationGraph:
             else [None] * len(self._topo)
         )
         new_state = dict(state)
+        new_rnn = dict(rnn_state) if rnn_state is not None else None
         for name, r in zip(self._topo, rngs):
             vertex = conf.vertices[name]
             ins = [acts[src] for src in conf.vertex_inputs[name]]
-            acts[name], new_state[name] = vertex.apply(
-                params[name], ins, state[name], train=train, rng=r, masks=vmasks
-            )
-        return acts, new_state
+            if new_rnn is not None and new_rnn.get(name):
+                acts[name], new_rnn[name] = vertex.apply_seq(
+                    params[name], ins, new_rnn[name], train=train, rng=r, masks=vmasks
+                )
+            else:
+                acts[name], new_state[name] = vertex.apply(
+                    params[name], ins, state[name], train=train, rng=r, masks=vmasks
+                )
+        return acts, new_state, new_rnn
 
-    def _forward(self, params, inputs, state, train, rng, masks=None):
-        acts, new_state = self._activations(params, inputs, state, train, rng, masks)
-        return [acts[o] for o in self.conf.network_outputs], new_state
+    def _forward(self, params, inputs, state, train, rng, masks=None, rnn_state=None):
+        acts, new_state, new_rnn = self._activations(
+            params, inputs, state, train, rng, masks, rnn_state
+        )
+        return [acts[o] for o in self.conf.network_outputs], new_state, new_rnn
 
     def _loss(self, params, state, inputs, labels, rng, train,
-              labels_masks=None, masks=None):
+              labels_masks=None, masks=None, rnn_state=None):
         """Sum of output-layer losses + regularization
         (reference: ComputationGraph.computeGradientAndScore score accumulation)."""
         conf = self.conf
@@ -124,7 +141,9 @@ class ComputationGraph:
         # forward over all non-output vertices; output-layer vertices consume
         # their input activations via compute_loss (pre-activation path for
         # fused stable softmax-xent, as in MultiLayerNetwork._loss)
-        acts, new_state = self._activations(params, inputs, state, train, acts_rng, masks)
+        acts, new_state, new_rnn = self._activations(
+            params, inputs, state, train, acts_rng, masks, rnn_state
+        )
         total = jnp.asarray(0.0)
         out_rngs = (
             jax.random.split(out_rng, len(conf.network_outputs))
@@ -150,13 +169,13 @@ class ComputationGraph:
             (self.conf.vertices[n].regularization_loss(params[n]) for n in self._topo),
             start=jnp.asarray(0.0),
         )
-        return total + reg, new_state
+        return total + reg, new_state, new_rnn
 
     def loss_fn(self, params, inputs, labels, *, train=False, state=None, rng=None,
                 labels_masks=None, masks=None):
         """Pure scalar loss of params — the gradient-check entry point."""
         st = state if state is not None else self.state
-        val, _ = self._loss(params, st, inputs, labels, rng, train, labels_masks, masks)
+        val, _, _ = self._loss(params, st, inputs, labels, rng, train, labels_masks, masks)
         return val
 
     # ------------------------------------------------------------- train step
@@ -165,7 +184,10 @@ class ComputationGraph:
 
         def step(params, opt_state, state, inputs, labels, rng, labels_masks, masks):
             def loss_of(p):
-                return self._loss(p, state, inputs, labels, rng, True, labels_masks, masks)
+                loss, new_state, _ = self._loss(
+                    p, state, inputs, labels, rng, True, labels_masks, masks
+                )
+                return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
@@ -208,6 +230,8 @@ class ComputationGraph:
 
         if isinstance(ds, MultiDataSet):
             return ds
+        if isinstance(ds, (tuple, list)) and len(ds) == 2:
+            ds = DataSet(ds[0], ds[1])
         if isinstance(ds, DataSet):
             return MultiDataSet(
                 features=[ds.features],
@@ -219,6 +243,11 @@ class ComputationGraph:
 
     def _fit_batch(self, mds) -> None:
         self.last_batch_size = mds.num_examples()
+        if self.conf.backprop_type == "tbptt" and any(
+            np.ndim(f) == 3 for f in mds.features
+        ):
+            self._fit_tbptt(mds)
+            return
         self._rng, step_key = jax.random.split(self._rng)
         masks = None
         if mds.features_masks is not None:
@@ -238,6 +267,157 @@ class ComputationGraph:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
 
+    # ------------------------------------------------------- TBPTT (graphs)
+    def _init_rnn_states(self, batch: int):
+        """Per-vertex streaming state dict ({} for stateless vertices)."""
+        return {
+            name: (
+                self.conf.vertices[name].init_recurrent_state(batch)
+                if getattr(self.conf.vertices[name], "is_recurrent", False)
+                else {}
+            )
+            for name in self._topo
+        }
+
+    def _build_tbptt_step(self):
+        """One param update per time segment, recurrent state carried across
+        segments with gradients stopped (reference: the doTruncatedBPTT path
+        invoked from ComputationGraph.fit; tbptt_back_length < fwd_length
+        truncates the backward window like tbpttBackwardLength does)."""
+        tx = self._tx
+        back_len = int(self.conf.tbptt_back_length or 0)
+
+        def slice_t(arrs, sl):
+            return [a[:, sl] if a.ndim == 3 else a for a in arrs]
+
+        def slice_mask_dict(md, sl):
+            if md is None:
+                return None
+            return {n: (None if m is None else m[:, sl]) for n, m in md.items()}
+
+        def step(params, opt_state, state, rnn, xs, ys, rng, labels_masks, masks):
+            seg_len = next(a.shape[1] for a in xs if a.ndim == 3)
+            k = seg_len if back_len <= 0 else min(back_len, seg_len)
+            if k < seg_len:
+                split = seg_len - k
+                pre_rng, rng = jax.random.split(rng)
+                _, state_in, rnn_in = jax.lax.stop_gradient(
+                    self._forward(
+                        params, slice_t(xs, slice(None, split)), state, True,
+                        pre_rng, slice_mask_dict(masks, slice(None, split)), rnn,
+                    )
+                )
+                xs_g = slice_t(xs, slice(split, None))
+                ys_g = slice_t(ys, slice(split, None))
+                lm_g = (
+                    None if labels_masks is None
+                    else [None if m is None else m[:, split:] for m in labels_masks]
+                )
+                m_g = slice_mask_dict(masks, slice(split, None))
+            else:
+                xs_g, ys_g, lm_g, m_g = xs, ys, labels_masks, masks
+                state_in, rnn_in = state, rnn
+
+            def loss_of(p):
+                loss, new_state, new_rnn = self._loss(
+                    p, state_in, xs_g, ys_g, rng, True, lm_g, m_g, rnn_state=rnn_in
+                )
+                return loss, (new_state, new_rnn)
+
+            (loss, (new_state, new_rnn)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # segment boundary = truncation boundary: h/c re-enter the next
+            # call as constants
+            new_rnn = jax.lax.stop_gradient(new_rnn)
+            return new_params, new_opt, new_state, new_rnn, loss
+
+        return jax.jit(step)
+
+    def _fit_tbptt(self, mds) -> None:
+        feats = [np.asarray(f) for f in mds.features]
+        labs = [np.asarray(l) for l in mds.labels]
+        n_in, n_out = len(feats), len(labs)
+        fmasks = list(mds.features_masks or [None] * n_in)
+        lmasks = list(mds.labels_masks or [None] * n_out)
+        seq_lens = {a.shape[1] for a in feats + labs if a.ndim == 3}
+        if len(seq_lens) != 1:
+            raise ValueError(
+                f"TBPTT requires one shared sequence length; got {sorted(seq_lens)}"
+            )
+        T, L = seq_lens.pop(), self.conf.tbptt_fwd_length
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        rnn = self._init_rnn_states(feats[0].shape[0])
+        for t0 in range(0, T, L):
+            seg = slice(t0, t0 + min(L, T - t0))
+            xs = [a[:, seg] if a.ndim == 3 else a for a in feats]
+            ys = [a[:, seg] if a.ndim == 3 else a for a in labs]
+            fms = [None if m is None else np.asarray(m)[:, seg] for m in fmasks]
+            lms = [None if m is None else np.asarray(m)[:, seg] for m in lmasks]
+            masks = (
+                dict(zip(self.conf.network_inputs, fms))
+                if any(m is not None for m in fms) else None
+            )
+            lms = None if all(m is None for m in lms) else lms
+            self._rng, step_key = jax.random.split(self._rng)
+            (self.params, self.opt_state, self.state, rnn, loss) = self._tbptt_step(
+                self.params, self.opt_state, self.state, rnn,
+                xs, ys, step_key, lms, masks,
+            )
+            self._last_loss = loss
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, loss)
+
+    # ------------------------------------------------------------- streaming
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference (reference: ComputationGraph.rnnTimeStep:1801).
+
+        Each input: [batch, features] (one step) or [batch, time, features].
+        Recurrent vertices' h/c persist across calls until
+        :meth:`rnn_clear_previous_state`.
+        """
+        self.init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        xs = [jnp.asarray(x) for x in inputs]
+        single_step = all(x.ndim == 2 for x in xs)
+        if single_step:
+            xs = [x[:, None, :] for x in xs]
+        batch = int(xs[0].shape[0])
+        leaves = (
+            jax.tree_util.tree_leaves(self._rnn_state)
+            if self._rnn_state is not None else []
+        )
+        if self._rnn_state is None or (leaves and leaves[0].shape[0] != batch):
+            self._rnn_state = self._init_rnn_states(batch)
+        if self._rnn_step_fn is None:
+            self._rnn_step_fn = jax.jit(
+                lambda params, state, rnn, xs: self._forward(
+                    params, xs, state, False, None, None, rnn
+                )[::2]  # (outs, new_rnn) — per-token dispatch stays on device
+            )
+        outs, self._rnn_state = self._rnn_step_fn(
+            self.params, self.state, self._rnn_state, xs
+        )
+        if single_step:
+            outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference: ComputationGraph.rnnClearPreviousState."""
+        self._rnn_state = None
+
+    def rnn_get_previous_state(self, vertex_name: str):
+        """Reference: ComputationGraph.rnnGetPreviousState(layerName)."""
+        if self._rnn_state is None:
+            return None
+        st = self._rnn_state.get(vertex_name)
+        return st if st else None
+
     # -------------------------------------------------------------- inference
     def output(self, *inputs, train: bool = False, masks=None):
         """Output activations (reference: ComputationGraph.output). Returns a
@@ -250,7 +430,7 @@ class ComputationGraph:
                 lambda params, state, xs, masks: self._forward(
                     params, xs, state, False, None, masks
                 )[0]
-            )
+            )  # _forward returns (outs, state, rnn); [0] = outputs
         outs = self._eval_forward(
             self.params, self.state, [jnp.asarray(x) for x in inputs], masks
         )

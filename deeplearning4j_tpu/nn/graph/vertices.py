@@ -163,6 +163,28 @@ class LayerVertex(BaseVertex):
         mask = None if masks is None else masks.get("features")
         return self.layer.apply(params, x, state, train=train, rng=rng, mask=mask)
 
+    # ---- streaming/TBPTT support (reference: ComputationGraph.rnnTimeStep
+    # :1801 routes through each vertex's rnnTimeStep; only layer vertices
+    # carry recurrent state) ------------------------------------------------
+    @property
+    def is_recurrent(self) -> bool:
+        return bool(getattr(self.layer, "is_recurrent", False)) and hasattr(
+            self.layer, "init_recurrent_state"
+        )
+
+    def init_recurrent_state(self, batch: int):
+        return self.layer.init_recurrent_state(batch)
+
+    def apply_seq(self, params, inputs, rstate, *, train=False, rng=None, masks=None):
+        """Like apply() but threads recurrent h/c state across calls."""
+        x = inputs[0]
+        if self.preprocessor is not None:
+            x = self.preprocessor.apply(x)
+        mask = None if masks is None else masks.get("features")
+        return self.layer.apply_seq(
+            params, x, rstate, mask=mask, train=train, rng=rng
+        )
+
     def pre_output_input(self, inputs):
         x = inputs[0]
         if self.preprocessor is not None:

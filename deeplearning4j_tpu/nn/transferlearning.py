@@ -52,6 +52,10 @@ class TransferLearningBuilder:
         self._params: List = [
             jax.tree_util.tree_map(lambda a: a, p) for p in net.params
         ]
+        # layer state (BN running mean/var) rides along with the params
+        self._states: List = [
+            jax.tree_util.tree_map(lambda a: a, s) for s in net.state
+        ]
         self._fine_tune: Optional[FineTuneConfiguration] = None
         self._freeze_until: Optional[int] = None
         self._reinit: set = set()
@@ -72,11 +76,13 @@ class TransferLearningBuilder:
         for _ in range(n):
             self._conf.layers.pop()
             self._params.pop()
+            self._states.pop()
         return self
 
     def add_layer(self, layer: BaseLayer) -> "TransferLearningBuilder":
         self._conf.layers.append(layer)
         self._params.append(None)  # fresh init at build
+        self._states.append(None)
         return self
 
     def n_out_replace(self, layer_idx: int, n_out: int,
@@ -116,6 +122,200 @@ class TransferLearningBuilder:
                 params.append(layer.init_params(keys[i], input_types[i]))
         net = MultiLayerNetwork(conf)
         net.init(params=tuple(params))
+        net.state = tuple(
+            self._states[i]
+            if i < len(self._states) and self._states[i] is not None and i not in self._reinit
+            else net.state[i]
+            for i in range(len(conf.layers))
+        )
+        return net
+
+
+class TransferLearningGraphBuilder:
+    """Vertex-level surgery on a trained ComputationGraph
+    (reference: TransferLearning.GraphBuilder:420).
+
+    Supported operations, mirroring the reference:
+    ``fine_tune_configuration``, ``set_feature_extractor(*names)`` (freezes the
+    named vertices and every vertex on a path from an input to them),
+    ``remove_vertex_and_connections``, ``remove_vertex_keep_connections``,
+    ``add_layer``/``add_vertex``, ``n_out_replace`` (re-initializes the changed
+    layer and its layer consumers' now-stale input weights), ``set_outputs``.
+    """
+
+    def __init__(self, net):
+        from .conf.computation_graph import ComputationGraphConfiguration
+
+        net.init()
+        self._conf = ComputationGraphConfiguration.from_dict(net.conf.to_dict())
+        self._params = {
+            k: jax.tree_util.tree_map(lambda a: a, v) for k, v in net.params.items()
+        }
+        # layer state (BN running mean/var) must survive surgery — a frozen
+        # extractor re-running with fresh 0/1 statistics would silently change
+        # its outputs
+        self._state = {
+            k: jax.tree_util.tree_map(lambda a: a, v) for k, v in net.state.items()
+        }
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze: set = set()
+        self._reinit: set = set()
+        self._kept_connections: dict = {}
+
+    # ------------------------------------------------------------- operations
+    def fine_tune_configuration(
+        self, cfg: FineTuneConfiguration
+    ) -> "TransferLearningGraphBuilder":
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str) -> "TransferLearningGraphBuilder":
+        """Freeze the named vertices and everything between them and the
+        network inputs (reference: GraphBuilder.setFeatureExtractor)."""
+        missing = [n for n in vertex_names if n not in self._conf.vertices]
+        if missing:
+            raise ValueError(f"Unknown vertices: {missing}")
+        self._freeze.update(vertex_names)
+        return self
+
+    def remove_vertex_and_connections(self, name: str) -> "TransferLearningGraphBuilder":
+        """Remove the vertex and every edge touching it (reference:
+        GraphBuilder.removeVertexAndConnections). Downstream vertices lose this
+        input — re-wire them with add_layer/add_vertex before build()."""
+        self._drop_vertex(name)
+        for ins in self._conf.vertex_inputs.values():
+            while name in ins:
+                ins.remove(name)
+        return self
+
+    def remove_vertex_keep_connections(self, name: str) -> "TransferLearningGraphBuilder":
+        """Remove the vertex but remember its edges: re-adding a vertex with
+        the same name reuses them (reference: removeVertexKeepConnections)."""
+        self._kept_connections[name] = (
+            list(self._conf.vertex_inputs.get(name, [])),
+            name in self._conf.network_outputs,
+        )
+        self._drop_vertex(name)
+        return self
+
+    def _drop_vertex(self, name: str) -> None:
+        if name not in self._conf.vertices:
+            raise ValueError(f"Unknown vertex '{name}'")
+        del self._conf.vertices[name]
+        self._conf.vertex_inputs.pop(name, None)
+        self._params.pop(name, None)
+        self._reinit.discard(name)
+        if name in self._conf.network_outputs:
+            self._conf.network_outputs.remove(name)
+
+    def add_layer(self, name: str, layer: BaseLayer, *inputs: str) -> "TransferLearningGraphBuilder":
+        from .graph.vertices import LayerVertex
+
+        return self.add_vertex(name, LayerVertex(layer=layer), *inputs)
+
+    def add_vertex(self, name: str, vertex, *inputs: str) -> "TransferLearningGraphBuilder":
+        if not inputs and name in self._kept_connections:
+            kept_inputs, was_output = self._kept_connections.pop(name)
+            inputs = tuple(kept_inputs)
+            if was_output and name not in self._conf.network_outputs:
+                self._conf.network_outputs.append(name)
+        if not inputs:
+            raise ValueError(
+                f"Vertex '{name}' needs inputs (none given and no kept connections)"
+            )
+        self._conf.vertices[name] = vertex
+        self._conf.vertex_inputs[name] = list(inputs)
+        self._reinit.add(name)
+        return self
+
+    def n_out_replace(
+        self, name: str, n_out: int, weight_init: Optional[str] = None
+    ) -> "TransferLearningGraphBuilder":
+        """Change a layer vertex's n_out, re-initializing it and its layer
+        consumers (reference: GraphBuilder.nOutReplace)."""
+        vertex = self._conf.vertices.get(name)
+        layer = getattr(vertex, "layer", None)
+        if layer is None:
+            raise ValueError(f"'{name}' is not a layer vertex")
+        layer.n_out = int(n_out)
+        if weight_init is not None:
+            layer.weight_init = weight_init
+        self._reinit.add(name)
+        for cname, ins in self._conf.vertex_inputs.items():
+            if name in ins:
+                consumer = getattr(self._conf.vertices[cname], "layer", None)
+                if consumer is None:
+                    raise ValueError(
+                        f"n_out_replace('{name}'): consumer '{cname}' is not a "
+                        "layer vertex; its downstream widths cannot be fixed up "
+                        "automatically — remove and re-add that subgraph instead"
+                    )
+                if hasattr(consumer, "n_in"):
+                    consumer.n_in = int(n_out)
+                self._reinit.add(cname)
+        return self
+
+    def set_outputs(self, *names: str) -> "TransferLearningGraphBuilder":
+        self._conf.network_outputs = list(names)
+        return self
+
+    # ------------------------------------------------------------------ build
+    def _frozen_closure(self) -> set:
+        """The freeze set plus all its ancestors (paths back to inputs)."""
+        closure, stack = set(), list(self._freeze)
+        while stack:
+            n = stack.pop()
+            if n in closure or n not in self._conf.vertices:
+                continue
+            closure.add(n)
+            stack.extend(self._conf.vertex_inputs.get(n, []))
+        return closure
+
+    def build(self):
+        from .graph.computation_graph import ComputationGraph
+        from .graph.vertices import LayerVertex
+
+        conf = self._conf
+        if self._fine_tune is not None:
+            self._fine_tune.apply(conf)
+        for name in self._frozen_closure():
+            v = conf.vertices[name]
+            if isinstance(v, LayerVertex) and not isinstance(v.layer, FrozenLayer):
+                v.layer = FrozenLayer(layer=v.layer)
+        dangling = {}
+        for name, ins in conf.vertex_inputs.items():
+            missing = [
+                s for s in ins
+                if s not in conf.vertices and s not in conf.network_inputs
+            ]
+            if missing or not ins:
+                dangling[name] = missing or ["<no inputs>"]
+        if dangling:
+            raise ValueError(f"Vertices with removed inputs not re-wired: {dangling}")
+        unknown_outputs = [o for o in conf.network_outputs if o not in conf.vertices]
+        if unknown_outputs:
+            raise ValueError(f"set_outputs names are not vertices: {unknown_outputs}")
+        topo = conf.topological_order()
+        vit = conf.vertex_input_types()
+        key = jax.random.PRNGKey(conf.seed)
+        keys = jax.random.split(key, max(len(topo), 1))
+        params = {}
+        for name, k in zip(topo, keys):
+            if name in self._params and name not in self._reinit:
+                params[name] = self._params[name]
+            else:
+                params[name] = conf.vertices[name].init_params(k, *vit[name])
+        net = ComputationGraph(conf)
+        net.init(params=params)
+        # restore carried layer state (BN running stats) over the fresh init
+        net.state = {
+            name: (
+                self._state[name]
+                if name in self._state and name not in self._reinit
+                else net.state[name]
+            )
+            for name in net.state
+        }
         return net
 
 
@@ -123,3 +323,4 @@ class TransferLearning:
     """Namespace matching the reference's TransferLearning.Builder entry point."""
 
     Builder = TransferLearningBuilder
+    GraphBuilder = TransferLearningGraphBuilder

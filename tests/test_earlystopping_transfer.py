@@ -286,3 +286,141 @@ class TestRegressionEvaluation:
         ev.eval(y, p, mask=mask)
         assert ev._n == 6
         assert ev.mean_squared_error(0) == pytest.approx(1.0)
+
+
+class TestTransferLearningGraph:
+    """Round-1 missing #3: TransferLearning.GraphBuilder vertex surgery
+    (reference: TransferLearning.java:420)."""
+
+    def _trained_graph(self, rng):
+        from deeplearning4j_tpu import ComputationGraphConfiguration, ComputationGraph
+
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_out=16, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "d1")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "d2")
+            .set_outputs("out")
+            .updater(UpdaterConfig(updater="adam", learning_rate=2e-2))
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(64, 4))
+        w = np.random.default_rng(9).normal(size=(4, 3))
+        y = np.eye(3)[(x @ w).argmax(-1)]
+        net.fit((x, y), epochs=30)
+        return net, x, y
+
+    def test_freeze_subgraph_and_replace_output_vertex(self, rng):
+        from deeplearning4j_tpu import TransferLearning
+        from deeplearning4j_tpu.nn.layers.frozen import FrozenLayer
+
+        net, x, y = self._trained_graph(rng)
+        d1_before = np.asarray(net.params["d1"]["W"])
+
+        new_net = (
+            TransferLearning.GraphBuilder(net)
+            .fine_tune_configuration(
+                FineTuneConfiguration(updater=UpdaterConfig(updater="sgd", learning_rate=0.1))
+            )
+            .set_feature_extractor("d2")  # freezes d2 AND its ancestor d1
+            .remove_vertex_and_connections("out")
+            .add_layer("new_out",
+                       OutputLayer(n_out=5, activation="softmax", loss="mcxent"), "d2")
+            .set_outputs("new_out")
+            .build()
+        )
+        # frozen wrappers in place
+        assert isinstance(new_net.conf.vertices["d1"].layer, FrozenLayer)
+        assert isinstance(new_net.conf.vertices["d2"].layer, FrozenLayer)
+        # feature-extractor params carried over, new head fresh with n_out=5
+        np.testing.assert_array_equal(np.asarray(new_net.params["d1"]["W"]), d1_before)
+        assert new_net.params["new_out"]["W"].shape == (8, 5)
+
+        y5 = np.eye(5)[rng.integers(0, 5, size=64)]
+        new_net.fit((x, y5), epochs=5)
+        # frozen params unchanged by training; new head moved
+        np.testing.assert_array_equal(np.asarray(new_net.params["d1"]["W"]), d1_before)
+        out = new_net.output(x)
+        assert out.shape == (64, 5)
+
+    def test_n_out_replace_reinitializes_consumers(self, rng):
+        from deeplearning4j_tpu import TransferLearning
+
+        net, x, y = self._trained_graph(rng)
+        d1_before = np.asarray(net.params["d1"]["W"])
+        new_net = (
+            TransferLearning.GraphBuilder(net)
+            .n_out_replace("d2", 12)
+            .build()
+        )
+        assert new_net.params["d2"]["W"].shape == (16, 12)
+        assert new_net.params["out"]["W"].shape == (12, 3)
+        np.testing.assert_array_equal(np.asarray(new_net.params["d1"]["W"]), d1_before)
+        new_net.fit((x, y), epochs=2)  # still trains end-to-end
+
+    def test_remove_vertex_keep_connections_rewires_by_name(self, rng):
+        from deeplearning4j_tpu import TransferLearning
+
+        net, x, y = self._trained_graph(rng)
+        new_net = (
+            TransferLearning.GraphBuilder(net)
+            .remove_vertex_keep_connections("out")
+            .add_layer("out", OutputLayer(n_out=7, activation="softmax", loss="mcxent"))
+            .build()
+        )
+        assert new_net.params["out"]["W"].shape == (8, 7)
+        assert np.asarray(new_net.output(x)).shape == (64, 7)
+
+    def test_dangling_inputs_rejected(self, rng):
+        from deeplearning4j_tpu import TransferLearning
+
+        net, _, _ = self._trained_graph(rng)
+        b = TransferLearning.GraphBuilder(net).remove_vertex_and_connections("d2")
+        with pytest.raises(ValueError, match="not re-wired"):
+            b.build()
+
+    def test_surgery_preserves_batchnorm_running_stats(self, rng):
+        """BN running mean/var must ride along with frozen params — a fresh
+        0/1 state would silently change the extractor's inference outputs."""
+        from deeplearning4j_tpu import (
+            BatchNormalization, ComputationGraph, ComputationGraphConfiguration,
+            TransferLearning,
+        )
+
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("bn", BatchNormalization(), "d1")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "bn")
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(64, 4)) * 3 + 1  # non-trivial stats
+        y = np.eye(3)[rng.integers(0, 3, size=64)]
+        net.fit((x, y), epochs=10)
+        mean_before = np.asarray(net.state["bn"]["mean"])
+        assert np.abs(mean_before).max() > 0.05  # stats actually moved
+
+        new_net = (
+            TransferLearning.GraphBuilder(net)
+            .set_feature_extractor("bn")
+            .remove_vertex_and_connections("out")
+            .add_layer("head", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "bn")
+            .set_outputs("head")
+            .build()
+        )
+        np.testing.assert_array_equal(np.asarray(new_net.state["bn"]["mean"]), mean_before)
+
+    def test_set_outputs_typo_rejected_at_build(self, rng):
+        from deeplearning4j_tpu import TransferLearning
+
+        net, _, _ = self._trained_graph(rng)
+        b = TransferLearning.GraphBuilder(net).set_outputs("no_such_vertex")
+        with pytest.raises(ValueError, match="not vertices"):
+            b.build()

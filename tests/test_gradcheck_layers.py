@@ -191,7 +191,7 @@ def test_computation_graph_vertex_gradients():
     y = _labels(3, 2)
 
     def loss(p, xx, yy):
-        l, _ = net._loss(p, net.state, [xx], [yy], None, True, None, None)
+        l, _, _ = net._loss(p, net.state, [xx], [yy], None, True, None, None)
         return l
 
     passed, failures, max_rel = gradient_check(
