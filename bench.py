@@ -1,4 +1,4 @@
-"""Benchmark harness: prints ONE JSON line for the driver.
+"""Benchmark harness: prints ONE JSON line for the driver — always.
 
 Headline metric (BASELINE.md config #2 / BASELINE.json north-star):
 **ResNet-50 ImageNet-shape training throughput, images/sec/chip**, bf16,
@@ -6,65 +6,119 @@ batch 128, single chip. Batches are staged on-device before timing (MLPerf
 convention) so the number measures the training step — on this harness's
 tunnel-attached chip, per-step host→device transfer is tunnel-bound and
 would measure the tunnel, not the framework; real TPU hosts overlap the
-~4ms PCIe/DMA transfer under the 29ms step via DevicePrefetchIterator.
+~4ms PCIe/DMA transfer under the step via DevicePrefetchIterator.
+
+Contract & failure design (hard-learned: round 1 rc=1, round 2 rc=124):
+the TPU tunnel can hang INSIDE a C-level XLA call, where no Python signal
+handler runs — so an in-process deadline cannot save the print. Therefore:
+
+- The parent process NEVER initializes the TPU backend. The entire TPU
+  attempt runs in a child subprocess (``--tpu-child``) whose stdout is the
+  metric line; the parent waits with a wall-clock budget and SIGTERM→SIGKILLs
+  a wedged child (SIGTERM's default disposition terminates even a process
+  blocked in C).
+- Budget: ``BENCH_DEADLINE_S`` (default 480s) total; the child gets
+  the budget minus a reserve for the CPU fallback. On child failure the
+  parent forces the CPU backend and runs the MLP fallback metric.
+- A ``signal.alarm`` backstop in the parent prints an error line and hard-exits
+  should even the CPU path stall.
+- The child enables the persistent XLA compilation cache so a healthy driver
+  run pays ResNet-50 compile once per machine, not once per round.
 
 The reference publishes no numbers (BASELINE.md) so vs_baseline is the ratio
 to the FIRST recorded value of this same metric (stored in BENCH_SELF.json),
 i.e. the driver tracks round-over-round improvement; 1.0 on first run.
-
-Off-TPU (CPU dev boxes) falls back to the round-1 MLP metric so the harness
-always prints a line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 SELF_BASELINE_PATH = os.environ.get(
-    "BENCH_SELF_PATH", os.path.join(os.path.dirname(__file__), "BENCH_SELF.json")
+    "BENCH_SELF_PATH", os.path.join(REPO_DIR, "BENCH_SELF.json")
 )
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "480"))
+CPU_RESERVE_S = float(os.environ.get("BENCH_CPU_RESERVE_S", "150"))
+CACHE_DIR = os.environ.get("BENCH_XLA_CACHE_DIR", "/tmp/dl4j_tpu_xla_cache")
+
+
+def _enable_compilation_cache() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
 
 
 def bench_resnet50(batch: int = 128, steps: int = 30, warmup: int = 2) -> dict:
+    """ResNet-50 training throughput + step breakdown + XLA-reported MFU."""
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu import profiler
     from deeplearning4j_tpu.models.resnet import resnet50_conf
     from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
 
-    conf = resnet50_conf(dtype="bfloat16")
-    net = ComputationGraph(conf).init()
-    net._train_step = net._build_train_step()
+    timer = profiler.StepTimer()
+    with timer.phase("build"):
+        conf = resnet50_conf(dtype="bfloat16")
+        net = ComputationGraph(conf).init()
+        net._train_step = net._build_train_step()
 
-    rng = np.random.default_rng(0)
-    x = jax.device_put(
-        jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.float32)
-    )
-    y = jax.device_put(
-        jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
-    )
-    key = jax.random.PRNGKey(0)
+    with timer.phase("data"):
+        rng = np.random.default_rng(0)
+        x = jax.device_put(
+            jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.float32)
+        )
+        y = jax.device_put(
+            jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+        )
+        key = jax.random.PRNGKey(0)
+
     p, o, s = net.params, net.opt_state, net.state
-    for _ in range(max(warmup, 1)):  # >=1: binds loss + compiles before timing
-        p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
-    jax.block_until_ready(loss)
+    with timer.phase("compile"):  # first call compiles (or hits the disk cache)
+        for _ in range(max(warmup, 1)):
+            p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
+        jax.block_until_ready(loss)
+    # After warmup: the AOT lower().compile() inside compiled_flops now hits
+    # the persistent cache instead of paying the ResNet-50 compile twice.
+    flops = profiler.compiled_flops(net._train_step, p, o, s, [x], [y], key, None, None)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    with timer.phase("step"):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
     assert np.isfinite(float(loss)), f"non-finite loss {loss}"
 
-    return {
+    step_s = dt / steps
+    result = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": round(steps * batch / dt, 1),
         "unit": "images/sec/chip",
+        "breakdown": timer.breakdown(),
     }
+    result["breakdown"]["step"]["mean_ms"] = round(1000 * step_s, 3)
+    if flops:
+        result["flops_per_step"] = flops
+        result["mfu_pct"] = round(profiler.mfu(flops, step_s), 1)
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:  # optional deep dive: xplane trace of 3 steady-state steps
+        with profiler.trace(trace_dir):
+            for _ in range(3):
+                p, o, s, loss = net._train_step(p, o, s, [x], [y], key, None, None)
+            jax.block_until_ready(loss)
+        result["trace_dir"] = trace_dir
+    return result
 
 
 def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
@@ -135,60 +189,124 @@ def _with_self_baseline(result: dict) -> dict:
     return result
 
 
-def _probe_backend(timeout: float = 240.0) -> str | None:
-    """Ask a subprocess which jax backend initializes. Returns None on any
-    failure (crash, hang, nonzero exit) — the TPU tunnel can be wedged, and
-    probing it in-process would take this process down with it (round-1 bench
-    died exactly that way: BENCH_r01.json rc=1). On timeout, SIGTERM first and
-    give the process time to release its tunnel claim — a SIGKILL mid-claim
-    wedges the tunnel for every later process."""
-    import signal
-    import subprocess
-    import sys
-
-    proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
-        text=True,
-    )
-    def _graceful_stop():
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        _graceful_stop()
-        return None
-    except Exception:
-        _graceful_stop()
-        return None
-    if proc.returncode == 0 and out and out.strip():
-        return out.strip().splitlines()[-1]
-    return None
-
-
 def _force_cpu() -> None:
     from __graft_entry__ import _force_cpu_mesh
 
     _force_cpu_mesh(1)
 
 
-if __name__ == "__main__":
-    # Contract: this block ALWAYS prints exactly one JSON line, whatever the
-    # backend does. TPU healthy -> ResNet-50 headline metric; TPU absent or
-    # wedged -> CPU MLP fallback metric; even that failing -> an error line
-    # with the same keys so the driver records a parse instead of an rc!=0.
+def _tpu_child_main() -> int:
+    """Child process: initialize whatever backend the env pins (the TPU
+    tunnel), run the headline bench, print ONE json line. Never forces CPU —
+    if the default backend isn't a TPU the parent's fallback is better than a
+    CPU ResNet-50, so exit with a marker instead."""
+    import signal
+
+    # SIGTERM → SystemExit so atexit/PJRT teardown runs when the parent times
+    # us out while we're still in interruptible Python.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(2))
+    _enable_compilation_cache()
+    import jax
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        print(json.dumps({"metric": "bench_skip", "backend": backend}))
+        return 3
+    result = bench_resnet50()
+    result["backend"] = backend
+    print(json.dumps(result))
+    return 0
+
+
+def _run_tpu_child(timeout_s: float) -> dict | None:
+    """Spawn the TPU attempt; parse its metric line. None on any failure."""
+    import signal
+    import subprocess
+
+    if timeout_s <= 10:
+        return None
+    # Test hook: BENCH_TPU_CHILD_CMD substitutes the child argv so the
+    # wedged-tunnel path (child hangs / ignores SIGTERM) is reproducible
+    # without real TPU hardware (tests/test_driver_entry.py).
+    override = os.environ.get("BENCH_TPU_CHILD_CMD")
+    argv = (
+        json.loads(override)
+        if override
+        else [sys.executable, os.path.abspath(__file__), "--tpu-child"]
+    )
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO_DIR,
+    )
+    def _stop_child():
+        # SIGTERM first: default disposition kills even a C-blocked process,
+        # letting the OS close the tunnel claim; KILL only if it lingers.
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
     try:
-        backend = None if os.environ.get("BENCH_FORCE_CPU") else _probe_backend()
-        if backend != "tpu":
+        out, _ = proc.communicate(timeout=timeout_s)
+    except BaseException:  # timeout, Ctrl-C, OSError: never leak a live child
+        _stop_child()
+        return None
+    if not out:
+        return None
+    # Trust a parseable metric line even on rc!=0: a PJRT teardown crash
+    # AFTER the bench printed is a completed bench, not a failed one.
+    for line in reversed(out.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if parsed.get("metric") and parsed["metric"] not in ("bench_skip", "bench_error"):
+            return parsed
+    return None
+
+
+def _alarm_backstop(seconds: float) -> None:
+    """Last-resort guarantee: if the parent itself stalls, print and die."""
+    import signal
+
+    def _fire(*_):
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": "internal deadline expired (BENCH_DEADLINE_S backstop)",
+        }), flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(max(1, int(seconds)))
+
+
+if __name__ == "__main__":
+    if "--tpu-child" in sys.argv:
+        sys.exit(_tpu_child_main())
+
+    # Contract: this block ALWAYS prints exactly one JSON line, whatever the
+    # backend does. TPU healthy -> ResNet-50 headline metric (from the child);
+    # TPU absent or wedged -> CPU MLP fallback metric; even that failing -> an
+    # error line with the same keys so the driver records a parse, not rc!=0.
+    t_start = time.monotonic()
+    _alarm_backstop(DEADLINE_S)
+    try:
+        result = None
+        if not os.environ.get("BENCH_FORCE_CPU"):
+            child_budget = DEADLINE_S - CPU_RESERVE_S - (time.monotonic() - t_start)
+            result = _run_tpu_child(child_budget)
+        if result is None:
             _force_cpu()
-        result = bench_resnet50() if backend == "tpu" else bench_mlp_mnist()
+            _enable_compilation_cache()
+            result = bench_mlp_mnist()
         result = _with_self_baseline(result)
     except BaseException as e:  # noqa: BLE001 - the line must print regardless
         result = {
@@ -198,4 +316,7 @@ if __name__ == "__main__":
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:500],
         }
-    print(json.dumps(result))
+    import signal as _signal
+
+    _signal.alarm(0)  # a near-deadline finish must not print a second line
+    print(json.dumps(result), flush=True)

@@ -44,6 +44,9 @@ class ComputationGraph:
         self._rnn_state = None  # streaming rnnTimeStep state, one entry per vertex
         self._rnn_step_fn = None
         self._tbptt_step = None
+        self._grad_stats_step = None
+        self._last_grads = None  # populated when a listener needs_gradients
+        self._last_updates = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "ComputationGraph":
@@ -69,10 +72,21 @@ class ComputationGraph:
         self._tbptt_step = None  # closes over self._tx — must follow it
         self._rnn_step_fn = None
         self._rnn_state = None
+        self._grad_stats_step = None
         return self
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
+
+    def _wants_grad_stats(self) -> bool:
+        """See MultiLayerNetwork._wants_grad_stats — instrumented step only on
+        iterations a listener will actually report."""
+        nxt = self.iteration + 1
+        return any(
+            getattr(lst, "needs_gradients", False)
+            and nxt % max(1, getattr(lst, "frequency", 1)) == 0
+            for lst in self.listeners
+        )
 
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
@@ -179,7 +193,9 @@ class ComputationGraph:
         return val
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self):
+    def _build_train_step(self, with_grad_stats: bool = False):
+        """Jitted step; ``with_grad_stats`` also returns gradient/update
+        pytrees for StatsListener histograms (see MultiLayerNetwork note)."""
         tx = self._tx
 
         def step(params, opt_state, state, inputs, labels, rng, labels_masks, masks):
@@ -192,6 +208,8 @@ class ComputationGraph:
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if with_grad_stats:
+                return new_params, new_opt, new_state, loss, grads, updates
             return new_params, new_opt, new_state, loss
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
@@ -258,14 +276,26 @@ class ComputationGraph:
         lmasks = mds.labels_masks
         if lmasks is not None and all(m is None for m in lmasks):
             lmasks = None
-        self.params, self.opt_state, self.state, loss = self._train_step(
-            self.params, self.opt_state, self.state,
-            list(mds.features), list(mds.labels), step_key, lmasks, masks,
-        )
+        if self._wants_grad_stats():
+            if self._grad_stats_step is None:
+                self._grad_stats_step = self._build_train_step(with_grad_stats=True)
+            (self.params, self.opt_state, self.state, loss,
+             self._last_grads, self._last_updates) = self._grad_stats_step(
+                self.params, self.opt_state, self.state,
+                list(mds.features), list(mds.labels), step_key, lmasks, masks,
+            )
+        else:
+            self.params, self.opt_state, self.state, loss = self._train_step(
+                self.params, self.opt_state, self.state,
+                list(mds.features), list(mds.labels), step_key, lmasks, masks,
+            )
         self._last_loss = loss
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
+        # listeners have copied what they need; free the grad/update buffers
+        self._last_grads = None
+        self._last_updates = None
 
     # ------------------------------------------------------- TBPTT (graphs)
     def _init_rnn_states(self, batch: int):
@@ -337,6 +367,9 @@ class ComputationGraph:
         return jax.jit(step)
 
     def _fit_tbptt(self, mds) -> None:
+        # TBPTT bypasses the grad-stats step; drop stale grads (see MLN note).
+        self._last_grads = None
+        self._last_updates = None
         feats = [np.asarray(f) for f in mds.features]
         labs = [np.asarray(l) for l in mds.labels]
         n_in, n_out = len(feats), len(labs)

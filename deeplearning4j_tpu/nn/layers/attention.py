@@ -135,7 +135,8 @@ def set_attention_mesh(mesh, seq_axis: str = "seq", nets=()) -> None:
     global _ATTENTION_MESH
     _ATTENTION_MESH = None if mesh is None else (mesh, seq_axis)
     for net in nets:
-        for attr in ("_train_step", "_eval_forward", "_tbptt_step", "_rnn_step_fn"):
+        for attr in ("_train_step", "_eval_forward", "_tbptt_step", "_rnn_step_fn",
+                     "_grad_stats_step"):
             if hasattr(net, attr):
                 setattr(net, attr, None)
 

@@ -78,6 +78,9 @@ class MultiLayerNetwork:
         self._last_loss = None
         self._rnn_state = None  # streaming rnnTimeStep state, one entry per layer
         self._rnn_step_fn = None
+        self._grad_stats_step = None
+        self._last_grads = None  # populated when a listener needs_gradients
+        self._last_updates = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "MultiLayerNetwork":
@@ -104,6 +107,7 @@ class MultiLayerNetwork:
         self._eval_forward = None
         self._rnn_state = None
         self._rnn_step_fn = None
+        self._grad_stats_step = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -111,6 +115,18 @@ class MultiLayerNetwork:
 
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
+
+    def _wants_grad_stats(self) -> bool:
+        """True when some listener will consume gradient/update stats on the
+        iteration about to run — off-frequency iterations keep the donated
+        fast path (StatsListener(frequency=50) costs the instrumented step
+        on 1 of 50 steps, not all 50)."""
+        nxt = self.iteration + 1
+        return any(
+            getattr(lst, "needs_gradients", False)
+            and nxt % max(1, getattr(lst, "frequency", 1)) == 0
+            for lst in self.listeners
+        )
 
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
@@ -187,7 +203,12 @@ class MultiLayerNetwork:
         return val
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self):
+    def _build_train_step(self, with_grad_stats: bool = False):
+        """Jitted step. ``with_grad_stats`` additionally returns the gradient
+        and update pytrees so StatsListener can histogram them (reference:
+        BaseStatsListener.java:419-437 collects parameters, gradients AND
+        per-iteration updates). Kept off the default path: returning them
+        defeats buffer reuse XLA would otherwise apply."""
         tx = self._tx
 
         def step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
@@ -200,6 +221,8 @@ class MultiLayerNetwork:
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if with_grad_stats:
+                return new_params, new_opt, new_state, loss, grads, updates
             return new_params, new_opt, new_state, loss
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
@@ -243,14 +266,29 @@ class MultiLayerNetwork:
             self._fit_tbptt(ds)
             return
         self._rng, step_key = jax.random.split(self._rng)
-        self.params, self.opt_state, self.state, loss = self._train_step(
-            self.params, self.opt_state, self.state, ds.features, ds.labels, step_key,
-            getattr(ds, "labels_mask", None), getattr(ds, "features_mask", None),
-        )
+        if self._wants_grad_stats():
+            if self._grad_stats_step is None:
+                self._grad_stats_step = self._build_train_step(with_grad_stats=True)
+            (self.params, self.opt_state, self.state, loss,
+             self._last_grads, self._last_updates) = self._grad_stats_step(
+                self.params, self.opt_state, self.state, ds.features, ds.labels,
+                step_key,
+                getattr(ds, "labels_mask", None), getattr(ds, "features_mask", None),
+            )
+        else:
+            self.params, self.opt_state, self.state, loss = self._train_step(
+                self.params, self.opt_state, self.state, ds.features, ds.labels,
+                step_key,
+                getattr(ds, "labels_mask", None), getattr(ds, "features_mask", None),
+            )
         self._last_loss = loss
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
+        # listeners have copied what they need; don't pin ~2x model size of
+        # gradient+update buffers in HBM until the next instrumented step
+        self._last_grads = None
+        self._last_updates = None
 
     # ---------------------------------------------------------------- TBPTT
     def _init_rnn_states(self, batch: int):
@@ -323,6 +361,11 @@ class MultiLayerNetwork:
         """
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
+        # TBPTT uses its own jitted step without grad-stats instrumentation;
+        # drop any stale grads so StatsListener never histograms a previous
+        # non-TBPTT batch's gradients under this iteration's label.
+        self._last_grads = None
+        self._last_updates = None
         x, y = np.asarray(ds.features), np.asarray(ds.labels)
         fmask = getattr(ds, "features_mask", None)
         lmask = getattr(ds, "labels_mask", None)

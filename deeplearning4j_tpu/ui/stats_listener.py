@@ -1,11 +1,18 @@
 """StatsListener: rich per-iteration stats routed to a StatsStorage.
 
 Reference: deeplearning4j-ui-model/.../stats/BaseStatsListener.java (617 LoC;
-score/timing/memory collection :259-273, per-layer parameter histograms +
-mean magnitudes :419-437). The Agrona flyweight encoding is replaced by plain
-dicts (storage.py); the collection content matches: score, iteration timing,
-process memory, per-layer per-parameter mean-magnitude and histogram, plus
-JAX device memory stats where the backend exposes them.
+score/timing/memory collection :259-273, per-layer parameter/gradient/update
+histograms + mean magnitudes :419-437). The Agrona flyweight encoding is
+replaced by plain dicts (storage.py); the collection content matches: score,
+iteration timing, process + device memory, per-layer per-parameter
+mean-magnitude and histogram for parameters, gradients AND updates, plus a
+static model report carrying the graph structure the flow view renders
+(reference: FlowIterationListener builds the same node/edge model).
+
+Gradients/updates come from the model's instrumented train step
+(``_build_train_step(with_grad_stats=True)``), selected automatically when a
+listener with ``needs_gradients`` is attached — histogramming is paid only
+when a dashboard asks for it, keeping the donated-buffer fast path intact.
 """
 
 from __future__ import annotations
@@ -27,10 +34,10 @@ def _mean_magnitude(arr) -> float:
 
 
 def _histogram(arr, bins: int = 20) -> Dict[str, Any]:
-    a = np.asarray(arr).ravel()
+    a = np.asarray(arr).ravel().astype(np.float64)
     if a.size == 0:
         return {"bins": [], "counts": []}
-    counts, edges = np.histogram(a, bins=bins)
+    counts, edges = np.histogram(a[np.isfinite(a)], bins=bins)
     return {"bins": edges.tolist(), "counts": counts.tolist()}
 
 
@@ -44,6 +51,47 @@ def _process_memory_bytes() -> Optional[int]:
         return None
 
 
+def _named_param_groups(tree) -> List[tuple]:
+    """Normalize MLN (tuple of per-layer dicts) and CG (vertex-name -> dict)
+    param containers to [(group_name, {param_name: array})]."""
+    if tree is None:
+        return []
+    if isinstance(tree, dict):
+        return [(str(k), v) for k, v in tree.items() if v]
+    return [(str(i), p) for i, p in enumerate(tree) if p]
+
+
+def model_graph_info(model) -> Dict[str, Any]:
+    """Node/edge structure for the flow view (reference: FlowIterationListener
+    / FlowListenerModule build the same description from the live model)."""
+    conf = getattr(model, "conf", None)
+    nodes: List[dict] = []
+    edges: List[list] = []
+    if conf is None:
+        return {"nodes": nodes, "edges": edges}
+    if hasattr(conf, "vertices"):  # ComputationGraph
+        for inp in conf.network_inputs:
+            nodes.append({"name": inp, "type": "Input"})
+        for name, vertex in conf.vertices.items():
+            nodes.append({
+                "name": name,
+                "type": type(vertex).__name__,
+                "output": name in conf.network_outputs,
+            })
+            for src in conf.vertex_inputs.get(name, []):
+                edges.append([src, name])
+    elif hasattr(conf, "layers"):  # MultiLayerNetwork
+        nodes.append({"name": "input", "type": "Input"})
+        prev = "input"
+        for i, layer in enumerate(conf.layers):
+            name = f"{i}_{type(layer).__name__}"
+            nodes.append({"name": name, "type": type(layer).__name__,
+                          "output": i == len(conf.layers) - 1})
+            edges.append([prev, name])
+            prev = name
+    return {"nodes": nodes, "edges": edges}
+
+
 class StatsListener(TrainingListener):
     """Collects and routes training statistics every ``frequency`` iterations."""
 
@@ -54,6 +102,7 @@ class StatsListener(TrainingListener):
         session_id: Optional[str] = None,
         worker_id: str = "0",
         collect_histograms: bool = True,
+        collect_gradients: bool = True,
         histogram_bins: int = 20,
     ):
         self.router = router
@@ -61,9 +110,15 @@ class StatsListener(TrainingListener):
         self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
+        self.collect_gradients = collect_gradients
         self.histogram_bins = histogram_bins
         self._static_sent = False
         self._last_time: Optional[float] = None
+
+    @property
+    def needs_gradients(self) -> bool:
+        """Models check this to select the instrumented train step."""
+        return self.collect_gradients
 
     # -- static info: model architecture, once (reference: initial report) --
     def _send_static(self, model) -> None:
@@ -71,6 +126,12 @@ class StatsListener(TrainingListener):
         layers = []
         if conf is not None and hasattr(conf, "layers"):
             layers = [type(l).__name__ for l in conf.layers]
+        elif conf is not None and hasattr(conf, "vertices"):
+            layers = [type(v).__name__ for v in conf.vertices.values()]
+        param_counts = {
+            name: {k: int(np.size(v)) for k, v in group.items()}
+            for name, group in _named_param_groups(getattr(model, "params", None))
+        }
         self.router.put_static_info(
             {
                 "session_id": self.session_id,
@@ -78,11 +139,29 @@ class StatsListener(TrainingListener):
                 "timestamp": time.time(),
                 "model_class": type(model).__name__,
                 "layers": layers,
+                "graph": model_graph_info(model),
+                "param_counts": param_counts,
                 "num_params": model.num_params() if hasattr(model, "num_params") else None,
                 "pid": os.getpid(),
+                "backend": _backend_name(),
             }
         )
         self._static_sent = True
+
+    def _collect_tree(self, record: Dict[str, Any], key_prefix: str, tree) -> None:
+        if tree is None:  # e.g. TBPTT path: no instrumented grads this batch
+            return
+        mm: Dict[str, float] = {}
+        hists: Dict[str, Any] = {}
+        for gname, group in _named_param_groups(tree):
+            for k, v in group.items():
+                name = f"{gname}_{k}"
+                mm[name] = _mean_magnitude(v)
+                if self.collect_histograms:
+                    hists[name] = _histogram(v, self.histogram_bins)
+        record[f"{key_prefix}_mean_magnitudes"] = mm
+        if self.collect_histograms:
+            record[f"{key_prefix}_histograms"] = hists
 
     def iteration_done(self, model, iteration: int, score) -> None:
         if iteration % self.frequency:
@@ -103,20 +182,28 @@ class StatsListener(TrainingListener):
         mem = _process_memory_bytes()
         if mem is not None:
             record["memory_rss_bytes"] = mem
+        dev = _device_memory_stats()
+        if dev:
+            record["device_memory"] = dev
 
-        params = getattr(model, "params", None)
-        if params is not None:
-            mm: Dict[str, float] = {}
-            hists: Dict[str, Any] = {}
-            for i, layer_params in enumerate(params):
-                if not layer_params:
-                    continue
-                for k, v in layer_params.items():
-                    name = f"{i}_{k}"
-                    mm[name] = _mean_magnitude(v)
-                    if self.collect_histograms:
-                        hists[name] = _histogram(v, self.histogram_bins)
-            record["param_mean_magnitudes"] = mm
-            if self.collect_histograms:
-                record["param_histograms"] = hists
+        self._collect_tree(record, "param", getattr(model, "params", None))
+        if self.collect_gradients:
+            self._collect_tree(record, "gradient", getattr(model, "_last_grads", None))
+            self._collect_tree(record, "update", getattr(model, "_last_updates", None))
         self.router.put_update(record)
+
+
+def _backend_name() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return None
+
+
+def _device_memory_stats() -> List[dict]:
+    """One implementation of the PJRT device-memory walk — profiler's."""
+    from ..profiler import device_memory_stats
+
+    return device_memory_stats()

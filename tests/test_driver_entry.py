@@ -42,6 +42,87 @@ def test_dryrun_multichip_under_pinned_axon_platform():
     assert "DRYRUN_OK" in proc.stdout
 
 
+def test_bench_survives_wedged_tpu_child(tmp_path):
+    """Round-2 failure mode (BENCH_r02.json rc=124): the TPU attempt hangs
+    inside backend init where no in-process deadline can fire. The parent
+    must SIGTERM the child at its budget and still print the fallback line
+    well inside BENCH_DEADLINE_S."""
+    hang = json.dumps([sys.executable, "-c", "import time; time.sleep(600)"])
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=_driver_env(
+            BENCH_TPU_CHILD_CMD=hang,
+            BENCH_DEADLINE_S="180",
+            BENCH_CPU_RESERVE_S="150",
+            BENCH_SELF_PATH=str(tmp_path / "self.json"),
+        ),
+        capture_output=True,
+        text=True,
+        timeout=170,
+    )
+    assert proc.returncode == 0, f"stderr tail: {proc.stderr[-2000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"stdout: {proc.stdout[-2000:]}"
+    result = json.loads(lines[0])
+    assert result["metric"] == "mlp_mnist_train_samples_per_sec", result
+    assert result["value"] > 0
+
+
+def test_bench_kills_sigterm_immune_child(tmp_path):
+    """Escalation path: a child that ignores SIGTERM (C-wedged analog) is
+    SIGKILLed after the grace window and the fallback still prints."""
+    immune = json.dumps([
+        sys.executable,
+        "-c",
+        "import signal, time; signal.signal(signal.SIGTERM, signal.SIG_IGN); time.sleep(600)",
+    ])
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=_driver_env(
+            BENCH_TPU_CHILD_CMD=immune,
+            BENCH_DEADLINE_S="200",
+            BENCH_CPU_RESERVE_S="170",
+            BENCH_SELF_PATH=str(tmp_path / "self.json"),
+        ),
+        capture_output=True,
+        text=True,
+        timeout=190,
+    )
+    assert proc.returncode == 0, f"stderr tail: {proc.stderr[-2000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["value"] > 0
+
+
+def test_bench_uses_healthy_child_result(tmp_path):
+    """A child that prints a metric line is trusted verbatim (the TPU path),
+    and the parent applies the self-baseline ratio on top."""
+    fake = json.dumps([
+        sys.executable,
+        "-c",
+        "import json; print(json.dumps({'metric': 'resnet50_imagenet_train_images_per_sec_per_chip', 'value': 1234.5, 'unit': 'images/sec/chip'}))",
+    ])
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=_driver_env(
+            BENCH_TPU_CHILD_CMD=fake,
+            BENCH_SELF_PATH=str(tmp_path / "self.json"),
+        ),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, f"stderr tail: {proc.stderr[-2000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    result = json.loads(lines[0])
+    assert result["metric"] == "resnet50_imagenet_train_images_per_sec_per_chip"
+    assert result["value"] == 1234.5
+    assert result["vs_baseline"] == 1.0  # first recorded value
+
+
 def test_bench_always_prints_one_json_line(tmp_path):
     proc = subprocess.run(
         [sys.executable, "bench.py"],

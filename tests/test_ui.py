@@ -120,6 +120,42 @@ class TestStatsListener:
         self._train(st, frequency=2)
         assert len(st.get_all_updates("test_sess")) == 2  # iters 2 and 4
 
+    def test_collects_gradient_and_update_histograms(self):
+        """Reference parity: BaseStatsListener.java:419-437 histograms
+        parameters, gradients AND updates (VERDICT round-2 task 3)."""
+        st = InMemoryStatsStorage()
+        self._train(st)
+        u = st.get_all_updates("test_sess")[-1]
+        for kind in ("gradient", "update"):
+            mm = u[f"{kind}_mean_magnitudes"]
+            assert "0_W" in mm and "1_b" in mm, (kind, sorted(mm))
+            assert all(np.isfinite(v) for v in mm.values())
+            hists = u[f"{kind}_histograms"]
+            assert len(hists["0_W"]["counts"]) == 20
+            assert sum(hists["0_W"]["counts"]) == 4 * 8  # one count per weight
+        # SGD: update = -lr * grad, so mean magnitudes are proportional
+        gm = u["gradient_mean_magnitudes"]["0_W"]
+        um = u["update_mean_magnitudes"]["0_W"]
+        assert um == pytest.approx(0.1 * gm, rel=1e-4)
+
+    def test_static_report_carries_flow_graph(self):
+        st = InMemoryStatsStorage()
+        self._train(st)
+        static = st.get_static_info("test_sess")[0]
+        g = static["graph"]
+        names = [n["name"] for n in g["nodes"]]
+        assert names == ["input", "0_DenseLayer", "1_OutputLayer"]
+        assert g["edges"] == [["input", "0_DenseLayer"],
+                              ["0_DenseLayer", "1_OutputLayer"]]
+        assert static["param_counts"]["0"]["W"] == 4 * 8
+
+    def test_gradient_collection_opt_out_uses_fast_path(self):
+        st = InMemoryStatsStorage()
+        net = self._train(st, collect_gradients=False)
+        u = st.get_all_updates("test_sess")[-1]
+        assert "gradient_mean_magnitudes" not in u
+        assert net._grad_stats_step is None  # instrumented step never built
+
 
 class TestUIServer:
     def test_server_endpoints_and_remote_router(self):
@@ -157,5 +193,54 @@ class TestUIServer:
                  "iteration": 0, "score": 0.1}
             )
             assert "remote_sess" in st.list_session_ids()
+        finally:
+            server.stop()
+
+    def test_dashboard_renders_recorded_training(self):
+        """VERDICT round-2 task 3 'done' condition: histogram and model
+        endpoints render non-empty from a recorded StatsStorage, and every
+        train page (overview/model/system/flow) serves."""
+        server = UIServer(port=0)
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            base = f"http://127.0.0.1:{server.port}"
+            TestStatsListener()._train(st)
+
+            for page, marker in [("overview", "Score vs iteration"),
+                                 ("model", "Latest histogram"),
+                                 ("system", "Device memory"),
+                                 ("flow", "Network graph")]:
+                html = urllib.request.urlopen(f"{base}/train/{page}").read().decode()
+                assert marker in html, page
+
+            h = json.loads(urllib.request.urlopen(
+                f"{base}/api/histograms?session=test_sess").read())
+            assert h["iteration"] == 5
+            for key in ("param_histograms", "gradient_histograms",
+                        "update_histograms"):
+                assert h[key]["0_W"]["counts"], key
+                assert len(h[key]["0_W"]["bins"]) == 21
+
+            mm = json.loads(urllib.request.urlopen(
+                f"{base}/api/meanmag?session=test_sess").read())
+            assert mm["iterations"] == [1, 2, 3, 4, 5]
+            assert len(mm["param"]["0_W"]) == 5
+            assert len(mm["gradient"]["1_b"]) == 5
+            assert all(v is not None for v in mm["update"]["0_W"])
+
+            sysrows = json.loads(urllib.request.urlopen(
+                f"{base}/api/system?session=test_sess").read())
+            assert sysrows[-1]["memory_rss_bytes"] > 0
+            assert "param_mean_magnitudes" not in sysrows[-1]
+
+            static = json.loads(urllib.request.urlopen(
+                f"{base}/api/static?session=test_sess").read())
+            assert static[0]["graph"]["nodes"]
+
+            # a specific iteration's histograms are addressable
+            h3 = json.loads(urllib.request.urlopen(
+                f"{base}/api/histograms?session=test_sess&iteration=3").read())
+            assert h3["iteration"] == 3
         finally:
             server.stop()
