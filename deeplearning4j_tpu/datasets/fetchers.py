@@ -63,6 +63,58 @@ def _synthetic_classification(n: int, n_features: int, n_classes: int, seed: int
     return x.astype(np.float32), y.astype(np.int64)
 
 
+# Official MNIST gz digests (reference: MnistFetcher.java:39 pins MD5s for
+# the same four files; SHA-256 here).
+MNIST_SHA256 = {
+    "train-images-idx3-ubyte.gz":
+        "440fcabf73cc546fa21475e81ea370265605f56be210a4024d2ca8f203523609",
+    "train-labels-idx1-ubyte.gz":
+        "3552534a0a558bbed6aed32b30c495cca23d567ec52cac8be1a0730e8010255c",
+    "t10k-images-idx3-ubyte.gz":
+        "8d422c7b0a1c1c79245a5bcf07fe86e33eeafee792b84584aec276f5a2dbc4e6",
+    "t10k-labels-idx1-ubyte.gz":
+        "f7ae60f92e00ec6debd23a6088c31dbd2371eca3ffa0defaefb259924204aec6",
+}
+
+
+def fetch_mnist(root: Optional[str] = None, base_url: Optional[str] = None,
+                checksums: Optional[dict] = None) -> str:
+    """Download + checksum-verify the four MNIST IDX archives into ``root``
+    (reference: base/MnistFetcher.java:39 — downloadAndUntar with pinned
+    digests). Env-gated by nature: on a no-egress machine the urlopen fails
+    and callers fall back to local/synthetic data via :func:`load_mnist`.
+
+    ``base_url`` defaults to ``$DL4J_TPU_MNIST_URL`` (any mirror, including
+    ``file://`` trees for tests) else the canonical host. A digest mismatch
+    deletes the file and raises — a truncated or tampered download never
+    parses as data.
+    """
+    import hashlib
+    import urllib.request
+
+    root = root or os.environ.get("MNIST_DIR", os.path.expanduser("~/.dl4j-tpu/mnist"))
+    base = (base_url or os.environ.get("DL4J_TPU_MNIST_URL")
+            or "https://ossci-datasets.s3.amazonaws.com/mnist/").rstrip("/")
+    digests = checksums if checksums is not None else MNIST_SHA256
+    os.makedirs(root, exist_ok=True)
+    for name, want in digests.items():
+        dest = os.path.join(root, name)
+        if os.path.exists(dest):
+            if hashlib.sha256(open(dest, "rb").read()).hexdigest() == want:
+                continue
+            os.remove(dest)  # stale/corrupt cache entry
+        with urllib.request.urlopen(f"{base}/{name}", timeout=60) as r:
+            data = r.read()
+        got = hashlib.sha256(data).hexdigest()
+        if got != want:
+            raise ValueError(
+                f"{name}: checksum mismatch (got {got[:16]}…, want {want[:16]}…)"
+            )
+        with open(dest, "wb") as f:
+            f.write(data)
+    return root
+
+
 def load_mnist(train: bool = True, root: Optional[str] = None):
     """(images [N,784] float32 in [0,1], labels [N] int) — real if present."""
     root = root or os.environ.get("MNIST_DIR", os.path.expanduser("~/.dl4j-tpu/mnist"))
@@ -106,6 +158,41 @@ class MnistDataSetIterator(NumpyDataSetIterator):
 # ---------------------------------------------------------------------------
 # Iris
 # ---------------------------------------------------------------------------
+
+
+def load_digits_dataset() -> Tuple[np.ndarray, np.ndarray]:
+    """Real handwritten digits, zero egress: sklearn's bundled UCI corpus
+    (1,797 8×8 grayscale scans — genuinely non-synthetic data available in
+    any sklearn install). Returns (images [N,64] float32 in [0,1], labels [N]).
+
+    Role parity: the accuracy-parity corpus the reference's MNIST tests play
+    (MnistFetcher + *accuracy-threshold integration tests, SURVEY.md §4.2)
+    on machines where MNIST itself cannot be downloaded.
+    """
+    from sklearn.datasets import load_digits as _ld
+
+    d = _ld()
+    x = (d.data / 16.0).astype(np.float32)
+    return x, d.target.astype(np.int64)
+
+
+class DigitsDataSetIterator(NumpyDataSetIterator):
+    """Iterator over the real sklearn digits corpus (8×8 images, 10 classes)."""
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 123, n_train: int = 1437, flat: bool = False,
+                 split_seed: int = 42):
+        x, y = load_digits_dataset()
+        # deterministic SHUFFLED train/test split: the corpus is ordered by
+        # writer, so a tail split measures writer shift, not model quality
+        perm = np.random.default_rng(split_seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+        sl = slice(None, n_train) if train else slice(n_train, None)
+        x, y = x[sl], y[sl]
+        if not flat:  # NHWC for conv models (LeNet config)
+            x = x.reshape(-1, 8, 8, 1)
+        labels = np.eye(10, dtype=np.float32)[y]
+        super().__init__(x, labels, batch, shuffle=shuffle, seed=seed)
 
 
 def load_iris():

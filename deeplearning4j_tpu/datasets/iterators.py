@@ -32,6 +32,9 @@ class DataSet:
     labels: np.ndarray
     features_mask: Optional[np.ndarray] = None
     labels_mask: Optional[np.ndarray] = None
+    # per-example provenance (reference: DataSet.getExampleMetaData — carried
+    # from RecordReader iterators into Evaluation's Prediction records)
+    example_metadata: Optional[List] = None
 
     def num_examples(self) -> int:
         return int(self.features.shape[0])
@@ -43,6 +46,7 @@ class DataSet:
                 self.labels[sl],
                 None if self.features_mask is None else self.features_mask[sl],
                 None if self.labels_mask is None else self.labels_mask[sl],
+                None if self.example_metadata is None else self.example_metadata[sl],
             )
 
         return take(slice(None, n_train)), take(slice(n_train, None))
@@ -55,6 +59,8 @@ class DataSet:
             self.labels[idx],
             None if self.features_mask is None else self.features_mask[idx],
             None if self.labels_mask is None else self.labels_mask[idx],
+            None if self.example_metadata is None
+            else [self.example_metadata[i] for i in idx],
         )
 
 
@@ -199,13 +205,16 @@ class IteratorDataSetIterator(DataSetIterator):
         return self.batch
 
     def __iter__(self):
-        feats, labs = [], []
+        feats, labs, metas = [], [], []
         for ex in self.examples:
             feats.append(ex.features)
             labs.append(ex.labels)
+            if ex.example_metadata:
+                metas.extend(ex.example_metadata)
             if len(feats) == self.batch:
-                yield DataSet(np.stack(feats), np.stack(labs))
-                feats, labs = [], []
+                yield DataSet(np.stack(feats), np.stack(labs),
+                              example_metadata=metas if len(metas) == len(feats) else None)
+                feats, labs, metas = [], [], []
 
 
 _SENTINEL = object()

@@ -88,13 +88,13 @@ class NormalizerStandardize(DataNormalization):
         x = ds.features.reshape(shape[0], -1)
         x = (x - self.mean) / self.std
         return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
-                       ds.features_mask, ds.labels_mask)
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
 
     def revert(self, ds: DataSet) -> DataSet:
         shape = ds.features.shape
         x = ds.features.reshape(shape[0], -1) * self.std + self.mean
         return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
-                       ds.features_mask, ds.labels_mask)
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
 
 
 class NormalizerMinMaxScaler(DataNormalization):
@@ -124,7 +124,7 @@ class NormalizerMinMaxScaler(DataNormalization):
         rng = np.maximum(self.max - self.min, 1e-12)
         x = (x - self.min) / rng * (self.hi - self.lo) + self.lo
         return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
-                       ds.features_mask, ds.labels_mask)
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
 
 
 class ImagePreProcessingScaler(DataNormalization):
@@ -142,7 +142,7 @@ class ImagePreProcessingScaler(DataNormalization):
     def transform(self, ds: DataSet) -> DataSet:
         x = ds.features / self.max_pixel * (self.hi - self.lo) + self.lo
         return DataSet(x.astype(np.float32), ds.labels,
-                       ds.features_mask, ds.labels_mask)
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
 
 
 class NormalizingIterator(DataSetIterator):

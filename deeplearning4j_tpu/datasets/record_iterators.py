@@ -45,13 +45,17 @@ class RecordReaderDataSetIterator(DataSetIterator):
                  label_index: Optional[int] = None,
                  num_classes: Optional[int] = None,
                  label_index_to: Optional[int] = None,
-                 regression: bool = False):
+                 regression: bool = False,
+                 collect_metadata: bool = False):
         self.reader = reader
         self.batch = int(batch)
         self.label_index = label_index
         self.num_classes = num_classes
         self.label_index_to = label_index_to
         self.regression = regression or label_index_to is not None
+        # reference: RecordReaderDataSetIterator.setCollectMetaData — batches
+        # carry per-example RecordMetaData for Evaluation attribution
+        self.collect_metadata = collect_metadata
 
     def batch_size(self):
         return self.batch
@@ -79,15 +83,21 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __iter__(self):
         feats: List[np.ndarray] = []
         labels: List[np.ndarray] = []
-        for rec in self.reader:
+        metas: List = []
+        source = (self.reader.iter_with_metadata() if self.collect_metadata
+                  else ((rec, None) for rec in self.reader))
+        for rec, meta in source:
             f, l = self._split(rec)
             feats.append(f)
             labels.append(l)
+            metas.append(meta)
             if len(feats) == self.batch:
-                yield DataSet(np.stack(feats), np.stack(labels))
-                feats, labels = [], []
+                yield DataSet(np.stack(feats), np.stack(labels),
+                              example_metadata=metas if self.collect_metadata else None)
+                feats, labels, metas = [], [], []
         if feats:
-            yield DataSet(np.stack(feats), np.stack(labels))
+            yield DataSet(np.stack(feats), np.stack(labels),
+                          example_metadata=metas if self.collect_metadata else None)
 
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):

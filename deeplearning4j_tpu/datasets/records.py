@@ -21,6 +21,41 @@ import numpy as np
 Record = List[object]
 
 
+class RecordMetaData:
+    """Where a record came from (reference: DataVec RecordMetaData — the
+    source URI + location the eval/meta/Prediction.java chain carries so
+    misclassified examples can be traced back and reloaded).
+
+    ``index`` is the record's ordinal within its reader; ``source`` a human
+    description (file path, "collection", ...); ``reader`` the originating
+    reader, kept so :meth:`load` can replay it (all readers are restartable).
+    """
+
+    __slots__ = ("index", "source", "reader")
+
+    def __init__(self, index: int, source: str, reader: "RecordReader" = None):
+        self.index = index
+        self.source = source
+        self.reader = reader
+
+    def load(self) -> Record:
+        """Reload the referenced record (reference:
+        RecordReaderDataSetIterator.loadFromMetaData)."""
+        if self.reader is None:
+            raise ValueError("metadata carries no reader to reload from")
+        return self.reader.load_from_metadata([self])[0]
+
+    def __repr__(self):
+        return f"RecordMetaData(index={self.index}, source={self.source!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, RecordMetaData)
+                and self.index == other.index and self.source == other.source)
+
+    def __hash__(self):
+        return hash((self.index, self.source))
+
+
 class RecordReader:
     """Restartable stream of records (reference SPI: DataVec RecordReader)."""
 
@@ -34,6 +69,33 @@ class RecordReader:
     def labels(self) -> Optional[List[str]]:
         """Class-label vocabulary, when the reader defines one (images)."""
         return None
+
+    # -- record metadata (reference: DataVec Record.getMetaData) --
+    def source_description(self) -> str:
+        return getattr(self, "path", None) or type(self).__name__
+
+    def iter_with_metadata(self) -> Iterator[tuple]:
+        """Yield (record, RecordMetaData) pairs; default counts ordinals."""
+        src = self.source_description()
+        for i, rec in enumerate(self):
+            yield rec, RecordMetaData(i, src, self)
+
+    def load_from_metadata(self, metas: Sequence[RecordMetaData]) -> List[Record]:
+        """Reload specific records by replaying the stream (reference:
+        RecordReader.loadFromMetaData). Restores the reader's position."""
+        wanted = {m.index for m in metas}
+        by_index = {}
+        self.reset()
+        for i, rec in enumerate(self):
+            if i in wanted:
+                by_index[i] = rec
+                if len(by_index) == len(wanted):
+                    break
+        self.reset()
+        missing = wanted - set(by_index)
+        if missing:
+            raise KeyError(f"records not found for indices {sorted(missing)}")
+        return [by_index[m.index] for m in metas]
 
 
 class CollectionRecordReader(RecordReader):

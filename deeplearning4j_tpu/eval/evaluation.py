@@ -40,17 +40,24 @@ class Evaluation:
         self.examples = 0
         self.top_n = max(1, top_n)
         self.top_n_correct = 0
+        # Prediction records, populated only when eval() receives metadata
+        # (reference: Evaluation.java metadata overloads + eval/meta/)
+        self.predictions: List = []
 
     def _ensure(self, n: int):
         if self.confusion is None:
             self.n_classes = self.n_classes or n
             self.confusion = ConfusionMatrix(self.n_classes)
 
-    def eval(self, labels, predictions) -> None:
+    def eval(self, labels, predictions, record_metadata=None) -> None:
         """labels: one-hot [B,C] (or int [B]); predictions: prob/score [B,C].
 
         Reference: Evaluation.eval:191 — row-argmax both sides into the
         confusion matrix. Time-series [B,T,C] inputs are flattened over time.
+        ``record_metadata`` (one entry per example, e.g. from a
+        RecordReaderDataSetIterator with ``collect_metadata=True``) additionally
+        records per-example :class:`~deeplearning4j_tpu.eval.meta.Prediction`s
+        so misclassifications are traceable (reference metadata overload).
         """
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
@@ -60,12 +67,38 @@ class Evaluation:
         self._ensure(predictions.shape[-1])
         pred_idx = predictions.argmax(-1)
         act_idx = labels.argmax(-1) if labels.ndim == 2 else labels.astype(np.int64)
+        if record_metadata is not None and len(record_metadata) != len(pred_idx):
+            # validate BEFORE mutating: a caller catching this must not be
+            # left with the batch half-counted
+            raise ValueError(
+                f"record_metadata has {len(record_metadata)} entries for "
+                f"{len(pred_idx)} examples"
+            )
         self.confusion.add(act_idx, pred_idx)
         self.examples += len(pred_idx)
+        if record_metadata is not None:
+            from .meta import Prediction
+
+            self.predictions.extend(
+                Prediction(a, p, m)
+                for a, p, m in zip(act_idx, pred_idx, record_metadata)
+            )
         if self.top_n > 1:
             k = min(self.top_n, predictions.shape[-1])
             topk = np.argpartition(predictions, -k, axis=-1)[:, -k:]
             self.top_n_correct += int((topk == act_idx[:, None]).any(-1).sum())
+
+    # ---- record-metadata attribution (reference: Evaluation.java meta API) ----
+    def prediction_errors(self) -> List:
+        """Misclassified examples with provenance (reference:
+        Evaluation.getPredictionErrors)."""
+        return [p for p in self.predictions if not p.is_correct()]
+
+    def predictions_by_actual_class(self, cls: int) -> List:
+        return [p for p in self.predictions if p.actual_class == cls]
+
+    def predictions_by_predicted_class(self, cls: int) -> List:
+        return [p for p in self.predictions if p.predicted_class == cls]
 
     # ---- metrics (reference: Evaluation accuracy()/precision()/recall()/f1()) ----
     def _tp(self) -> np.ndarray:

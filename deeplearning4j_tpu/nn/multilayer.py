@@ -534,7 +534,10 @@ class MultiLayerNetwork:
         ev = Evaluation(top_n=top_n)
         for ds in as_iterator(data):
             out = self.output(ds.features, features_mask=getattr(ds, "features_mask", None))
-            ev.eval(ds.labels, out)
+            # metadata (when the iterator collects it) flows into Prediction
+            # records (reference: evaluate -> Evaluation metadata overload)
+            ev.eval(ds.labels, out,
+                    record_metadata=getattr(ds, "example_metadata", None))
         return ev
 
     # ------------------------------------------------------------------ misc

@@ -59,6 +59,34 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(**kwargs)
 
 
+def global_put(arr, sharding):
+    """Place host data on a (possibly multi-process) sharding.
+
+    Single-process: plain ``device_put``. Multi-process: every process holds
+    the same host array and contributes its addressable shards via
+    ``make_array_from_callback`` — the multi-controller analog of the Spark
+    driver's broadcast (SURVEY.md §3.5): identical host-side data, one global
+    device array spanning all hosts.
+    """
+    import jax
+
+    if arr is None:
+        return None
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    import numpy as _np
+
+    a = _np.asarray(arr)
+    return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
+
+
+def global_put_tree(tree, sharding):
+    """``global_put`` over a pytree (one sharding for every leaf)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: global_put(a, sharding), tree)
+
+
 def replicated_sharding(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
 

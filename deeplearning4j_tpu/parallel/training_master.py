@@ -30,6 +30,19 @@ class TrainingStats:
             {"phase": phase, "start": start, "duration_ms": (end - start) * 1e3, **meta}
         )
 
+    def record_total(self, phase: str, duration_ms: float, **meta) -> None:
+        """Record an aggregate phase total (e.g. from a profiler.StepTimer)."""
+        self.events.append({"phase": phase, "start": None,
+                            "duration_ms": duration_ms, **meta})
+
+    def merge_timer(self, timer, prefix: str = "") -> None:
+        """Fold a profiler.StepTimer breakdown into the phase events — the
+        single instrumentation path shared with bench.py and the UI system
+        page (reference: worker-phase stats folded into SparkTrainingStats)."""
+        for phase, info in timer.breakdown().items():
+            self.record_total(prefix + phase, info["total_s"] * 1e3,
+                              count=info["count"], mean_ms=info["mean_ms"])
+
     def total_ms(self, phase: str) -> float:
         return sum(e["duration_ms"] for e in self.events if e["phase"] == phase)
 
@@ -91,6 +104,7 @@ class SyncAllReduceTrainingMaster(TrainingMaster):
         t1 = time.perf_counter()
         wrapper.fit(data, epochs=epochs)
         self.stats.record("fit", t1, time.perf_counter(), iterations=wrapper.iteration)
+        self.stats.merge_timer(wrapper.timer)
         return net
 
     def get_stats(self) -> TrainingStats:
@@ -144,8 +158,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         wrapper.fit(data, epochs=epochs)
         if self.collect_training_stats:
             self.stats.record("fit", t1, time.perf_counter(), iterations=wrapper.iteration)
-            t2 = time.perf_counter()
-            self.stats.record("aggregate", t2, time.perf_counter())
+            self.stats.merge_timer(wrapper.timer)
         return net
 
     def get_stats(self) -> TrainingStats:

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .mesh import make_mesh, replicated_sharding, data_sharding
+from .mesh import make_mesh, replicated_sharding, data_sharding, global_put, global_put_tree
 
 
 def _stack_tree(tree, n: int):
@@ -94,6 +94,14 @@ class ParallelWrapper:
         self._vstep = None
         self._avg_fn = None
         self._sync_ready = False
+        # Shared instrumentation path (profiler.StepTimer): the same
+        # data/step/average phases feed the TrainingMaster's phase stats, the
+        # StatsListener records (UI system page), and the bench breakdown —
+        # reference: ParameterAveragingTrainingWorkerStats per-phase events.
+        from ..profiler import StepTimer  # noqa: PLC0415
+
+        self.timer = StepTimer()
+        net._phase_timer = self.timer
 
     # ------------------------------------------------------------- sync mode
     def _setup_sync(self):
@@ -109,10 +117,10 @@ class ParallelWrapper:
             # param's sharding; training state is preserved, not reset)
             shard_params(net, self.mesh, self.model_axis)
         else:
-            net.params = jax.device_put(net.params, rep)
-            net.opt_state = jax.device_put(net.opt_state, rep)
+            net.params = global_put_tree(net.params, rep)
+            net.opt_state = global_put_tree(net.opt_state, rep)
         if jax.tree_util.tree_leaves(net.state):
-            net.state = jax.device_put(net.state, rep)
+            net.state = global_put_tree(net.state, rep)
         self._sync_ready = True
 
     def _batch_sharding(self):
@@ -125,16 +133,16 @@ class ParallelWrapper:
         """One SPMD step on a globally-sharded batch; grads psum over ICI."""
         net = self.net
         shard = self._batch_sharding()
-        x = jax.device_put(jnp.asarray(global_ds.features), shard)
-        y = jax.device_put(jnp.asarray(global_ds.labels), shard)
-        net._rng, step_key = jax.random.split(net._rng)
-        lm = getattr(global_ds, "labels_mask", None)
-        fm = getattr(global_ds, "features_mask", None)
-        lm = None if lm is None else jax.device_put(jnp.asarray(lm), shard)
-        fm = None if fm is None else jax.device_put(jnp.asarray(fm), shard)
-        net.params, net.opt_state, net.state, loss = net._train_step(
-            net.params, net.opt_state, net.state, x, y, step_key, lm, fm
-        )
+        with self.timer.phase("data"):
+            x = global_put(np.asarray(global_ds.features), shard)
+            y = global_put(np.asarray(global_ds.labels), shard)
+            net._rng, step_key = jax.random.split(net._rng)
+            lm = global_put(getattr(global_ds, "labels_mask", None), shard)
+            fm = global_put(getattr(global_ds, "features_mask", None), shard)
+        with self.timer.phase("step"):
+            net.params, net.opt_state, net.state, loss = net._train_step(
+                net.params, net.opt_state, net.state, x, y, step_key, lm, fm
+            )
         net._last_loss = loss
         net.iteration += 1
         self.iteration += 1
@@ -152,7 +160,7 @@ class ParallelWrapper:
             _stack_tree(net.state, n),
         )
         shard0 = data_sharding(self.mesh)  # leading replica axis over devices
-        self._replica = jax.device_put(self._replica, shard0)
+        self._replica = global_put_tree(self._replica, shard0)
 
         tx = net._tx
 
@@ -192,23 +200,24 @@ class ParallelWrapper:
         net._rng, k = jax.random.split(net._rng)
         keys = jax.random.split(k, self.workers)
         shard0 = data_sharding(self.mesh)
-        x = jax.device_put(jnp.asarray(stacked_ds.features), shard0)
-        y = jax.device_put(jnp.asarray(stacked_ds.labels), shard0)
-        # Masks ride the replica axis too — each replica's loss must see its
-        # own masks exactly as its net.fit would (round-1 weak #4: periodic
-        # mode silently computed unmasked loss). None passes through vmap as
-        # an empty pytree.
-        lm = getattr(stacked_ds, "labels_mask", None)
-        fm = getattr(stacked_ds, "features_mask", None)
-        lm = None if lm is None else jax.device_put(jnp.asarray(lm), shard0)
-        fm = None if fm is None else jax.device_put(jnp.asarray(fm), shard0)
-        params, opt_state, state, losses = self._vstep(
-            params, opt_state, state, x, y, keys, lm, fm
-        )
+        with self.timer.phase("data"):
+            x = global_put(np.asarray(stacked_ds.features), shard0)
+            y = global_put(np.asarray(stacked_ds.labels), shard0)
+            # Masks ride the replica axis too — each replica's loss must see
+            # its own masks exactly as its net.fit would (round-1 weak #4:
+            # periodic mode silently computed unmasked loss). None passes
+            # through vmap as an empty pytree.
+            lm = global_put(getattr(stacked_ds, "labels_mask", None), shard0)
+            fm = global_put(getattr(stacked_ds, "features_mask", None), shard0)
+        with self.timer.phase("step"):
+            params, opt_state, state, losses = self._vstep(
+                params, opt_state, state, x, y, keys, lm, fm
+            )
         self.iteration += 1
         net.iteration += 1
         if self.iteration % self.averaging_frequency == 0:
-            params, opt_state, state = self._avg_fn(params, opt_state, state)
+            with self.timer.phase("average"):
+                params, opt_state, state = self._avg_fn(params, opt_state, state)
             if self.report_score_after_averaging:
                 net._last_loss = jnp.mean(losses)
         if not self.report_score_after_averaging:
@@ -293,6 +302,10 @@ class ParallelWrapper:
                     )
         if not sync:
             self._finalize_periodic()
+        # Detach the phase timer: a later plain net.fit must not report this
+        # wrapper's frozen breakdown as if it described the new run.
+        if getattr(self.net, "_phase_timer", None) is self.timer:
+            self.net._phase_timer = None
         return self
 
     def average_model(self):

@@ -168,6 +168,7 @@ _SYSTEM = _page("System", """
 <div class="card"><h3>Host memory (RSS, MB)</h3><svg id="mem" width="800" height="180"></svg></div>
 <div class="card"><h3>Device memory in use (MB)</h3><svg id="devmem" width="800" height="180"></svg></div>
 <div class="card"><h3>Iteration time (ms)</h3><svg id="itertime" width="800" height="180"></svg></div>
+<div class="card"><h3>Phase timings</h3><table id="phases"><tr><td>no phase data (attach a ParallelWrapper / bench StepTimer)</td></tr></table></div>
 <div class="card"><h3>Environment</h3><table id="env"></table></div>
 <script>
 async function refresh(){
@@ -180,14 +181,22 @@ async function refresh(){
     dev.map(u=>u.device_memory.reduce((a,d)=>a+(d.bytes_in_use||0),0)/1048576), '#936');
   const ts = sys.filter(u=>u.iteration_time_ms!=null);
   lineChart(document.getElementById('itertime'), ts.map(u=>u.iteration), ts.map(u=>u.iteration_time_ms), '#c63');
+  const ph = sys.filter(u=>u.phase_timings);
+  if (ph.length){
+    const pt = ph[ph.length-1].phase_timings;
+    let rows = '<tr><th>phase</th><th>total s</th><th>count</th><th>mean ms</th></tr>';
+    for (const k of Object.keys(pt))
+      rows += `<tr><td>${esc(k)}</td><td>${esc(pt[k].total_s)}</td><td>${esc(pt[k].count)}</td><td>${esc(pt[k].mean_ms)}</td></tr>`;
+    document.getElementById('phases').innerHTML = rows;
+  }
   const st = await getJSON('/api/static?session='+encodeURIComponent(session));
   if (st.length){
     const s = st[0];
     document.getElementById('env').innerHTML =
       `<tr><th>model</th><td>${esc(s.model_class)}</td></tr>`+
       `<tr><th>backend</th><td>${esc(s.backend||'-')}</td></tr>`+
-      `<tr><th>params</th><td>${s.num_params}</td></tr>`+
-      `<tr><th>pid</th><td>${s.pid}</td></tr>`;
+      `<tr><th>params</th><td>${esc(s.num_params)}</td></tr>`+
+      `<tr><th>pid</th><td>${esc(s.pid)}</td></tr>`;
   }
 }
 refresh(); setInterval(refresh, 3000);
@@ -255,7 +264,7 @@ _MM_KEYS = {"param": "param_mean_magnitudes",
             "gradient": "gradient_mean_magnitudes",
             "update": "update_mean_magnitudes"}
 _SYSTEM_KEYS = ("iteration", "timestamp", "worker_id", "memory_rss_bytes",
-                "iteration_time_ms", "device_memory")
+                "iteration_time_ms", "device_memory", "phase_timings")
 
 
 class _Handler(BaseHTTPRequestHandler):

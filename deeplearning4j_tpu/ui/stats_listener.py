@@ -185,6 +185,11 @@ class StatsListener(TrainingListener):
         dev = _device_memory_stats()
         if dev:
             record["device_memory"] = dev
+        # phase breakdown when a ParallelWrapper (or bench) attached its
+        # StepTimer to the model — surfaces on the UI system page
+        timer = getattr(model, "_phase_timer", None)
+        if timer is not None and timer.totals:
+            record["phase_timings"] = timer.breakdown()
 
         self._collect_tree(record, "param", getattr(model, "params", None))
         if self.collect_gradients:

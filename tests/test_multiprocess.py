@@ -1,0 +1,104 @@
+"""Multi-PROCESS distributed training tests (VERDICT round-2 task 4).
+
+The reference proves its cluster tier with `local[n]` SparkContext tests
+(dl4j-spark/src/test/.../BaseSparkTest.java:90): multi-worker semantics in one
+JVM. SURVEY.md §4.3 prescribes the jax.distributed analog — and goes further:
+these tests spawn REAL OS processes that ``jax.distributed.initialize`` into
+one CPU-backend cluster (2 processes x 2 virtual devices = one 4-device global
+mesh, collectives over Gloo), run the parameter-averaging TrainingMaster
+across the process boundary, and assert the result matches a single-process
+run of the identical configuration bit-for-bit (same data order, same seeds;
+only the all-reduce reduction order may differ -> tight allclose).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "helpers", "multiproc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(local_devices: int) -> dict:
+    env = dict(os.environ)
+    # Same recipe as conftest's _force_cpu_mesh, but via env because each
+    # worker is a fresh interpreter: never let the axon TPU plugin register.
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
+    env.pop("JAX_NUM_PROCESSES", None)
+    return env
+
+
+def _run_cluster(mode: str, num_processes: int, out_dir: str,
+                 local_devices: int = 2, timeout: float = 300.0):
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER,
+             "--process-id", str(i), "--num-processes", str(num_processes),
+             "--port", str(port), "--out", out_dir, "--mode", mode,
+             "--local-devices", str(local_devices)],
+            env=_worker_env(local_devices),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO,
+        )
+        for i in range(num_processes)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+        assert "WORKER_OK" in out
+    return outs
+
+
+def _load(out_dir: str, mode: str, n: int):
+    params = dict(np.load(os.path.join(out_dir, f"params_{mode}_{n}p.npz")))
+    with open(os.path.join(out_dir, f"meta_{mode}_{n}p.json")) as f:
+        meta = json.load(f)
+    return params, meta
+
+
+@pytest.mark.parametrize("mode", ["periodic", "sync"])
+def test_two_processes_match_single_process(mode, tmp_path):
+    """2 OS processes forming one 4-device mesh == 1 process with 4 devices."""
+    out = str(tmp_path)
+    _run_cluster(mode, num_processes=2, out_dir=out, local_devices=2)
+    _run_cluster(mode, num_processes=1, out_dir=out, local_devices=4)
+
+    mp_params, mp_meta = _load(out, mode, 2)
+    sp_params, sp_meta = _load(out, mode, 1)
+
+    assert mp_meta["process_count"] == 2
+    assert sp_meta["process_count"] == 1
+    assert mp_meta["devices"] == sp_meta["devices"] == 4
+
+    assert set(mp_params) == set(sp_params)
+    for k in sp_params:
+        np.testing.assert_allclose(
+            mp_params[k], sp_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"param {k} diverged between 2-process and 1-process runs",
+        )
+    assert mp_meta["loss"] == pytest.approx(sp_meta["loss"], rel=1e-4)
+    # training actually moved: params differ from a fresh init
+    assert any(np.abs(v).sum() > 0 for v in mp_params.values())

@@ -144,13 +144,18 @@ class TestTrainingMasters:
         stats = master.get_stats()
         assert "fit" in stats.phases()
         assert stats.total_ms("fit") > 0
+        # per-step phases folded in from the wrapper's StepTimer (shared
+        # instrumentation path with bench.py and the UI system page)
+        assert {"data", "step"} <= set(stats.phases())
+        assert stats.total_ms("step") > 0
 
     def test_param_avg_master_stats_and_html(self, tmp_path):
         net = _net(lr=0.2)
         master = ParameterAveragingTrainingMaster(workers=4, averaging_frequency=2)
         master.execute_training(net, ListDataSetIterator(_batches(32)), epochs=3)
         stats = master.get_stats()
-        assert {"broadcast", "fit", "aggregate"} <= set(stats.phases())
+        assert {"broadcast", "fit", "data", "step", "average"} <= set(stats.phases())
+        assert stats.total_ms("average") > 0  # averaging rounds actually ran
         out = tmp_path / "stats.html"
         stats.export_html(str(out))
         assert "Training phase timings" in out.read_text()

@@ -184,3 +184,129 @@ def test_minmax_and_normalizing_iterator():
     ds = next(iter(wrapped))
     np.testing.assert_allclose(ds.features.min(0), [0, 0])
     np.testing.assert_allclose(ds.features.max(0), [1, 1])
+
+
+# ---- record-metadata attribution (reference: eval/meta/Prediction.java +
+# Evaluation.java metadata overloads; VERDICT round-2 task 6) ----
+
+
+def test_record_metadata_roundtrip(tmp_path):
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader, RecordMetaData
+
+    p = tmp_path / "data.csv"
+    p.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n")
+    reader = CSVRecordReader(str(p))
+    pairs = list(reader.iter_with_metadata())
+    assert [m.index for _, m in pairs] == [0, 1, 2]
+    assert all(m.source == str(p) for _, m in pairs)
+    # load() replays the reader and returns the exact record
+    rec = pairs[2][1].load()
+    assert rec == [5.0, 6.0, 2.0]
+    # load_from_metadata preserves request order and restores position
+    recs = reader.load_from_metadata([pairs[1][1], pairs[0][1]])
+    assert recs == [[3.0, 4.0, 1.0], [1.0, 2.0, 0.0]]
+    assert len(list(reader)) == 3  # reader usable afterwards
+
+
+def test_record_iterator_collects_metadata(tmp_path):
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    from deeplearning4j_tpu.datasets.record_iterators import RecordReaderDataSetIterator
+
+    p = tmp_path / "data.csv"
+    p.write_text("".join(f"{i}.0,{i}.5,{i % 3}\n" for i in range(5)))
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=2, label_index=2, num_classes=3,
+        collect_metadata=True,
+    )
+    batches = list(it)
+    assert [len(b.example_metadata) for b in batches] == [2, 2, 1]
+    assert batches[1].example_metadata[0].index == 2
+    # off by default
+    it2 = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=2, label_index=2, num_classes=3)
+    assert next(iter(it2)).example_metadata is None
+
+
+def test_evaluation_prediction_attribution(tmp_path):
+    """Misclassified examples are traceable back to their source records."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    from deeplearning4j_tpu.datasets.record_iterators import RecordReaderDataSetIterator
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    # class == first feature (0/1); model 'predicts' class 0 always
+    p = tmp_path / "data.csv"
+    p.write_text("0.0,10.0,0\n1.0,11.0,1\n0.0,12.0,0\n1.0,13.0,1\n")
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=4, label_index=2, num_classes=2,
+        collect_metadata=True,
+    )
+    ds = next(iter(it))
+    ev = Evaluation()
+    preds = np.tile(np.array([[0.9, 0.1]], dtype=np.float32), (4, 1))
+    ev.eval(ds.labels, preds, record_metadata=ds.example_metadata)
+
+    errors = ev.prediction_errors()
+    assert [e.record_metadata.index for e in errors] == [1, 3]
+    assert all(e.predicted_class == 0 and e.actual_class == 1 for e in errors)
+    # reload the originating records of the misclassified examples
+    recs = [e.get_record() for e in errors]
+    assert recs == [[1.0, 11.0, 1.0], [1.0, 13.0, 1.0]]
+    assert len(ev.predictions_by_actual_class(0)) == 2
+    assert len(ev.predictions_by_predicted_class(0)) == 4
+    # count mismatch is an error, not silent misalignment
+    import pytest
+
+    with pytest.raises(ValueError):
+        ev.eval(ds.labels, preds, record_metadata=ds.example_metadata[:2])
+
+
+def test_network_evaluate_threads_metadata(tmp_path):
+    """MultiLayerNetwork.evaluate picks up iterator metadata end-to-end."""
+    from deeplearning4j_tpu import (
+        DenseLayer, InputType, MultiLayerConfiguration, MultiLayerNetwork,
+        OutputLayer, UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    from deeplearning4j_tpu.datasets.record_iterators import RecordReaderDataSetIterator
+
+    p = tmp_path / "data.csv"
+    p.write_text("".join(f"{i/10:.1f},{(9-i)/10:.1f},{i % 2}\n" for i in range(10)))
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=5, label_index=2, num_classes=2,
+        collect_metadata=True,
+    )
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=8, activation="tanh"),
+                OutputLayer(n_out=2, activation="softmax")],
+        input_type=InputType.feed_forward(2),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.05),
+    )
+    net = MultiLayerNetwork(conf).init()
+    ev = net.evaluate(it)
+    assert len(ev.predictions) == 10
+    assert {pr.record_metadata.index for pr in ev.predictions} == set(range(10))
+    for pr in ev.prediction_errors():
+        rec = pr.get_record()
+        assert int(rec[2]) == pr.actual_class  # provenance is the real record
+
+
+def test_metadata_survives_normalizer(tmp_path):
+    """Attribution must survive the standard pipeline: reader -> iterator ->
+    normalizer (metadata previously dropped at DataSet reconstruction)."""
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    from deeplearning4j_tpu.datasets.record_iterators import RecordReaderDataSetIterator
+    from deeplearning4j_tpu.datasets.normalizers import (
+        NormalizerStandardize, NormalizingIterator,
+    )
+
+    p = tmp_path / "data.csv"
+    p.write_text("".join(f"{i}.0,{i}.5,{i % 3}\n" for i in range(6)))
+    base = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=3, label_index=2, num_classes=3,
+        collect_metadata=True)
+    norm = NormalizerStandardize().fit(base)
+    batches = list(NormalizingIterator(base, norm))
+    assert all(b.example_metadata is not None for b in batches)
+    assert [m.index for b in batches for m in b.example_metadata] == list(range(6))
