@@ -52,16 +52,26 @@ def _script_runs(text: str) -> List[str]:
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Script-run segmentation for Japanese (reference plugin:
-    JapaneseTokenizerFactory over Kuromoji). Hiragana/katakana/kanji/latin
-    runs become tokens — the useful granularity for embedding models without
-    a morphological dictionary."""
+    """Morphological segmentation for Japanese (reference plugin:
+    JapaneseTokenizerFactory over Kuromoji). Backed by
+    :mod:`deeplearning4j_tpu.nlp.japanese` — a dictionary + Viterbi-lattice
+    segmenter (kuromoji's architecture with an embedded lexicon), NOT a
+    gated import. ``extra_entries`` extends the lexicon; pass
+    ``script_runs_only=True`` for the older coarse behavior."""
 
-    def __init__(self, pre_processor: Optional[TokenPreProcess] = None):
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
+                 extra_entries=None, script_runs_only: bool = False):
         self.pre_processor = pre_processor
+        self.script_runs_only = script_runs_only
+        if not script_runs_only:
+            from .japanese import JapaneseSegmenter  # noqa: PLC0415
+
+            self._segmenter = JapaneseSegmenter(extra_entries)
 
     def create(self, text: str) -> Tokenizer:
-        return Tokenizer(_script_runs(text), self.pre_processor)
+        if self.script_runs_only:
+            return Tokenizer(_script_runs(text), self.pre_processor)
+        return Tokenizer(self._segmenter.tokenize(text), self.pre_processor)
 
 
 class KoreanTokenizerFactory(TokenizerFactory):

@@ -412,6 +412,10 @@ class ComputationGraph:
         Each input: [batch, features] (one step) or [batch, time, features].
         Recurrent vertices' h/c persist across calls until
         :meth:`rnn_clear_previous_state`.
+
+        XLA shape note: single-step 2-D inputs normalize to [B, 1, F] and
+        reuse one traced program; multi-step calls compile once per distinct
+        (batch, T) — bucket T for variable-length streaming.
         """
         self.init()
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):

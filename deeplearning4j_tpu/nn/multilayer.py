@@ -259,6 +259,10 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])
+        # host-side reference only (no copy): listeners that render activations
+        # (ConvolutionalIterationListener) re-run the forward on this batch —
+        # reference keeps the same via Model.setInput/input()
+        self._last_input = ds.features
         if (
             self.conf.backprop_type == "tbptt"
             and np.ndim(ds.features) == 3
@@ -391,6 +395,11 @@ class MultiLayerNetwork:
 
         ``x``: [batch, features] (one step) or [batch, time, features]. LSTM
         h/c persist across calls until :meth:`rnn_clear_previous_state`.
+
+        XLA shape note: single-step 2-D input is normalized to [B, 1, F] so
+        streaming always reuses ONE traced program; multi-step calls compile
+        once per distinct (batch, T). For variable-length streaming, bucket T
+        (pad to a few fixed lengths) to bound recompiles.
         """
         self.init()
         x = jnp.asarray(x)
@@ -535,9 +544,13 @@ class MultiLayerNetwork:
         for ds in as_iterator(data):
             out = self.output(ds.features, features_mask=getattr(ds, "features_mask", None))
             # metadata (when the iterator collects it) flows into Prediction
-            # records (reference: evaluate -> Evaluation metadata overload)
-            ev.eval(ds.labels, out,
-                    record_metadata=getattr(ds, "example_metadata", None))
+            # records (reference: evaluate -> Evaluation metadata overload).
+            # Time-series outputs flatten to B*T rows — per-example metadata
+            # no longer aligns, so attribution is skipped for 3-D outputs.
+            meta = getattr(ds, "example_metadata", None)
+            if np.ndim(out) == 3:
+                meta = None
+            ev.eval(ds.labels, out, record_metadata=meta)
         return ev
 
     # ------------------------------------------------------------------ misc

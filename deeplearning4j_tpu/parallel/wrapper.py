@@ -240,13 +240,22 @@ class ParallelWrapper:
     def fit(self, data, epochs: int = 1) -> "ParallelWrapper":
         """Reference: ParallelWrapper.fit(DataSetIterator):317. Minibatches are
         pulled through async prefetch and grouped ``workers`` at a time."""
-        from ..datasets.iterators import as_iterator, AsyncDataSetIterator, DataSet
-
         sync = self.averaging_frequency <= 1
         if sync and not self._sync_ready:
             self._setup_sync()
         if not sync and self._replica is None:
             self._setup_periodic()
+        try:
+            self._fit_epochs(data, epochs, sync)
+        finally:
+            # Detach even on mid-fit failure: a later plain net.fit must not
+            # report this wrapper's frozen breakdown as the new run's timings.
+            if getattr(self.net, "_phase_timer", None) is self.timer:
+                self.net._phase_timer = None
+        return self
+
+    def _fit_epochs(self, data, epochs: int, sync: bool) -> None:
+        from ..datasets.iterators import as_iterator, AsyncDataSetIterator
 
         for _ in range(epochs):
             it = as_iterator(data)
@@ -302,11 +311,6 @@ class ParallelWrapper:
                     )
         if not sync:
             self._finalize_periodic()
-        # Detach the phase timer: a later plain net.fit must not report this
-        # wrapper's frozen breakdown as if it described the new run.
-        if getattr(self.net, "_phase_timer", None) is self.timer:
-            self.net._phase_timer = None
-        return self
 
     def average_model(self):
         """Current averaged model params (periodic mode) or the net's params."""

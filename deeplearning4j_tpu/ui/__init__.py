@@ -10,9 +10,12 @@ from .storage import (
     RemoteStatsStorageRouter,
 )
 from .stats_listener import StatsListener
+from .conv_listener import ConvolutionalIterationListener, post_tsne
 from .server import UIServer
 
 __all__ = [
+    "ConvolutionalIterationListener",
+    "post_tsne",
     "StatsStorage",
     "StatsStorageRouter",
     "InMemoryStatsStorage",

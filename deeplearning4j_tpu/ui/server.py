@@ -44,6 +44,8 @@ _NAV = """<nav>
 <a href="/train/model" id="nav-model">Model</a>
 <a href="/train/system" id="nav-system">System</a>
 <a href="/train/flow" id="nav-flow">Flow</a>
+<a href="/train/activations" id="nav-activations">Activations</a>
+<a href="/train/tsne" id="nav-tsne">t-SNE</a>
 </nav>
 <script>
 const here = location.pathname.split('/').pop();
@@ -250,6 +252,63 @@ async function refresh(){
 refresh(); setInterval(refresh, 5000);
 </script>""")
 
+_ACTIVATIONS = _page("Conv activations", """
+<div class="card"><h3>First conv layer — feature maps (one input example)</h3>
+<div id="meta" style="font-size:13px;color:#555"></div>
+<div class="hrow" id="grids"></div></div>
+<script>
+async function refresh(){
+  const session = await firstSession(); if (!session) return;
+  const a = await getJSON('/api/activations?session='+encodeURIComponent(session));
+  if (!a || !a.conv_activations) return;
+  const ca = a.conv_activations;
+  document.getElementById('meta').textContent =
+    `layer ${ca.layer} · iteration ${a.iteration} · ${ca.maps.length} maps`;
+  const grids = document.getElementById('grids'); grids.innerHTML='';
+  ca.maps.forEach((m, idx) => {
+    const h = m.length, w = m[0].length, px = 6;
+    let s = '';
+    for (let r = 0; r < h; r++)
+      for (let c = 0; c < w; c++){
+        const v = Math.round(255 * (1 - m[r][c]));
+        s += `<rect x="${c*px}" y="${r*px}" width="${px}" height="${px}" fill="rgb(${v},${v},${v})"/>`;
+      }
+    grids.innerHTML += `<div class="hcell"><h4>map ${idx}</h4><svg width="${w*px}" height="${h*px}">${s}</svg></div>`;
+  });
+}
+refresh(); setInterval(refresh, 4000);
+</script>""")
+
+_TSNE = _page("t-SNE", """
+<div class="card"><h3>t-SNE embedding</h3><svg id="scatter" width="820" height="620"></svg></div>
+<script>
+const COLORS = ['#36c','#c63','#693','#936','#369','#c36','#663','#339','#933','#396'];
+async function refresh(){
+  const session = await firstSession(); if (!session) return;
+  const t = await getJSON('/api/tsne?session='+encodeURIComponent(session));
+  if (!t || !t.coords || !t.coords.length) return;
+  const xs = t.coords.map(c=>c[0]), ys = t.coords.map(c=>c[1]);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs), ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const W=800, H=600, pad=20;
+  const px=x=>pad+(W-2*pad)*(x-xmin)/Math.max(xmax-xmin,1e-9);
+  const py=y=>pad+(H-2*pad)*(y-ymin)/Math.max(ymax-ymin,1e-9);
+  const labels = t.labels || [];
+  const classes = [...new Set(labels)];
+  let s='';
+  t.coords.forEach((c,i)=>{
+    const color = labels.length ? COLORS[classes.indexOf(labels[i]) % COLORS.length] : '#36c';
+    s += `<circle cx="${px(c[0])}" cy="${py(c[1])}" r="3" fill="${color}" opacity="0.7">`+
+         `<title>${labels.length ? esc(labels[i]) : i}</title></circle>`;
+  });
+  classes.slice(0,10).forEach((cl,i)=>{
+    s += `<circle cx="${W-90}" cy="${20+i*16}" r="4" fill="${COLORS[i % COLORS.length]}"/>`+
+         `<text x="${W-80}" y="${24+i*16}" font-size="11">${esc(cl)}</text>`;
+  });
+  document.getElementById('scatter').innerHTML = s;
+}
+refresh(); setInterval(refresh, 5000);
+</script>""")
+
 _PAGES = {
     "/": _OVERVIEW,
     "/train": _OVERVIEW,
@@ -257,6 +316,8 @@ _PAGES = {
     "/train/model": _MODEL,
     "/train/system": _SYSTEM,
     "/train/flow": _FLOW,
+    "/train/activations": _ACTIVATIONS,
+    "/train/tsne": _TSNE,
 }
 
 _HIST_KEYS = ("param_histograms", "gradient_histograms", "update_histograms")
@@ -337,6 +398,19 @@ class _Handler(BaseHTTPRequestHandler):
             out = self._updates(sess, q.get("worker"))
             slim = [{k: r[k] for k in _SYSTEM_KEYS if k in r} for r in out]
             return self._send(200, json.dumps(slim).encode())
+        if path == "/api/activations":
+            # latest update carrying conv feature maps
+            out = self._updates(sess, q.get("worker"))
+            rec = next((r for r in reversed(out) if "conv_activations" in r), None)
+            return self._send(200, json.dumps(rec or {}).encode())
+        if path == "/api/tsne":
+            # latest posted t-SNE coordinate set (static records, see
+            # conv_listener.post_tsne)
+            stat = []
+            for st in storages:
+                stat.extend(st.get_static_info(sess))
+            rec = next((r.get("tsne") for r in reversed(stat) if "tsne" in r), None)
+            return self._send(200, json.dumps(rec or {}).encode())
         if path == "/api/static":
             out = []
             for st in storages:

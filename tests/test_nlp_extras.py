@@ -64,12 +64,48 @@ def test_moving_windows():
 
 
 def test_japanese_tokenizer_script_runs():
-    tf = JapaneseTokenizerFactory()
+    tf = JapaneseTokenizerFactory(script_runs_only=True)
     toks = tf.create("私はJAXが好きです。").get_tokens()
     # kanji/hiragana/latin runs split; punctuation dropped
     assert "JAX" in toks
     assert "私" in toks
     assert "。" not in "".join(toks)
+
+
+def test_japanese_tokenizer_morphological():
+    """Dictionary+Viterbi segmentation (kuromoji-architecture, VERDICT task 8):
+    the classic lattice test sentence plus everyday grammar."""
+    tf = JapaneseTokenizerFactory()
+    # すもももももももものうち — greedy matching cannot segment this; the
+    # min-cost lattice path can (kuromoji's own canonical demo sentence)
+    toks = tf.create("すもももももももものうち").get_tokens()
+    assert toks == ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+    toks = tf.create("私は学生です").get_tokens()
+    assert toks == ["私", "は", "学生", "です"]
+    toks = tf.create("昨日映画を見ました").get_tokens()
+    assert toks == ["昨日", "映画", "を", "見", "ました"]
+    # unknown katakana loanword stays one token; particles split off
+    toks = tf.create("コンピュータで日本語を学んでいます").get_tokens()
+    assert toks[0] == "コンピュータ"
+    assert "を" in toks and "で" in toks
+    # punctuation dropped, numbers kept
+    toks = tf.create("2024年に東京へ行きます。").get_tokens()
+    assert "2024" in toks and "年" in toks and "。" not in toks
+
+
+def test_japanese_segmenter_pos_and_extension():
+    from deeplearning4j_tpu.nlp.japanese import JapaneseSegmenter
+
+    seg = JapaneseSegmenter()
+    morphs = seg.segment("私は学生です")
+    assert [(m.surface, m.pos) for m in morphs] == [
+        ("私", "pronoun"), ("は", "particle"), ("学生", "noun"), ("です", "aux")]
+    assert [m.start for m in morphs] == [0, 1, 2, 4]
+    # lexicon extension seam (where a full IPADIC-scale dictionary drops in)
+    seg2 = JapaneseSegmenter(extra_entries=[("深層学習", "noun", 2)])
+    assert "深層学習" in [m.surface for m in seg2.segment("深層学習を学んでいます")]
+    # whitespace resets the lattice path
+    assert seg.tokenize("私は 学生です") == ["私", "は", "学生", "です"]
 
 
 def test_korean_tokenizer():

@@ -1,0 +1,100 @@
+"""Profiler tier tests (SURVEY.md §5.1 — the jax.profiler hook, step-time
+breakdown, and MFU math VERDICT rounds 1-2 demanded)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import profiler
+
+
+class TestStepTimer:
+    def test_phases_accumulate(self):
+        t = profiler.StepTimer()
+        with t.phase("data"):
+            pass
+        with t.phase("data"):
+            pass
+        with t.phase("step"):
+            pass
+        b = t.breakdown()
+        assert b["data"]["count"] == 2
+        assert b["step"]["count"] == 1
+        assert b["data"]["total_s"] >= 0
+        assert b["data"]["mean_ms"] >= 0  # values rounded for JSON payloads
+
+    def test_tick_tock(self):
+        t = profiler.StepTimer()
+        t.tick("a")
+        t.tick("b")  # implicitly tocks "a"
+        t.tock()
+        assert set(t.breakdown()) == {"a", "b"}
+        t.reset()
+        assert t.breakdown() == {}
+
+    def test_phase_records_on_exception(self):
+        t = profiler.StepTimer()
+        with pytest.raises(RuntimeError):
+            with t.phase("x"):
+                raise RuntimeError("boom")
+        assert t.breakdown()["x"]["count"] == 1
+
+
+class TestFlopsAndMfu:
+    def test_compiled_flops_matmul(self):
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((64, 128), jnp.float32)
+        b = jnp.ones((128, 32), jnp.float32)
+        flops = profiler.compiled_flops(f, a, b)
+        if flops is None:
+            pytest.skip("backend exposes no cost analysis")
+        # 2*M*N*K, allow backend slack (fusion/rounding)
+        assert flops >= 2 * 64 * 128 * 32 * 0.5
+
+    def test_mfu_math(self):
+        # 100 TFLOP in 1s on a 200-TFLOP/s chip = 50%
+        assert profiler.mfu(100e12, 1.0, peak_tflops=200) == pytest.approx(50.0)
+        assert profiler.mfu(1.0, 0.0) == 0.0
+
+    def test_device_memory_stats_shape(self):
+        stats = profiler.device_memory_stats()
+        for s in stats:  # CPU backend may expose none — shape-check only
+            assert {"device", "bytes_in_use"} <= set(s)
+
+
+class TestTraceCapture:
+    def test_trace_contextmanager_writes(self, tmp_path):
+        logdir = str(tmp_path / "trace")
+        with profiler.trace(logdir):
+            x = jnp.ones((32, 32)) @ jnp.ones((32, 32))
+            jax.block_until_ready(x)
+        found = [f for _, _, fs in os.walk(logdir) for f in fs]
+        assert found, "trace produced no files"
+
+    def test_profiling_listener_finalizes_on_epoch_end(self, tmp_path):
+        """Round-3 review finding: a trace left open when training ends early
+        is unreadable and blocks later captures."""
+        lst = profiler.ProfilingListener(str(tmp_path / "t"), start=1, duration=99)
+        model = object()
+        score = jnp.zeros(())
+        lst.iteration_done(model, 1, score)  # starts trace
+        assert lst._active
+        lst.on_epoch_end(model, 1)  # training ended before start+duration
+        assert not lst._active
+        # a later capture in the same process must not raise
+        with profiler.trace(str(tmp_path / "t2")):
+            jax.block_until_ready(jnp.ones(4) + 1)
+
+
+class TestSystemInfoSampler:
+    def test_sample_fields(self):
+        info = profiler.SystemInfoSampler.sample()
+        assert info["host_rss_mb"] > 0
+        assert info["device_count"] >= 1
+        assert info["device_platform"] == "cpu"

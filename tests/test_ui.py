@@ -293,3 +293,80 @@ class TestPhaseTimingsFlow:
             assert "Phase timings" in html
         finally:
             server.stop()
+
+
+class TestConvActivationsAndTsne:
+    def test_conv_listener_records_feature_maps(self):
+        """Reference: ConvolutionalIterationListener.java — feature maps of
+        the first conv layer land in storage and render via the API."""
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+        from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+
+        conf = MultiLayerConfiguration(
+            layers=[
+                ConvolutionLayer(n_out=6, kernel=(3, 3), activation="relu"),
+                SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+                DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=3, activation="softmax"),
+            ],
+            input_type=InputType.convolutional(10, 10, 1),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        )
+        net = MultiLayerNetwork(conf).init()
+        st = InMemoryStatsStorage()
+        net.add_listener(ConvolutionalIterationListener(
+            st, frequency=2, session_id="conv_sess", max_maps=4, max_px=8))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 10, 10, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.fit(DataSet(x, y), epochs=4)
+
+        ups = st.get_all_updates("conv_sess")
+        assert len(ups) == 2  # iterations 2 and 4
+        ca = ups[-1]["conv_activations"]
+        assert ca["layer"] == 0
+        assert len(ca["maps"]) == 4
+        assert len(ca["maps"][0]) == 8 and len(ca["maps"][0][0]) == 8
+        flat = [v for m in ca["maps"] for row in m for v in row]
+        assert 0.0 <= min(flat) and max(flat) <= 1.0
+
+        server = UIServer(port=0)
+        try:
+            server.attach(st)
+            base = f"http://127.0.0.1:{server.port}"
+            rec = json.loads(urllib.request.urlopen(
+                f"{base}/api/activations?session=conv_sess").read())
+            assert rec["conv_activations"]["maps"]
+            html = urllib.request.urlopen(f"{base}/train/activations").read().decode()
+            assert "feature maps" in html
+        finally:
+            server.stop()
+
+    def test_tsne_page_round_trip(self):
+        from deeplearning4j_tpu.ui import post_tsne
+
+        st = InMemoryStatsStorage()
+        rng = np.random.default_rng(0)
+        coords = rng.normal(size=(50, 2))
+        labels = [str(i % 5) for i in range(50)]
+        post_tsne(st, "tsne_sess", coords, labels)
+
+        server = UIServer(port=0)
+        try:
+            server.attach(st)
+            base = f"http://127.0.0.1:{server.port}"
+            t = json.loads(urllib.request.urlopen(
+                f"{base}/api/tsne?session=tsne_sess").read())
+            assert len(t["coords"]) == 50
+            assert t["labels"][:5] == ["0", "1", "2", "3", "4"]
+            html = urllib.request.urlopen(f"{base}/train/tsne").read().decode()
+            assert "t-SNE embedding" in html
+        finally:
+            server.stop()
+
+    def test_post_tsne_validates_shape(self):
+        from deeplearning4j_tpu.ui import post_tsne
+
+        with pytest.raises(ValueError):
+            post_tsne(InMemoryStatsStorage(), "s", np.zeros((5,)))
