@@ -1,20 +1,70 @@
 """Object-store data plumbing (reference: deeplearning4j-aws s3/uploader/
 S3Uploader.java, s3/reader/BaseS3DataSetIterator.java).
 
-Cloud clients are NOT baked into this image, so all classes gate on their
-SDK at construction (boto3 for s3://, google-cloud-storage for gs:// — the
-TPU-native home). The iterator surface matches the rest of the datasets
-tier so object-store-resident corpora drop into fit() unchanged.
+Cloud clients are NOT baked into this image, so the s3://-and-gs:// transports
+gate on their SDK at construction (boto3 / google-cloud-storage). Everything
+ABOVE the transport — the uploader, downloader, listing, and the caching
+dataset iterator — is transport-agnostic and fully exercised offline through
+the built-in ``file://`` client (also the injection seam for tests and for
+other object stores via :func:`register_client`). Object-store-resident
+corpora drop into fit() unchanged.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
-from typing import Iterator, List, Optional
+import shutil
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+class LocalFileSystemClient:
+    """s3-style client over a local directory tree (``file://`` scheme).
+
+    'bucket' is an absolute directory path component; keys are relative
+    paths. Gives the full uploader/downloader/iterator stack an offline
+    transport (and tests a real one).
+    """
+
+    def upload_file(self, local_path: str, bucket: str, key: str) -> None:
+        dest = os.path.join("/", bucket, key)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copyfile(local_path, dest)
+
+    def download_file(self, bucket: str, key: str, local_path: str) -> None:
+        shutil.copyfile(os.path.join("/", bucket, key), local_path)
+
+    def list_objects_v2(self, Bucket: str, Prefix: str = "") -> dict:  # noqa: N803 - s3 API shape
+        base = os.path.join("/", Bucket)
+        # Walk only the prefix subtree: file:///abs/path parses to bucket=""
+        # and walking base ("/") would traverse the entire filesystem.
+        start = os.path.join(base, Prefix)
+        root = start if os.path.isdir(start) else os.path.dirname(start)
+        out = []
+        for r, _, files in os.walk(root):
+            for f in files:
+                key = os.path.relpath(os.path.join(r, f), base)
+                if key.startswith(Prefix):
+                    out.append({"Key": key})
+        return {"Contents": sorted(out, key=lambda o: o["Key"])}
+
+
+_CLIENT_FACTORIES: Dict[str, Callable[[], tuple]] = {}
+
+
+def register_client(scheme: str, factory: Callable[[], tuple]) -> None:
+    """Install a client factory for a URL scheme. ``factory`` returns
+    ``(kind, client)`` where kind is "s3" (boto3-shaped API) or "gs"
+    (google-cloud-storage-shaped). Tests and alternative stores hook in here."""
+    _CLIENT_FACTORIES[scheme] = factory
+
+
+register_client("file", lambda: ("s3", LocalFileSystemClient()))
 
 
 def _client_for(scheme: str):
+    if scheme in _CLIENT_FACTORIES:
+        return _CLIENT_FACTORIES[scheme]()
     if scheme == "s3":
         try:
             import boto3  # noqa: PLC0415
@@ -33,7 +83,10 @@ def _client_for(scheme: str):
                 "this image); install it or use local files"
             ) from e
         return ("gs", storage.Client())
-    raise ValueError(f"Unsupported scheme '{scheme}' (use s3:// or gs://)")
+    raise ValueError(
+        f"Unsupported scheme '{scheme}' (use s3://, gs://, file://, or "
+        "register_client)"
+    )
 
 
 def _split_url(url: str):

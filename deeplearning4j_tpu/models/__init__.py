@@ -6,11 +6,15 @@ configs users built with MultiLayerConfiguration/ComputationGraphConfiguration.
 
 from .lenet import lenet_mnist_conf
 from .resnet import resnet_conf, resnet18_conf, resnet34_conf, resnet50_conf
+from .char_rnn import char_rnn
+from ..modelimport.trained_models import vgg16_configuration
 
 __all__ = [
+    "char_rnn",
     "lenet_mnist_conf",
     "resnet_conf",
     "resnet18_conf",
     "resnet34_conf",
     "resnet50_conf",
+    "vgg16_configuration",
 ]

@@ -74,17 +74,45 @@ class JapaneseTokenizerFactory(TokenizerFactory):
         return Tokenizer(self._segmenter.tokenize(text), self.pre_processor)
 
 
+# Common Korean postpositions (josa), longest-first so 에서/으로 beat 에/로.
+# Reference analog: the KoreanAnalyzer's particle POS class (josa) split off
+# from stems during tokenization.
+_JOSA = sorted(
+    ["은", "는", "이", "가", "을", "를", "의", "에", "에서", "에게", "한테",
+     "께", "으로", "로", "와", "과", "도", "만", "까지", "부터", "처럼",
+     "보다", "마다", "조차", "밖에", "이나", "나", "라도", "이라도", "요",
+     "이요", "이란", "란", "께서", "들"],
+    key=len, reverse=True,
+)
+
+
+def _split_josa(eojeol: str) -> List[str]:
+    """stem + particle for hangul eojeols (returns [eojeol] when no josa)."""
+    for josa in _JOSA:
+        if (len(eojeol) > len(josa) and eojeol.endswith(josa)
+                and _char_class(eojeol[0]) == "hangul"):
+            return [eojeol[: -len(josa)], josa]
+    return [eojeol]
+
+
 class KoreanTokenizerFactory(TokenizerFactory):
     """Korean segmentation (reference plugin: KoreanTokenizerFactory over
-    KoreanAnalyzer): whitespace-delimited eojeol, with non-hangul script
-    runs split out."""
+    KoreanAnalyzer): whitespace-delimited eojeol with non-hangul script runs
+    split out, plus josa (postposition) splitting so '학교에서' becomes
+    stem '학교' + particle '에서' — the granularity embedding models need.
+    ``split_josa=False`` restores plain eojeol tokens."""
 
-    def __init__(self, pre_processor: Optional[TokenPreProcess] = None):
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
+                 split_josa: bool = True):
         self.pre_processor = pre_processor
+        self.split_josa = split_josa
 
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
         for chunk in text.split():
-            runs = _script_runs(chunk)
-            tokens.extend(runs)
+            for run in _script_runs(chunk):
+                if self.split_josa and _char_class(run[0]) == "hangul":
+                    tokens.extend(_split_josa(run))
+                else:
+                    tokens.append(run)
         return Tokenizer(tokens, self.pre_processor)

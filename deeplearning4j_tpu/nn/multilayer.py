@@ -259,10 +259,14 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])
-        # host-side reference only (no copy): listeners that render activations
-        # (ConvolutionalIterationListener) re-run the forward on this batch —
-        # reference keeps the same via Model.setInput/input()
-        self._last_input = ds.features
+        # host-side reference (no copy), kept ONLY while a listener needs it:
+        # ConvolutionalIterationListener re-runs the forward on this batch
+        # (reference: Model.setInput/input()). Unconditional retention would
+        # pin one full batch per net for the net's lifetime.
+        if any(getattr(lst, "needs_input", False) for lst in self.listeners):
+            self._last_input = ds.features
+        else:
+            self._last_input = None
         if (
             self.conf.backprop_type == "tbptt"
             and np.ndim(ds.features) == 3

@@ -67,15 +67,22 @@ class KafkaSource(RecordSource):
     keeps the seam; deserializer maps message bytes → (features, label)).
     """
 
-    def __init__(self, topic: str, deserializer: Callable, **consumer_kwargs):
-        try:
-            from kafka import KafkaConsumer  # noqa: PLC0415
-        except ImportError as e:
-            raise ImportError(
-                "kafka-python is required for KafkaSource; implement "
-                "RecordSource.poll over your broker client instead"
-            ) from e
-        self._consumer = KafkaConsumer(topic, **consumer_kwargs)
+    def __init__(self, topic: str, deserializer: Callable,
+                 consumer_factory: Optional[Callable] = None, **consumer_kwargs):
+        # consumer_factory injects any kafka-python-shaped consumer (tests,
+        # alternative broker clients); only the default transport is gated.
+        if consumer_factory is not None:
+            self._consumer = consumer_factory(topic, **consumer_kwargs)
+        else:
+            try:
+                from kafka import KafkaConsumer  # noqa: PLC0415
+            except ImportError as e:
+                raise ImportError(
+                    "kafka-python is required for KafkaSource; implement "
+                    "RecordSource.poll over your broker client instead, or "
+                    "pass consumer_factory"
+                ) from e
+            self._consumer = KafkaConsumer(topic, **consumer_kwargs)
         self._deserializer = deserializer
 
     def poll(self, timeout: float = 0.1):

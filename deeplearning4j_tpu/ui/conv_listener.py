@@ -31,6 +31,9 @@ def _downsample(img: np.ndarray, max_px: int) -> np.ndarray:
 class ConvolutionalIterationListener(TrainingListener):
     """Capture first-conv-layer feature maps every ``frequency`` iterations."""
 
+    # models check this to retain the current batch for re-forwarding
+    needs_input = True
+
     def __init__(
         self,
         router: StatsStorageRouter,

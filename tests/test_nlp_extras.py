@@ -109,11 +109,22 @@ def test_japanese_segmenter_pos_and_extension():
 
 
 def test_korean_tokenizer():
-    tf = KoreanTokenizerFactory()
+    tf = KoreanTokenizerFactory(split_josa=False)
     toks = tf.create("안녕하세요 JAX 세계!").get_tokens()
     assert "안녕하세요" in toks
     assert "JAX" in toks
     assert "!" not in toks
+
+
+def test_korean_tokenizer_josa_splitting():
+    """Reference analog: KoreanAnalyzer separates josa particles from stems."""
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("학교에서 친구를 만났다").get_tokens()
+    assert toks[:4] == ["학교", "에서", "친구", "를"]
+    # longest-match: 에서 wins over 에; no-josa eojeol stays whole
+    assert "만났다" in toks
+    # a single-char hangul eojeol never strips to empty
+    assert tf.create("이").get_tokens() == ["이"]
 
 
 def _tiny_word2vec():

@@ -275,3 +275,41 @@ def test_gateway_concurrent_fit_serialized():
         assert not errors
         assert np.isfinite(c0.evaluate("m", feats, labels))
         c0.close()
+
+
+def test_kafka_source_logic_with_injected_consumer():
+    """KafkaSource's poll/deserialize logic runs against any kafka-python-
+    shaped consumer (injection seam); only the broker transport is gated."""
+    import numpy as np
+
+    from deeplearning4j_tpu.streaming.pipeline import KafkaSource
+
+    class FakeConsumer:
+        def __init__(self, topic):
+            self.topic = topic
+            self.messages = [b"1.0,2.0|0", b"3.0,4.0|1"]
+            self.closed = False
+
+        def poll(self, timeout_ms=100, max_records=1):
+            if not self.messages:
+                return {}
+            rec = type("Rec", (), {"value": self.messages.pop(0)})()
+            return {("tp", 0): [rec]}
+
+        def close(self):
+            self.closed = True
+
+    def deser(raw: bytes):
+        feats, label = raw.decode().split("|")
+        return (np.array([float(v) for v in feats.split(",")], np.float32),
+                int(label))
+
+    src = KafkaSource("topic-x", deser,
+                      consumer_factory=lambda topic, **kw: FakeConsumer(topic))
+    f1, l1 = src.poll()
+    assert list(f1) == [1.0, 2.0] and l1 == 0
+    f2, l2 = src.poll()
+    assert list(f2) == [3.0, 4.0] and l2 == 1
+    assert src.poll() is None  # drained
+    src.close()
+    assert src._consumer.closed
