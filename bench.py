@@ -271,7 +271,11 @@ def _tpu_child_main() -> int:
         result = max(results, key=lambda r: r["value"])
         result["sweep"] = {str(r["batch"]): r["value"] for r in results}
     else:
-        result = bench_resnet50(batch=int(os.environ.get("BENCH_BATCH", "128")))
+        try:
+            batch = int(os.environ.get("BENCH_BATCH", "128"))
+        except ValueError:
+            batch = 128
+        result = bench_resnet50(batch=batch)
     result["backend"] = backend
     print(json.dumps(result))
     return 0

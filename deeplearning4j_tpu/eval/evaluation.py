@@ -138,8 +138,14 @@ class Evaluation:
         tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
         return float(fp / max(fp + tn, 1))
 
+    def _label(self, cls: int) -> str:
+        if self.label_names and cls < len(self.label_names):
+            return self.label_names[cls]
+        return str(cls)
+
     def stats(self) -> str:
-        """Printable summary (reference: Evaluation.stats:352)."""
+        """Printable summary incl. the per-class breakdown the reference
+        prints (reference: Evaluation.stats:352)."""
         lines = [
             "========================Evaluation Metrics========================",
             f" # of classes:    {self.n_classes}",
@@ -148,6 +154,26 @@ class Evaluation:
             f" Precision:       {self.precision():.4f}",
             f" Recall:          {self.recall():.4f}",
             f" F1 Score:        {self.f1():.4f}",
+            "",
+            "Per-class:  label          precision  recall   f1      count",
+        ]
+        # vectorized per-class metrics: one pass over the C x C matrix
+        # (per-class method calls in a loop would be O(C^3) at C=1000)
+        m = self.confusion.matrix
+        tp = np.diag(m).astype(np.float64)
+        col = m.sum(axis=0)
+        row = m.sum(axis=1)
+        prec = np.where(col > 0, tp / np.maximum(col, 1), 0.0)
+        rec = np.where(row > 0, tp / np.maximum(row, 1), 0.0)
+        denom = prec + rec
+        f1s = np.where(denom > 0, 2 * prec * rec / np.maximum(denom, 1e-300), 0.0)
+        for c in range(self.n_classes or 0):
+            lines.append(
+                f"            {self._label(c):<14} "
+                f"{prec[c]:<9.4f} {rec[c]:<8.4f} "
+                f"{f1s[c]:<7.4f} {int(row[c])}"
+            )
+        lines += [
             "",
             "=========================Confusion Matrix=========================",
             str(self.confusion.matrix),

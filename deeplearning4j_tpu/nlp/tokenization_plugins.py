@@ -98,12 +98,15 @@ def _split_josa(eojeol: str) -> List[str]:
 class KoreanTokenizerFactory(TokenizerFactory):
     """Korean segmentation (reference plugin: KoreanTokenizerFactory over
     KoreanAnalyzer): whitespace-delimited eojeol with non-hangul script runs
-    split out, plus josa (postposition) splitting so '학교에서' becomes
-    stem '학교' + particle '에서' — the granularity embedding models need.
-    ``split_josa=False`` restores plain eojeol tokens."""
+    split out. ``split_josa=True`` additionally strips trailing josa
+    (postpositions) so '학교에서' becomes stem '학교' + particle '에서'.
+    OPT-IN: the splitter is dictionary-free suffix matching, which also
+    clips nouns whose final syllable coincides with a josa (고양이 →
+    고양+이) — enable it for recall-oriented embedding vocabularies, keep
+    the default eojeol tokens for precision."""
 
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
-                 split_josa: bool = True):
+                 split_josa: bool = False):
         self.pre_processor = pre_processor
         self.split_josa = split_josa
 

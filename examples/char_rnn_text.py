@@ -1,0 +1,64 @@
+"""Character-level LSTM language model with TBPTT + streaming sampling.
+
+Reference example: dl4j-examples GravesLSTMCharModellingExample — train a
+stacked GravesLSTM on text, then generate with stateful rnn_time_step
+(one traced program per step, h/c carried across calls).
+"""
+
+import argparse
+
+import numpy as np
+
+TEXT = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 8
+
+
+def main(quick: bool = False) -> str:
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.models.char_rnn import char_rnn
+
+    vocab = sorted(set(TEXT))
+    stoi = {c: i for i, c in enumerate(vocab)}
+    ids = np.array([stoi[c] for c in TEXT])
+
+    conf = char_rnn(vocab_size=len(vocab), hidden_size=32 if quick else 128,
+                    num_layers=1 if quick else 2, tbptt_length=16)
+    net = MultiLayerNetwork(conf).init()
+
+    T, B = 64, 8
+    n_wins = (len(ids) - 1) // T
+    xs = np.stack([np.eye(len(vocab), dtype=np.float32)[ids[i * T:(i + 1) * T]]
+                   for i in range(n_wins)])
+    ys = np.stack([np.eye(len(vocab), dtype=np.float32)[ids[i * T + 1:(i + 1) * T + 1]]
+                   for i in range(n_wins)])
+    for _ in range(2 if quick else 20):
+        for s in range(0, n_wins - B + 1, B):
+            net.fit(DataSet(xs[s:s + B], ys[s:s + B]))
+
+    # streaming generation: one char at a time, state carried on the net
+    net.rnn_clear_previous_state()
+    seed = "the "
+    out = list(seed)
+    rng = np.random.default_rng(0)
+    x = np.eye(len(vocab), dtype=np.float32)[[stoi[c] for c in seed]][None, :, :]
+    probs = np.asarray(net.rnn_time_step(x))[0, -1]
+    for _ in range(40 if quick else 200):
+        idx = int(rng.choice(len(vocab), p=probs / probs.sum()))
+        out.append(vocab[idx])
+        step = np.eye(len(vocab), dtype=np.float32)[[idx]]
+        probs = np.asarray(net.rnn_time_step(step))[0]
+        if probs.ndim == 2:
+            probs = probs[-1]
+    text = "".join(out)
+    print(text)
+    return text
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,72 @@
+"""Early stopping with score calculator, termination conditions, model saver.
+
+Reference example: dl4j-examples EarlyStoppingMnistExample.
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+
+def main(quick: bool = False):
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        DataSetLossCalculator,
+        EarlyStoppingConfiguration,
+        EarlyStoppingTrainer,
+        LocalFileModelSaver,
+        MaxEpochsTerminationCondition,
+        ScoreImprovementEpochTerminationCondition,
+    )
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(6, 3))
+
+    def batches(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            x = r.normal(size=(32, 6)).astype(np.float32)
+            out.append(DataSet(x, np.eye(3, dtype=np.float32)[(x @ w).argmax(-1)]))
+        return out
+
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=24, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(6),
+        updater=UpdaterConfig(updater="adam", learning_rate=5e-3),
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    save_dir = tempfile.mkdtemp()
+    es_conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(4 if quick else 30),
+            ScoreImprovementEpochTerminationCondition(patience=5),
+        ],
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(batches(4, 99))),
+        model_saver=LocalFileModelSaver(save_dir),
+    )
+    trainer = EarlyStoppingTrainer(es_conf, net, ListDataSetIterator(batches(8, 0)))
+    result = trainer.fit()
+    print("termination reason:", result.termination_reason)
+    print("best epoch:", result.best_model_epoch,
+          "best score:", round(result.best_model_score, 5))
+    best = result.best_model
+    assert best is not None
+    return result
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

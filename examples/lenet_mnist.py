@@ -1,0 +1,48 @@
+"""LeNet CNN with real-data accuracy.
+
+Reference example: dl4j-examples LenetMnistExample. Trains on the real
+handwritten-digit corpus bundled with sklearn (8x8 scans, kernels scaled
+accordingly); the full 28x28 LeNet-5 config (models/lenet.py) drops in when
+true MNIST is available.
+"""
+
+import argparse
+
+
+def main(quick: bool = False) -> float:
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+
+    conf = MultiLayerConfiguration(
+        layers=[
+            ConvolutionLayer(n_out=20, kernel=(3, 3), activation="identity"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            ConvolutionLayer(n_out=50, kernel=(2, 2), activation="identity"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            DenseLayer(n_out=128, activation="relu"),
+            OutputLayer(n_out=10, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.convolutional(8, 8, 1),
+        updater=UpdaterConfig(updater="adam", learning_rate=2e-3),
+        seed=5,
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DigitsDataSetIterator(batch=128, train=True), epochs=6 if quick else 12)
+    ev = net.evaluate(DigitsDataSetIterator(batch=120, train=False, shuffle=False))
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,72 @@
+"""Transfer learning: freeze a trained feature extractor, replace the head.
+
+Reference example: dl4j-examples transfer-learning set (EditLastLayerOthersFrozen):
+train a base model on task A, freeze everything below the head, swap in a new
+output layer for task B, fine-tune — frozen params provably unchanged.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main(quick: bool = False):
+    import jax
+
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(10, 4))
+
+    def task(n_classes, seed, n=256):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n, 10)).astype(np.float32)
+        y = (x @ w[:, :n_classes]).argmax(-1)
+        return DataSet(x, np.eye(n_classes, dtype=np.float32)[y])
+
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=32, activation="relu"),
+            DenseLayer(n_out=16, activation="relu"),
+            OutputLayer(n_out=4, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(10),
+        updater=UpdaterConfig(updater="adam", learning_rate=5e-3),
+        seed=1,
+    )
+    base = MultiLayerNetwork(conf).init()
+    base.fit(task(4, seed=0), epochs=25 if quick else 60)
+    print("base task accuracy:", round(base.evaluate(task(4, seed=9)).accuracy(), 3))
+
+    # freeze layers 0-1, replace the 4-way head with a 3-way head
+    new_net = (
+        TransferLearning.Builder(base)
+        .set_feature_extractor(1)
+        .remove_output_layer()
+        .add_layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .build()
+    )
+    frozen_before = jax.tree_util.tree_map(np.asarray, new_net.params[0])
+    new_net.fit(task(3, seed=2), epochs=25 if quick else 60)
+    frozen_after = jax.tree_util.tree_map(np.asarray, new_net.params[0])
+    for a, b in zip(jax.tree_util.tree_leaves(frozen_before),
+                    jax.tree_util.tree_leaves(frozen_after)):
+        np.testing.assert_array_equal(a, b)
+    acc = new_net.evaluate(task(3, seed=11)).accuracy()
+    print("new task accuracy (frozen features):", round(acc, 3))
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,73 @@
+"""Training dashboard: StatsListener -> StatsStorage -> UIServer.
+
+Reference example: dl4j-examples UIExample (UIServer.getInstance().attach).
+Serves overview / model / system / flow / activations / t-SNE pages while a
+small CNN trains; in --quick mode trains, asserts the endpoints respond, and
+exits.
+"""
+
+import argparse
+import json
+import urllib.request
+
+import numpy as np
+
+
+def main(quick: bool = False):
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.ui import (
+        ConvolutionalIterationListener,
+        InMemoryStatsStorage,
+        StatsListener,
+        UIServer,
+    )
+
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0 if quick else 9000)
+    server.attach(storage)
+    print(f"dashboard: http://127.0.0.1:{server.port}/train/overview")
+
+    conf = MultiLayerConfiguration(
+        layers=[
+            ConvolutionLayer(n_out=8, kernel=(3, 3), activation="relu"),
+            DenseLayer(n_out=64, activation="relu"),
+            OutputLayer(n_out=10, activation="softmax"),
+        ],
+        input_type=InputType.convolutional(8, 8, 1),
+        updater=UpdaterConfig(updater="adam", learning_rate=2e-3),
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(
+        StatsListener(storage, session_id="ui_example"),
+        ConvolutionalIterationListener(storage, frequency=5, session_id="ui_example"),
+    )
+    net.fit(DigitsDataSetIterator(batch=128, train=True), epochs=2 if quick else 20)
+
+    base = f"http://127.0.0.1:{server.port}"
+    h = json.loads(urllib.request.urlopen(
+        f"{base}/api/histograms?session=ui_example").read())
+    assert h["param_histograms"], "no histograms recorded"
+    a = json.loads(urllib.request.urlopen(
+        f"{base}/api/activations?session=ui_example").read())
+    assert a.get("conv_activations", {}).get("maps"), "no feature maps"
+    print("endpoints OK: histograms + activations populated")
+    if quick:
+        server.stop()
+    else:  # leave serving for a browser
+        input("dashboard running — press Enter to stop")
+        server.stop()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,42 @@
+"""Word2Vec on raw text: vocab build, training, nearest-word queries.
+
+Reference example: dl4j-examples Word2VecRawTextExample.
+"""
+
+import argparse
+
+SENTENCES = [
+    "the king rules the kingdom",
+    "the queen rules the kingdom",
+    "the king and the queen sit on thrones",
+    "a dog chases the cat",
+    "the cat runs from the dog",
+    "dogs and cats are animals",
+    "the kingdom has a castle",
+    "the castle belongs to the king and queen",
+] * 6
+
+
+def main(quick: bool = False):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    w2v = Word2Vec(
+        layer_size=16 if quick else 64,
+        window=3,
+        min_word_frequency=2,
+        epochs=1 if quick else 5,
+        seed=42,
+    )
+    w2v.fit(SENTENCES)
+    print("vocab size:", len(list(w2v.vocab.words())))
+    near = w2v.words_nearest("king", top_n=3)
+    print("nearest to 'king':", near)
+    sim = w2v.similarity("king", "queen")
+    print(f"similarity(king, queen) = {sim:.3f}")
+    return near
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,60 @@
+"""Every example in examples/ runs end-to-end in --quick mode.
+
+The reference ships dl4j-examples as its de-facto acceptance suite; these
+tests keep this repo's ports runnable (imports, API drift, numerics)."""
+
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+sys.path.insert(0, EXAMPLES)
+
+
+def _mod(name):
+    import importlib
+
+    return importlib.import_module(name)
+
+
+def test_mlp_mnist_example():
+    acc = _mod("mlp_mnist").main(quick=True)
+    assert acc > 0.5  # synthetic fallback is separable; real MNIST far higher
+
+
+def test_lenet_mnist_example():
+    acc = _mod("lenet_mnist").main(quick=True)
+    assert acc > 0.8  # 6 quick epochs on real digit scans
+
+
+def test_char_rnn_example():
+    text = _mod("char_rnn_text").main(quick=True)
+    assert text.startswith("the ") and len(text) > 20
+
+
+def test_word2vec_example():
+    near = _mod("word2vec_basic").main(quick=True)
+    assert len(near) == 3
+
+
+def test_parallel_training_example():
+    acc = _mod("parallel_training").main(quick=True)
+    assert acc > 0.5
+
+
+def test_early_stopping_example():
+    result = _mod("early_stopping").main(quick=True)
+    assert result.best_model is not None
+    assert result.termination_reason
+
+
+def test_transfer_learning_example():
+    acc = _mod("transfer_learning").main(quick=True)
+    assert acc > 0.5
+
+
+def test_ui_dashboard_example():
+    _mod("ui_dashboard").main(quick=True)

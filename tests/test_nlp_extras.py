@@ -109,7 +109,7 @@ def test_japanese_segmenter_pos_and_extension():
 
 
 def test_korean_tokenizer():
-    tf = KoreanTokenizerFactory(split_josa=False)
+    tf = KoreanTokenizerFactory()
     toks = tf.create("안녕하세요 JAX 세계!").get_tokens()
     assert "안녕하세요" in toks
     assert "JAX" in toks
@@ -117,8 +117,8 @@ def test_korean_tokenizer():
 
 
 def test_korean_tokenizer_josa_splitting():
-    """Reference analog: KoreanAnalyzer separates josa particles from stems."""
-    tf = KoreanTokenizerFactory()
+    """Opt-in josa splitting (KoreanAnalyzer analog at the particle level)."""
+    tf = KoreanTokenizerFactory(split_josa=True)
     toks = tf.create("학교에서 친구를 만났다").get_tokens()
     assert toks[:4] == ["학교", "에서", "친구", "를"]
     # longest-match: 에서 wins over 에; no-josa eojeol stays whole

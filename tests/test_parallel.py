@@ -264,12 +264,13 @@ class TestShardedCheckpointPortability:
         write_model(net, str(path))
 
         # 1) restore unsharded (single-device semantics). Params/opt-state are
-        # bit-exact (asserted below); outputs may differ by float reduction
-        # order between the GSPMD forward and the single-device forward.
+        # bit-exact (asserted below); forward outputs are compared loosely
+        # because GSPMD and single-device forwards legitimately differ by
+        # float reduction order (ulps on CPU simulation, more on real meshes).
         restored = restore_model(str(path))
         np.testing.assert_allclose(
             np.asarray(restored.output(probe.features)), ref_out,
-            rtol=0, atol=1e-12)
+            rtol=1e-5, atol=1e-6)
         for a, b in zip(jax.tree_util.tree_leaves(net.opt_state),
                         jax.tree_util.tree_leaves(restored.opt_state)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
