@@ -2,6 +2,7 @@
 
 from .iterators import (
     AsyncDataSetIterator,
+    BucketingSequenceIterator,
     DataSet,
     DataSetIterator,
     DevicePrefetchIterator,
@@ -51,7 +52,8 @@ from .normalizers import (
 )
 
 __all__ = [
-    "AsyncDataSetIterator", "DataSet", "DataSetIterator",
+    "AsyncDataSetIterator",
+    "BucketingSequenceIterator", "DataSet", "DataSetIterator",
     "DevicePrefetchIterator", "ExistingDataSetIterator", "IteratorDataSetIterator",
     "ListDataSetIterator", "MultiDataSet", "MultipleEpochsIterator",
     "NumpyDataSetIterator", "SamplingDataSetIterator",

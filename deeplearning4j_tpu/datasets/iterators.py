@@ -308,3 +308,88 @@ def as_iterator(data) -> Iterable[DataSet]:
     if isinstance(data, tuple) and len(data) == 2:
         return ListDataSetIterator([DataSet(np.asarray(data[0]), np.asarray(data[1]))])
     return data
+
+
+class BucketingSequenceIterator(DataSetIterator):
+    """Group variable-length sequences into a FIXED set of padded lengths.
+
+    SURVEY.md §7 hard part (f): XLA compiles one program per input shape, so
+    naive pad-to-longest-in-batch yields as many recompiles as there are
+    distinct batch maxima. This iterator assigns every sequence to the
+    smallest bucket boundary >= its length, pads (with a features mask — and
+    a labels mask for per-step labels) to that boundary, and emits batches
+    drawn from ONE bucket at a time — the whole epoch then compiles at most
+    ``len(boundaries)`` programs regardless of the length distribution.
+
+    ``sequences``: iterable of (features [T, F], labels [T, C] per-step or
+    [C] per-sequence) pairs. Overlong sequences go to the largest bucket
+    truncated (reference analog: the truncation semantics of TBPTT windows).
+    """
+
+    def __init__(self, sequences, batch: int,
+                 boundaries: Sequence[int] = (32, 64, 128, 256),
+                 drop_remainder: bool = False):
+        self.sequences = list(sequences)
+        self.batch = int(batch)
+        self.boundaries = sorted(int(b) for b in boundaries)
+        if not self.boundaries:
+            raise ValueError("need at least one bucket boundary")
+        self.drop_remainder = drop_remainder
+
+    def batch_size(self):
+        return self.batch
+
+    def _bucket_of(self, length: int) -> int:
+        for b in self.boundaries:
+            if length <= b:
+                return b
+        return self.boundaries[-1]  # overlong: truncate into the last bucket
+
+    def _pad(self, feats, labels, bound: int):
+        f = np.asarray(feats, dtype=np.float32)[:bound]
+        t = f.shape[0]
+        fp = np.zeros((bound,) + f.shape[1:], dtype=np.float32)
+        fp[:t] = f
+        fmask = np.zeros(bound, dtype=np.float32)
+        fmask[:t] = 1.0
+        l = np.asarray(labels, dtype=np.float32)
+        if l.ndim == 2:  # per-step labels pad + mask alongside
+            l = l[:bound]
+            lp = np.zeros((bound,) + l.shape[1:], dtype=np.float32)
+            lp[: l.shape[0]] = l
+            lmask = np.zeros(bound, dtype=np.float32)
+            lmask[: l.shape[0]] = 1.0
+            return fp, fmask, lp, lmask
+        return fp, fmask, l, None
+
+    def __iter__(self):
+        buckets: dict = {}
+        for feats, labels in self.sequences:
+            bound = self._bucket_of(np.asarray(feats).shape[0])
+            buckets.setdefault(bound, []).append((feats, labels))
+        for bound in self.boundaries:
+            items = buckets.get(bound, [])
+            for s in range(0, len(items), self.batch):
+                chunk = items[s : s + self.batch]
+                if self.drop_remainder and len(chunk) < self.batch:
+                    continue
+                padded = [self._pad(f, l, bound) for f, l in chunk]
+                fs = np.stack([p[0] for p in padded])
+                fm = np.stack([p[1] for p in padded])
+                ls = np.stack([p[2] for p in padded])
+                lm = (np.stack([p[3] for p in padded])
+                      if padded[0][3] is not None else None)
+                yield DataSet(fs, ls, fm, lm)
+
+    def num_programs(self) -> int:
+        """Upper bound on XLA compilations this iterator can cause."""
+        lens = {self._bucket_of(np.asarray(f).shape[0]) for f, _ in self.sequences}
+        full = len(lens)
+        if not self.drop_remainder:
+            # trailing partial batches add at most one extra shape per bucket
+            full += sum(
+                1 for b in lens
+                if len([1 for f, _ in self.sequences
+                        if self._bucket_of(np.asarray(f).shape[0]) == b]) % self.batch
+            )
+        return full

@@ -69,6 +69,10 @@ class SelfAttentionLayer(BaseLayer):
     n_heads: int = 4
     causal: bool = False
     sequence_parallel: str = "ring"  # ring | all_to_all
+    # local-kernel choice: "xla" (fused by the compiler, materializes [T,T]
+    # scores) or "flash" (Pallas blockwise online-softmax, O(T) memory —
+    # ops/flash_attention.py; the pick for long sequences)
+    attention_impl: str = "xla"
 
     @property
     def is_recurrent(self) -> bool:
@@ -111,7 +115,13 @@ class SelfAttentionLayer(BaseLayer):
 
         mesh_ctx = get_attention_mesh()
         if mesh_ctx is None:
-            out = attention(q, k, v, causal=self.causal, key_mask=key_mask)
+            if self.attention_impl == "flash":
+                from ...ops.flash_attention import flash_attention  # noqa: PLC0415
+
+                out = flash_attention(q, k, v, causal=self.causal,
+                                      key_mask=key_mask)
+            else:
+                out = attention(q, k, v, causal=self.causal, key_mask=key_mask)
         else:
             mesh, axis = mesh_ctx
             fn = (ring_attention if self.sequence_parallel == "ring"

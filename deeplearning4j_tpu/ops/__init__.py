@@ -22,6 +22,7 @@ from .pallas_kernels import (
     fused_lstm_cell,
     supported_lstm_activations,
 )
+from .flash_attention import flash_attention
 
 _FORCED: Optional[bool] = None  # set_helpers_enabled override
 
@@ -93,6 +94,7 @@ def lrn(x, k: float = 2.0, n: int = 5, alpha: float = 1e-4, beta: float = 0.75):
 
 
 __all__ = [
+    "flash_attention",
     "fused_lrn",
     "fused_lstm_cell",
     "helpers_enabled",

@@ -163,3 +163,89 @@ def test_device_prefetch_iterator_preserves_stream():
         np.testing.assert_allclose(np.asarray(ds.features), i)
     # re-iterable
     assert len(list(it)) == 5
+
+
+class TestBucketingSequenceIterator:
+    """SURVEY.md §7 hard part (f): bounded XLA shape count for variable-length
+    sequences."""
+
+    def _seqs(self, lengths, F=4, C=3, per_step=True, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for t in lengths:
+            f = rng.normal(size=(t, F)).astype(np.float32)
+            l = (np.eye(C, dtype=np.float32)[rng.integers(0, C, t)] if per_step
+                 else np.eye(C, dtype=np.float32)[rng.integers(0, C)])
+            out.append((f, l))
+        return out
+
+    def test_buckets_pad_and_mask(self):
+        from deeplearning4j_tpu.datasets.iterators import BucketingSequenceIterator
+
+        seqs = self._seqs([3, 7, 9, 15, 16, 30, 31, 5])
+        it = BucketingSequenceIterator(seqs, batch=2, boundaries=(8, 16, 32))
+        shapes = set()
+        total = 0
+        for ds in it:
+            shapes.add(ds.features.shape[1])
+            total += ds.num_examples()
+            # mask exactly covers the real steps
+            real = ds.features_mask.sum(axis=1)
+            assert all(1 <= r <= ds.features.shape[1] for r in real)
+            assert ds.labels_mask is not None
+            np.testing.assert_array_equal(ds.features_mask, ds.labels_mask)
+            # padding region is all zeros
+            for i in range(ds.num_examples()):
+                t = int(real[i])
+                assert not ds.features[i, t:].any()
+        assert shapes <= {8, 16, 32}
+        assert total == len(seqs)
+        assert it.num_programs() <= 2 * 3
+
+    def test_overlong_truncates_into_last_bucket(self):
+        from deeplearning4j_tpu.datasets.iterators import BucketingSequenceIterator
+
+        seqs = self._seqs([50, 60])
+        it = BucketingSequenceIterator(seqs, batch=2, boundaries=(8, 32))
+        (ds,) = list(it)
+        assert ds.features.shape[1] == 32
+        assert ds.features_mask.sum(axis=1).tolist() == [32.0, 32.0]
+
+    def test_per_sequence_labels(self):
+        from deeplearning4j_tpu.datasets.iterators import BucketingSequenceIterator
+
+        seqs = self._seqs([4, 6], per_step=False)
+        (ds,) = list(BucketingSequenceIterator(seqs, batch=2, boundaries=(8,)))
+        assert ds.labels.shape == (2, 3)
+        assert ds.labels_mask is None
+
+    def test_drop_remainder(self):
+        from deeplearning4j_tpu.datasets.iterators import BucketingSequenceIterator
+
+        seqs = self._seqs([4, 5, 6])
+        it = BucketingSequenceIterator(seqs, batch=2, boundaries=(8,),
+                                       drop_remainder=True)
+        batches = list(it)
+        assert len(batches) == 1 and batches[0].num_examples() == 2
+
+    def test_trains_a_masked_lstm(self):
+        """End-to-end: bucketed variable-length batches feed GravesLSTM with
+        masks; only bucket-many shapes reach XLA."""
+        from deeplearning4j_tpu import (
+            GravesLSTM, InputType, MultiLayerConfiguration, MultiLayerNetwork,
+            RnnOutputLayer, UpdaterConfig,
+        )
+        from deeplearning4j_tpu.datasets.iterators import BucketingSequenceIterator
+
+        seqs = self._seqs([3, 4, 6, 7, 10, 12, 5, 8], F=4, C=3)
+        it = BucketingSequenceIterator(seqs, batch=2, boundaries=(8, 16),
+                                       drop_remainder=True)
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=8),
+                    RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            input_type=InputType.recurrent(4),
+            updater=UpdaterConfig(updater="adam", learning_rate=5e-3),
+        )
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=2)
+        assert np.isfinite(float(net._last_loss))

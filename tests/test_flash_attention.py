@@ -1,0 +1,205 @@
+"""Flash-attention Pallas kernel vs the XLA reference implementation.
+
+Interpret mode (CPU) runs the identical kernel code; numerics are compared
+against parallel.ring_attention.attention (itself gradient-checked)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+from deeplearning4j_tpu.parallel.ring_attention import attention
+
+
+def _qkv(b=2, h=2, t=16, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_key_mask(self):
+        q, k, v = _qkv(t=12)
+        mask = jnp.asarray(np.tile([1, 1, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0], (2, 1)),
+                           jnp.float32)
+        ref = attention(q, k, v, key_mask=mask)
+        out = flash_attention(q, k, v, key_mask=mask, block_q=4, block_k=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_divisible_lengths(self):
+        """T not a multiple of the block: internal padding + slice."""
+        q, k, v = _qkv(t=13)
+        ref = attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=4)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_blocks_larger_than_t(self):
+        q, k, v = _qkv(t=6)
+        ref = attention(q, k, v)
+        out = flash_attention(q, k, v, block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(t=16, d=4)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention(q, k, v, causal=causal) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal, block_q=8, block_k=8) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_fl, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_grads_with_mask_and_padding(self):
+        q, k, v = _qkv(t=10, d=4)
+        mask = jnp.asarray(np.tile([1] * 7 + [0] * 3, (2, 1)), jnp.float32)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention(q, k, v, key_mask=mask) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, key_mask=mask,
+                                           block_q=4, block_k=4) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fl, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_jit_and_value_grad(self):
+        q, k, v = _qkv(t=8, d=4)
+        f = jax.jit(lambda q, k, v: jnp.mean(
+            flash_attention(q, k, v, causal=True, block_q=4, block_k=4)))
+        val, grads = jax.value_and_grad(f)(q, k, v)
+        assert np.isfinite(float(val))
+        assert np.isfinite(np.asarray(grads).sum())
+
+
+class TestLayerIntegration:
+    def test_self_attention_layer_flash_impl_trains(self):
+        """attention_impl='flash' produces the same model math as 'xla' and
+        trains end-to-end."""
+        import numpy as np
+
+        from deeplearning4j_tpu import (
+            InputType, MultiLayerConfiguration, MultiLayerNetwork, UpdaterConfig,
+        )
+        from deeplearning4j_tpu.datasets.iterators import DataSet
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+
+        def build(impl):
+            conf = MultiLayerConfiguration(
+                layers=[SelfAttentionLayer(n_out=16, n_heads=4, causal=True,
+                                           attention_impl=impl),
+                        RnnOutputLayer(n_out=5, activation="softmax",
+                                       loss="mcxent")],
+                input_type=InputType.recurrent(8, 12),
+                updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+                seed=0,
+            )
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 12, 8)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, size=(4, 12))]
+
+        net_x, net_f = build("xla"), build("flash")
+        np.testing.assert_allclose(np.asarray(net_f.output(x)),
+                                   np.asarray(net_x.output(x)),
+                                   rtol=1e-5, atol=1e-5)
+        net_f.fit(DataSet(x, y))
+        net_x.fit(DataSet(x, y))
+        assert np.isfinite(float(net_f._last_loss))
+        np.testing.assert_allclose(float(net_f._last_loss),
+                                   float(net_x._last_loss), rtol=1e-4)
+
+
+class TestFullyMaskedRows:
+    """Round-3 review finding: fully-masked rows must output 0 (not mean-of-V)
+    and leak no gradient — matching the reference's m_safe guard."""
+
+    def test_causal_with_leading_padding(self):
+        q, k, v = _qkv(t=8, d=4)
+        mask = jnp.asarray(np.tile([0, 0, 1, 1, 1, 1, 1, 1], (2, 1)), jnp.float32)
+        ref = attention(q, k, v, causal=True, key_mask=mask)
+        out = flash_attention(q, k, v, causal=True, key_mask=mask,
+                              block_q=4, block_k=4)
+        # rows 0-1 see only masked keys under the causal triangle -> zeros
+        assert not np.asarray(out[:, :, :2, :]).any()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_with_leading_padding(self):
+        q, k, v = _qkv(t=8, d=4)
+        mask = jnp.asarray(np.tile([0, 0, 1, 1, 1, 1, 1, 1], (2, 1)), jnp.float32)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v) ** 2)
+
+        g_ref = jax.grad(loss(lambda q, k, v: attention(
+            q, k, v, causal=True, key_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, key_mask=mask, block_q=4, block_k=4)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(g_fl, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{n}")
+        # no phantom gradient through masked keys
+        assert not np.asarray(g_fl[1][:, :, :2, :]).any()
+
+    def test_all_padding_example_in_batch(self):
+        q, k, v = _qkv(t=8, d=4)
+        mask = jnp.asarray(np.stack([[0] * 8, [1] * 8]), jnp.float32)
+        ref = attention(q, k, v, key_mask=mask)
+        out = flash_attention(q, k, v, key_mask=mask, block_q=4, block_k=4)
+        assert not np.asarray(out[0]).any()  # all-padding example -> zeros
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        g_ref = jax.grad(lambda k: jnp.sum(attention(q, k, v, key_mask=mask) ** 2))(k)
+        g_fl = jax.grad(lambda k: jnp.sum(flash_attention(
+            q, k, v, key_mask=mask, block_q=4, block_k=4) ** 2))(k)
+        np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_vmem_budget_falls_back_to_xla(self):
+        import importlib
+
+        # ops/__init__ re-exports the function under the submodule's name,
+        # shadowing attribute access — resolve the module via importlib
+        mod = importlib.import_module("deeplearning4j_tpu.ops.flash_attention")
+        q, k, v = _qkv(t=16, d=8)
+        old = mod._KV_VMEM_BUDGET_BYTES
+        try:
+            mod._KV_VMEM_BUDGET_BYTES = 1  # force the guard
+            out = mod.flash_attention(q, k, v, causal=True)
+        finally:
+            mod._KV_VMEM_BUDGET_BYTES = old
+        ref = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
