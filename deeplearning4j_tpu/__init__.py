@@ -55,6 +55,7 @@ from .nn.layers.recurrent import (
 )
 from .nn.layers.normalization import BatchNormalization, LocalResponseNormalization
 from .nn.layers.attention import LayerNormLayer, SelfAttentionLayer
+from .nn.layers.moe import MixtureOfExpertsLayer
 from .nn.layers.center_loss import CenterLossOutputLayer
 from .datasets.iterators import (
     DataSet,

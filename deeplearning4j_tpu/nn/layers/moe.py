@@ -1,0 +1,139 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+No counterpart exists in the reference (2016) — like attention, this extends
+the framework per the distributed-first design requirement (the driver's
+tp/pp/dp/sp/EP sharding axes). The design is TPU-native Switch/Mesh-TF
+routing: top-k gating, capacity-bucketed dense dispatch (one-hot position
+within each expert's token buffer built from a cumulative sum — no
+data-dependent shapes, everything einsum), expert FFNs evaluated as one
+batched einsum over the expert dimension, then a weighted combine.
+
+Expert parallelism is pure GSPMD: the expert-stacked weights [E, F, H] shard
+dim 0 over an "expert" mesh axis (parallel/sharding.py ``expert_axis``), and
+XLA inserts the dispatch/combine all-to-alls from the einsum sharding — no
+hand-written collectives (SURVEY.md §5.8's design rule).
+
+Tokens routed past an expert's capacity are dropped by the combine (their MoE
+contribution is zero); the default residual connection keeps their
+representation flowing — the standard Switch-Transformer treatment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from .base import BaseLayer, Params, register_layer, maybe_dropout
+
+
+@register_layer
+@dataclass
+class MixtureOfExpertsLayer(BaseLayer):
+    """Top-k routed expert FFN block over [B, T, F] (or [B, F]) inputs."""
+
+    n_out: int = 0
+    n_experts: int = 4
+    hidden: int = 0  # expert FFN hidden width (default 4*n_out)
+    top_k: int = 1  # 1 = Switch routing, 2 = GShard-style
+    capacity_factor: float = 1.25
+    residual: bool = True  # x + moe(x); requires n_out == n_in
+    expert_activation: str = "relu"
+
+    @property
+    def is_recurrent(self) -> bool:
+        return False  # shape-agnostic over leading dims
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type) -> Params:
+        n_in = input_type.size
+        if self.residual and n_in != self.n_out:
+            raise ValueError(
+                f"residual MoE needs n_in == n_out, got {n_in} != {self.n_out}"
+            )
+        h = self.hidden or 4 * self.n_out
+        e = self.n_experts
+        kg, k1, k2 = jax.random.split(key, 3)
+        return {
+            "Wg": self._init_weight(kg, (n_in, e), n_in, e),
+            "W1": self._init_weight(k1, (e, n_in, h), n_in, h),
+            "b1": self._init_bias((e, h)),
+            "W2": self._init_weight(k2, (e, h, self.n_out), h, self.n_out),
+            "b2": self._init_bias((e, self.n_out)),
+        }
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from ..activations import get_activation  # noqa: PLC0415
+
+        lead = x.shape[:-1]
+        f = x.shape[-1]
+        tokens = x.reshape(-1, f)  # [N, F]
+        n = tokens.shape[0]
+        e = self.n_experts
+        capacity = max(1, int(self.capacity_factor * n * self.top_k / e))
+
+        # padded timesteps ([B,T] mask) must not claim expert capacity or
+        # contribute output — flatten the mask alongside the tokens
+        token_mask = None
+        if mask is not None and x.ndim == 3 and mask.ndim == 2:
+            token_mask = mask.reshape(-1).astype(jnp.int32)  # [N]
+
+        logits = tokens @ params["Wg"]  # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k dispatch: iteratively take the best expert, build its
+        # capacity-bucketed one-hot dispatch, then mask it out and repeat.
+        dispatch = jnp.zeros((n, e, capacity), x.dtype)
+        combine = jnp.zeros((n, e, capacity), x.dtype)
+        remaining = probs
+        # position of each token within its expert's buffer must count ALL
+        # tokens assigned so far across the k rounds
+        expert_fill = jnp.zeros((e,), jnp.int32)
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)  # [N]
+            gate = jnp.take_along_axis(remaining, idx[:, None], axis=-1)[:, 0]
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [N, E]
+            if token_mask is not None:
+                onehot = onehot * token_mask[:, None]  # pad tokens: no slot
+            pos = jnp.cumsum(onehot, axis=0) - 1 + expert_fill[None, :]  # [N, E]
+            expert_fill = expert_fill + onehot.sum(axis=0)
+            within = (pos < capacity) & (onehot > 0)
+            pos_onehot = jax.nn.one_hot(
+                jnp.where(within, pos, capacity), capacity + 1, dtype=x.dtype
+            )[..., :capacity]  # [N, E, C], rows past capacity all-zero
+            dispatch = dispatch + pos_onehot
+            combine = combine + pos_onehot * gate[:, None, None]
+            remaining = remaining * (1 - onehot.astype(remaining.dtype))
+
+        act = get_activation(self.expert_activation)
+        expert_in = jnp.einsum("nec,nf->ecf", dispatch, tokens)  # [E, C, F]
+        hcur = act(jnp.einsum("ecf,efh->ech", expert_in, params["W1"])
+                   + params["b1"][:, None, :])
+        expert_out = (jnp.einsum("ech,eho->eco", hcur, params["W2"])
+                      + params["b2"][:, None, :])  # [E, C, O]
+        out = jnp.einsum("nec,eco->no", combine, expert_out)  # [N, O]
+        if self.residual:
+            out = out + tokens
+        out = out.reshape(lead + (self.n_out,))
+        out = maybe_dropout(out, self.dropout, train, rng)
+        return self._activate(out), state
+
+    def load_balance_stats(self, params, x) -> dict:
+        """Routing diagnostics (fraction of tokens per expert + dropped) —
+        the host-side analog of an aux balance loss; call outside jit."""
+        tokens = jnp.asarray(x).reshape(-1, x.shape[-1])
+        probs = jax.nn.softmax(tokens @ params["Wg"], axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        frac = jnp.bincount(idx, length=self.n_experts) / tokens.shape[0]
+        cap = max(1, int(self.capacity_factor * tokens.shape[0] * self.top_k
+                         / self.n_experts))
+        dropped = jnp.maximum(
+            jnp.bincount(idx, length=self.n_experts) - cap, 0).sum()
+        return {"expert_fraction": frac, "dropped_tokens": int(dropped),
+                "capacity": cap}

@@ -286,16 +286,13 @@ def flash_attention(q, k, v, causal: bool = False,
         mask = key_mask.astype(jnp.float32)
     maskf = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, t)
 
-    qf, t_real = _pad_to(qf, 1, block_q)
-    kf, _ = _pad_to(kf, 1, block_k)
-    vf, _ = _pad_to(vf, 1, block_k)
-    maskf, _ = _pad_to(maskf, 1, block_k)  # zero padding == masked out
-    # q padding must also reach a block_k multiple for the dkv q-loop,
-    # and k padding a block_q multiple for the dq k-loop: pad to lcm
+    # one pad straight to the lcm: q must reach a block_k multiple for the
+    # dkv q-loop and k a block_q multiple for the dq k-loop; zero mask
+    # padding == masked out
     import math
 
     lcm = math.lcm(block_q, block_k)
-    qf, _ = _pad_to(qf, 1, lcm)
+    qf, t_real = _pad_to(qf, 1, lcm)
     kf, _ = _pad_to(kf, 1, lcm)
     vf, _ = _pad_to(vf, 1, lcm)
     maskf, _ = _pad_to(maskf, 1, lcm)

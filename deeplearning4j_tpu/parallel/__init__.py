@@ -21,6 +21,12 @@ from .param_server import (
     ParameterServerParallelWrapper,
 )
 from .ring_attention import all_to_all_attention, attention, ring_attention
+from .pipeline import (
+    pipeline_apply,
+    pipeline_shardings,
+    sequential_apply,
+    stack_stage_params,
+)
 from .sharding import param_shardings, shard_params
 
 __all__ = [
@@ -40,6 +46,10 @@ __all__ = [
     "ParameterServerParallelWrapper",
     "attention",
     "ring_attention",
+    "pipeline_apply",
+    "pipeline_shardings",
+    "sequential_apply",
+    "stack_stage_params",
     "all_to_all_attention",
     "param_shardings",
     "shard_params",

@@ -1,0 +1,124 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a "pipe" mesh axis.
+
+No counterpart exists in the reference (SURVEY.md §2.4: DL4J 0.7's only
+strategy is data parallelism) — this is part of the framework's
+distributed-first extension set (dp / tp / sp / ep / pp).
+
+TPU-native design (the scaling-book recipe, functional form): the pipeline is
+ONE jitted SPMD program under ``shard_map`` — each device along the pipe axis
+holds one stage's parameters (stacked homogeneous blocks, leading dim sharded
+over the axis) and a ``lax.scan`` runs the M + P - 1 schedule ticks. Stage 0
+feeds a fresh microbatch each tick; activations hop stage-to-stage with
+``ppermute`` over ICI; the last stage's outputs are gathered with a masked
+psum. Because the whole schedule is pure JAX, ``jax.grad`` differentiates
+straight through it — the backward pipeline (reverse ppermute chain) falls
+out of autodiff instead of being hand-scheduled.
+
+Homogeneous stages are the contract (identical block structure per stage —
+the production-transformer case). Bubble fraction is (P-1)/(M+P-1): use
+several microbatches per step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def pipeline_shardings(stacked_params, mesh, axis: str = "pipe"):
+    """NamedShardings placing each stage's slice on its pipe-axis device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def rule(a):
+        return NamedSharding(mesh, P(axis, *([None] * (np.ndim(a) - 1))))
+
+    return jax.tree_util.tree_map(rule, stacked_params)
+
+
+def pipeline_apply(block_fn: Callable, stacked_params, microbatches, mesh,
+                   axis: str = "pipe"):
+    """Apply P homogeneous stages as a pipeline over M microbatches.
+
+    ``block_fn(stage_params, x) -> y`` with y.shape == x.shape (homogeneous
+    contract); ``stacked_params``: leaves [P, ...] (use
+    :func:`stack_stage_params` / :func:`pipeline_shardings`);
+    ``microbatches``: [M, mb, ...]. Returns [M, mb, ...] — the composition
+    block_{P-1}(...block_0(x)) per microbatch, computed with the GPipe
+    schedule. Differentiable end-to-end.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis]
+    m = microbatches.shape[0]
+    n_stacked = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stacked != n_stages:
+        # a divisible mismatch would otherwise silently run a SUBSET of
+        # stages (each device keeps only slice [0] of its local shard)
+        raise ValueError(
+            f"{n_stacked} stacked stages but the '{axis}' mesh axis has "
+            f"{n_stages} devices; one stage per device is the contract"
+        )
+
+    def per_stage(params, xs):
+        # params: local stage slice with leading dim 1; xs: full [M, mb, ...]
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        idx = jax.lax.axis_index(axis)
+        mb_shape = xs.shape[1:]
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(recv, t):
+            # stage 0 injects microbatch t (zeros once the feed is drained);
+            # later stages consume what the previous stage sent last tick
+            feed = jnp.where(t < m, xs[jnp.clip(t, 0, m - 1)],
+                             jnp.zeros(mb_shape, xs.dtype))
+            x_in = jnp.where(idx == 0, feed, recv)
+            y = block_fn(params, x_in)
+            return jax.lax.ppermute(y, axis, perm), y
+
+        recv0 = jnp.zeros(mb_shape, xs.dtype)
+        _, ys = jax.lax.scan(tick, recv0, jnp.arange(m + n_stages - 1))
+        # microbatch j completes on the LAST stage at tick j + P - 1; a
+        # masked psum hands every stage the gathered outputs (out_specs
+        # replicate, so each device must return the same array). where (not
+        # multiply) so bubble-tick NaNs on earlier stages cannot poison the
+        # sum (NaN * 0 == NaN).
+        outs = ys[n_stages - 1 :]  # [M, mb, ...]
+        outs = jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
+    )
+    fn = shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return fn(stacked_params, microbatches)
+
+
+def sequential_apply(block_fn: Callable, stacked_params, microbatches):
+    """Reference semantics: the same composition without the pipeline —
+    for tests and single-device fallback."""
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+    def one(x):
+        for i in range(n_stages):
+            params_i = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
+            x = block_fn(params_i, x)
+        return x
+
+    return jax.vmap(one)(microbatches)

@@ -19,19 +19,38 @@ import jax
 import numpy as np
 
 
-def tree_shardings(tree, mesh, model_axis: str = "model"):
+def tree_shardings(tree, mesh, model_axis: str | None = "model",
+                   expert_axis: str | None = None):
     """NamedShardings for an arbitrary pytree by the shape rules above.
     Works for params AND optimizer state (Adam moments share their param's
-    shape, so they land on the same sharding; scalar counts replicate)."""
+    shape, so they land on the same sharding; scalar counts replicate).
+
+    ``model_axis=None`` disables the tensor-parallel rules (expert-only
+    layouts); a NAMED axis must exist on the mesh — a typo'd axis raising
+    beats silently training fully replicated.
+
+    ``expert_axis`` adds the expert-parallel rule: exactly-3-D leaves whose
+    leading dim divides the axis (MoE expert-stacked weights [E, F, H])
+    shard dim 0 over it — XLA then derives the dispatch/combine all-to-alls
+    from the routing einsums, the GSPMD form of expert parallelism. (3-D
+    exactly: 4-D conv kernels whose height happens to divide must not
+    match.)"""
     from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: PLC0415
 
-    size = mesh.shape[model_axis]
+    for ax in (model_axis, expert_axis):
+        if ax is not None and ax not in mesh.shape:
+            raise ValueError(f"axis '{ax}' not in mesh axes {tuple(mesh.shape)}")
+    size = mesh.shape[model_axis] if model_axis is not None else 1
+    esize = mesh.shape[expert_axis] if expert_axis else 1
 
     def rule(a):
         shape = np.shape(a)
-        if len(shape) >= 2 and shape[-1] % size == 0:
+        if (expert_axis and len(shape) == 3 and shape[0] % esize == 0
+                and shape[0] >= esize):
+            spec = P(expert_axis, *([None] * (len(shape) - 1)))
+        elif len(shape) >= 2 and size > 1 and shape[-1] % size == 0:
             spec = P(*([None] * (len(shape) - 1)), model_axis)
-        elif len(shape) == 1 and shape[0] % size == 0 and shape[0] >= size:
+        elif len(shape) == 1 and size > 1 and shape[0] % size == 0 and shape[0] >= size:
             spec = P(model_axis)
         else:
             spec = P()
@@ -40,20 +59,23 @@ def tree_shardings(tree, mesh, model_axis: str = "model"):
     return jax.tree_util.tree_map(rule, tree)
 
 
-def param_shardings(params, mesh, model_axis: str = "model"):
+def param_shardings(params, mesh, model_axis: str | None = "model",
+                    expert_axis: str | None = None):
     """A pytree of NamedShardings matching ``params``' structure."""
-    return tree_shardings(params, mesh, model_axis)
+    return tree_shardings(params, mesh, model_axis, expert_axis)
 
 
-def shard_params(net, mesh, model_axis: str = "model"):
+def shard_params(net, mesh, model_axis: str | None = "model",
+                 expert_axis: str | None = None):
     """device_put the net's params (and existing optimizer state) with
-    tensor-parallel shardings; returns the param sharding pytree so callers
-    can reuse it for checkpoint restore."""
+    tensor/expert-parallel shardings; returns the param sharding pytree so
+    callers can reuse it for checkpoint restore."""
     net.init()
-    shardings = param_shardings(net.params, mesh, model_axis)
+    shardings = param_shardings(net.params, mesh, model_axis, expert_axis)
     net.params = jax.device_put(net.params, shardings)
     if net.opt_state is not None:
         net.opt_state = jax.device_put(
-            net.opt_state, tree_shardings(net.opt_state, mesh, model_axis)
+            net.opt_state, tree_shardings(net.opt_state, mesh, model_axis,
+                                          expert_axis)
         )
     return shardings

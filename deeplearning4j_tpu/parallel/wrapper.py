@@ -64,25 +64,31 @@ class ParallelWrapper:
         prefetch_buffer: int = 2,
         mesh=None,
         model_axis: Optional[str] = None,
+        expert_axis: Optional[str] = None,
     ):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh(workers)
         # dp×tp: batch shards over "data", params over model_axis (GSPMD
-        # inserts the tensor-parallel collectives — parallel/sharding.py)
+        # inserts the tensor-parallel collectives — parallel/sharding.py);
+        # dp×ep: MoE expert-stacked weights shard over expert_axis
         self.model_axis = model_axis
-        if model_axis is not None and model_axis not in self.mesh.axis_names:
+        self.expert_axis = expert_axis
+        for axis, label in ((model_axis, "model_axis"), (expert_axis, "expert_axis")):
+            if axis is not None and axis not in self.mesh.axis_names:
+                raise ValueError(
+                    f"{label} '{axis}' not in mesh axes {self.mesh.axis_names}"
+                )
+        if (model_axis or expert_axis) and averaging_frequency > 1:
             raise ValueError(
-                f"model_axis '{model_axis}' not in mesh axes {self.mesh.axis_names}"
-            )
-        if model_axis is not None and averaging_frequency > 1:
-            raise ValueError(
-                "tensor parallelism (model_axis) requires sync mode "
+                "tensor/expert parallelism requires sync mode "
                 "(averaging_frequency=1); periodic replica averaging would "
                 "silently replicate the model"
             )
-        self._data_axes = tuple(n for n in self.mesh.axis_names if n != model_axis)
+        self._data_axes = tuple(n for n in self.mesh.axis_names
+                                if n not in (model_axis, expert_axis))
         self.workers = int(
-            np.prod([self.mesh.shape[n] for n in self._data_axes]) if model_axis
+            np.prod([self.mesh.shape[n] for n in self._data_axes])
+            if (model_axis or expert_axis)
             else np.prod(self.mesh.devices.shape)
         )
         self.averaging_frequency = int(averaging_frequency)
@@ -110,12 +116,13 @@ class ParallelWrapper:
         if net._train_step is None:
             net._train_step = net._build_train_step()
         rep = replicated_sharding(self.mesh)
-        if self.model_axis is not None:
+        if self.model_axis is not None or self.expert_axis is not None:
             from .sharding import shard_params  # noqa: PLC0415
 
             # shards params AND the existing opt_state (moments follow their
             # param's sharding; training state is preserved, not reset)
-            shard_params(net, self.mesh, self.model_axis)
+            shard_params(net, self.mesh, self.model_axis,
+                         expert_axis=self.expert_axis)
         else:
             net.params = global_put_tree(net.params, rep)
             net.opt_state = global_put_tree(net.opt_state, rep)

@@ -1,0 +1,139 @@
+"""Pipeline parallelism (GPipe schedule over a "pipe" mesh axis) — the pp
+axis of the driver's tp/pp/dp/sp/ep matrix. No reference counterpart."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_shardings,
+    sequential_apply,
+    stack_stage_params,
+)
+
+
+def _block(params, x):
+    """One homogeneous stage: dense + tanh (same in/out width)."""
+    return jnp.tanh(x @ params["W"] + params["b"])
+
+
+def _stage_params(n_stages, width, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+    return [
+        {"W": jax.random.normal(k, (width, width), jnp.float32) * 0.3,
+         "b": jnp.zeros((width,), jnp.float32)}
+        for k in keys
+    ]
+
+
+class TestForward:
+    def test_matches_sequential_composition(self):
+        mesh = make_mesh(8, axis_names=("pipe",))
+        stacked = stack_stage_params(_stage_params(8, 4))
+        stacked = jax.device_put(stacked, pipeline_shardings(stacked, mesh))
+        rng = np.random.default_rng(0)
+        micro = jnp.asarray(rng.normal(size=(16, 4, 4)), jnp.float32)
+
+        out = pipeline_apply(_block, stacked, micro, mesh)
+        ref = sequential_apply(_block, stacked, micro)
+        assert out.shape == micro.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_single_microbatch(self):
+        """Degenerate M=1 still flows through all P stages."""
+        mesh = make_mesh(4, axis_names=("pipe",))
+        stacked = stack_stage_params(_stage_params(4, 3, seed=1))
+        micro = jnp.asarray(np.random.default_rng(1).normal(size=(1, 2, 3)),
+                            jnp.float32)
+        out = pipeline_apply(_block, stacked, micro, mesh)
+        ref = sequential_apply(_block, stacked, micro)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestBackwardAndTraining:
+    def test_grads_match_sequential(self):
+        """Autodiff through the ppermute schedule == grads of the plain
+        composition (the backward pipeline falls out of jax.grad)."""
+        mesh = make_mesh(4, axis_names=("pipe",))
+        stacked = stack_stage_params(_stage_params(4, 4, seed=2))
+        rng = np.random.default_rng(2)
+        micro = jnp.asarray(rng.normal(size=(8, 4, 4)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(8, 4, 4)), jnp.float32)
+
+        def loss_pipe(p):
+            return jnp.mean((pipeline_apply(_block, p, micro, mesh) - tgt) ** 2)
+
+        def loss_seq(p):
+            return jnp.mean((sequential_apply(_block, p, micro) - tgt) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_pipelined_training_step_converges(self):
+        """Full jitted train step over the pipeline: loss decreases."""
+        mesh = make_mesh(8, axis_names=("pipe",))
+        stacked = stack_stage_params(_stage_params(8, 4, seed=3))
+        stacked = jax.device_put(stacked, pipeline_shardings(stacked, mesh))
+        tx = optax.adam(3e-2)
+        opt = tx.init(stacked)
+        rng = np.random.default_rng(3)
+        micro = jnp.asarray(rng.normal(size=(8, 8, 4)), jnp.float32)
+        tgt = jnp.tanh(jnp.asarray(rng.normal(size=(8, 8, 4)), jnp.float32))
+
+        @jax.jit
+        def step(params, opt):
+            def loss_fn(p):
+                return jnp.mean((pipeline_apply(_block, p, micro, mesh) - tgt) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, updates), opt, loss
+
+        losses = []
+        for _ in range(40):
+            stacked, opt, loss = step(stacked, opt)
+            losses.append(float(loss))
+        # an 8-deep tanh chain fitting random targets has a loss floor; the
+        # assertion is that the pipelined step optimizes, not a race
+        assert losses[-1] < losses[0] * 0.9, losses
+        assert losses[-1] == min(losses)
+        # stage params stayed sharded over the pipe axis through the update
+        assert stacked["W"].sharding.spec[0] == "pipe"
+
+
+def test_stage_count_mismatch_raises():
+    """A divisible mismatch would silently run a subset of stages."""
+    mesh = make_mesh(4, axis_names=("pipe",))
+    stacked = stack_stage_params(_stage_params(8, 4))
+    micro = jnp.zeros((4, 2, 4), jnp.float32)
+    with pytest.raises(ValueError, match="one stage per device"):
+        pipeline_apply(_block, stacked, micro, mesh)
+
+
+def test_bubble_nan_does_not_poison_outputs():
+    """Warm-up ticks feed zero activations; a block that divides by its
+    input norm produces NaN there — outputs must stay clean."""
+    mesh = make_mesh(4, axis_names=("pipe",))
+    stacked = stack_stage_params(_stage_params(4, 4, seed=5))
+
+    def norm_block(params, x):
+        y = x @ params["W"] + params["b"]
+        return y / jnp.linalg.norm(y, axis=-1, keepdims=True)
+
+    rng = np.random.default_rng(5)
+    micro = jnp.asarray(rng.normal(size=(6, 3, 4)), jnp.float32)
+    out = pipeline_apply(norm_block, stacked, micro, mesh)
+    ref = sequential_apply(norm_block, stacked, micro)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
