@@ -72,6 +72,9 @@ class MultiDataSet:
     labels: List[np.ndarray]
     features_masks: Optional[List[Optional[np.ndarray]]] = None
     labels_masks: Optional[List[Optional[np.ndarray]]] = None
+    # per-example provenance, shared across outputs (reference:
+    # MultiDataSet.getExampleMetaData)
+    example_metadata: Optional[List] = None
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])

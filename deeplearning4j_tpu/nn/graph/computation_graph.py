@@ -256,6 +256,7 @@ class ComputationGraph:
                 labels=[ds.labels],
                 features_masks=[ds.features_mask],
                 labels_masks=[ds.labels_mask],
+                example_metadata=getattr(ds, "example_metadata", None),
             )
         raise TypeError(f"Cannot convert {type(ds).__name__} to MultiDataSet")
 
@@ -533,7 +534,12 @@ class ComputationGraph:
                     f"{len(outs)} outputs but {len(mds.labels)} label arrays"
                 )
             for ev, n in zip(evs, names):
-                ev.eval(mds.labels[idx[n]], outs[idx[n]])
+                # record provenance when present (Prediction records; skipped
+                # for time-series outputs, which flatten to B*T rows)
+                meta = getattr(mds, "example_metadata", None)
+                if meta is not None and np.ndim(outs[idx[n]]) == 3:
+                    meta = None
+                ev.eval(mds.labels[idx[n]], outs[idx[n]], record_metadata=meta)
         return (
             evs[0]
             if len(self.conf.network_outputs) == 1

@@ -76,7 +76,7 @@ class MixtureOfExpertsLayer(BaseLayer):
         tokens = x.reshape(-1, f)  # [N, F]
         n = tokens.shape[0]
         e = self.n_experts
-        capacity = max(1, int(self.capacity_factor * n * self.top_k / e))
+        capacity = self._capacity(n)
 
         # padded timesteps ([B,T] mask) must not claim expert capacity or
         # contribute output — flatten the mask alongside the tokens
@@ -124,16 +124,25 @@ class MixtureOfExpertsLayer(BaseLayer):
         out = maybe_dropout(out, self.dropout, train, rng)
         return self._activate(out), state
 
+    def _capacity(self, n_tokens: int) -> int:
+        """One formula shared by apply() and the diagnostics."""
+        return max(1, int(self.capacity_factor * n_tokens * self.top_k
+                          / self.n_experts))
+
     def load_balance_stats(self, params, x) -> dict:
-        """Routing diagnostics (fraction of tokens per expert + dropped) —
-        the host-side analog of an aux balance loss; call outside jit."""
+        """Routing diagnostics — ALL top_k assignments counted, matching
+        what apply() actually dispatches (fractions sum to top_k); the
+        host-side analog of an aux balance loss, call outside jit."""
         tokens = jnp.asarray(x).reshape(-1, x.shape[-1])
         probs = jax.nn.softmax(tokens @ params["Wg"], axis=-1)
-        idx = jnp.argmax(probs, axis=-1)
-        frac = jnp.bincount(idx, length=self.n_experts) / tokens.shape[0]
-        cap = max(1, int(self.capacity_factor * tokens.shape[0] * self.top_k
-                         / self.n_experts))
-        dropped = jnp.maximum(
-            jnp.bincount(idx, length=self.n_experts) - cap, 0).sum()
-        return {"expert_fraction": frac, "dropped_tokens": int(dropped),
-                "capacity": cap}
+        counts = jnp.zeros((self.n_experts,), jnp.int32)
+        remaining = probs
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            counts = counts + jnp.bincount(idx, length=self.n_experts)
+            remaining = remaining * (1 - jax.nn.one_hot(idx, self.n_experts,
+                                                        dtype=remaining.dtype))
+        cap = self._capacity(tokens.shape[0])
+        dropped = jnp.maximum(counts - cap, 0).sum()
+        return {"expert_fraction": counts / tokens.shape[0],
+                "dropped_tokens": int(dropped), "capacity": cap}

@@ -83,6 +83,13 @@ def pipeline_apply(block_fn: Callable, stacked_params, microbatches, mesh,
             feed = jnp.where(t < m, xs[jnp.clip(t, 0, m - 1)],
                              jnp.zeros(mb_shape, xs.dtype))
             x_in = jnp.where(idx == 0, feed, recv)
+            # Bubble ticks (stage idx is busy only for idx <= t < m + idx)
+            # must compute on SAFE inputs, not the zero filler: reverse-mode
+            # AD multiplies the dropped outputs' zero cotangents by the
+            # block's partials, and 0 * NaN = NaN (the jnp.where trap) — a
+            # block like x/||x|| would poison gradients from the zeros.
+            valid = (t >= idx) & (t < m + idx)
+            x_in = jnp.where(valid, x_in, jnp.ones(mb_shape, xs.dtype))
             y = block_fn(params, x_in)
             return jax.lax.ppermute(y, axis, perm), y
 

@@ -58,3 +58,10 @@ def test_transfer_learning_example():
 
 def test_ui_dashboard_example():
     _mod("ui_dashboard").main(quick=True)
+
+
+def test_long_context_example():
+    loss = _mod("long_context").main(quick=True)
+    import numpy as np
+
+    assert np.isfinite(loss)

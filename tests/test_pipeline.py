@@ -137,3 +137,15 @@ def test_bubble_nan_does_not_poison_outputs():
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
+
+    # the reverse-mode where-trap: dropped bubble outputs have zero
+    # cotangents, and 0 * NaN partial = NaN unless bubble INPUTS are safe
+    g_pipe = jax.grad(lambda p: jnp.sum(
+        pipeline_apply(norm_block, p, micro, mesh) ** 2))(stacked)
+    g_seq = jax.grad(lambda p: jnp.sum(
+        sequential_apply(norm_block, p, micro) ** 2))(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

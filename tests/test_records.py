@@ -310,3 +310,35 @@ def test_metadata_survives_normalizer(tmp_path):
     batches = list(NormalizingIterator(base, norm))
     assert all(b.example_metadata is not None for b in batches)
     assert [m.index for b in batches for m in b.example_metadata] == list(range(6))
+
+
+def test_graph_evaluate_threads_metadata(tmp_path):
+    """ComputationGraph.evaluate records Prediction provenance too."""
+    from deeplearning4j_tpu import InputType, UpdaterConfig
+    from deeplearning4j_tpu.nn.conf.computation_graph import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers.dense import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    from deeplearning4j_tpu.datasets.record_iterators import RecordReaderDataSetIterator
+
+    p = tmp_path / "data.csv"
+    p.write_text("".join(f"{i/10:.1f},{(9-i)/10:.1f},{i % 2}\n" for i in range(8)))
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch=4, label_index=2, num_classes=2,
+        collect_metadata=True)
+    conf = (ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "h")
+            .set_outputs("out")
+            .updater(UpdaterConfig(updater="sgd", learning_rate=0.05))
+            .build())
+    net = ComputationGraph(conf).init()
+    ev = net.evaluate(it)
+    assert len(ev.predictions) == 8
+    assert {pr.record_metadata.index for pr in ev.predictions} == set(range(8))
+    for pr in ev.prediction_errors():
+        assert int(pr.get_record()[2]) == pr.actual_class
