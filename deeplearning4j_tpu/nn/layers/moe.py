@@ -130,9 +130,11 @@ class MixtureOfExpertsLayer(BaseLayer):
                           / self.n_experts))
 
     def load_balance_stats(self, params, x) -> dict:
-        """Routing diagnostics — ALL top_k assignments counted, matching
-        what apply() actually dispatches (fractions sum to top_k); the
-        host-side analog of an aux balance loss, call outside jit."""
+        """Routing diagnostics over UNMASKED tokens — all top_k assignments
+        counted with apply()'s capacity formula (fractions sum to top_k);
+        the host-side analog of an aux balance loss, call outside jit. For
+        padded batches pass only the real tokens (apply()'s mask path
+        excludes pad tokens from dispatch)."""
         tokens = jnp.asarray(x).reshape(-1, x.shape[-1])
         probs = jax.nn.softmax(tokens @ params["Wg"], axis=-1)
         counts = jnp.zeros((self.n_experts,), jnp.int32)

@@ -78,10 +78,10 @@ def pipeline_apply(block_fn: Callable, stacked_params, microbatches, mesh,
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(recv, t):
-            # stage 0 injects microbatch t (zeros once the feed is drained);
-            # later stages consume what the previous stage sent last tick
-            feed = jnp.where(t < m, xs[jnp.clip(t, 0, m - 1)],
-                             jnp.zeros(mb_shape, xs.dtype))
+            # stage 0 injects microbatch t; later stages consume what the
+            # previous stage sent last tick (drained-feed ticks are bubble
+            # ticks, replaced below)
+            feed = xs[jnp.clip(t, 0, m - 1)]
             x_in = jnp.where(idx == 0, feed, recv)
             # Bubble ticks (stage idx is busy only for idx <= t < m + idx)
             # must compute on SAFE inputs, not the zero filler: reverse-mode

@@ -108,7 +108,6 @@ class TestDenseAndNormParity:
     def test_layernorm_matches_torch(self):
         rng = np.random.default_rng(4)
         layer = LayerNormLayer()
-        params = layer.init_params(jax.random.PRNGKey(2), InputType.feed_forward(12))
         # non-trivial gamma/beta so the affine part is exercised
         params = {"gamma": jnp.asarray(rng.normal(size=12), jnp.float32),
                   "beta": jnp.asarray(rng.normal(size=12), jnp.float32)}
@@ -142,3 +141,93 @@ class TestLossParity:
         # most a constant factor; accept either normalization
         assert ours == pytest.approx(ref, rel=1e-5) or \
             ours == pytest.approx(ref * y.shape[1], rel=1e-5)
+
+
+class TestBatchNormParity:
+    def test_train_and_eval_match_torch(self):
+        from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+
+        rng = np.random.default_rng(7)
+        C = 5
+        layer = BatchNormalization()
+        it = InputType.convolutional(6, 7, C)
+        params = {"gamma": jnp.asarray(rng.normal(size=C) + 1, jnp.float32),
+                  "beta": jnp.asarray(rng.normal(size=C), jnp.float32)}
+        state = _f32(layer.init_state(it))
+        x = rng.normal(size=(4, 6, 7, C)).astype(np.float32)
+
+        tbn = torch.nn.BatchNorm2d(C, eps=layer.eps,
+                                   momentum=1 - layer.decay)  # decay==1-momentum
+        with torch.no_grad():
+            tbn.weight.copy_(_t(params["gamma"]))
+            tbn.bias.copy_(_t(params["beta"]))
+        tbn.train()
+        ref_train = tbn(_t(np.transpose(x, (0, 3, 1, 2)))).detach().numpy()
+        ours_train, new_state = layer.apply(params, jnp.asarray(x), state,
+                                            train=True)
+        np.testing.assert_allclose(np.asarray(ours_train),
+                                   ref_train.transpose(0, 2, 3, 1),
+                                   rtol=1e-4, atol=1e-5)
+        # running stats: torch tracks UNBIASED var in running_var while ours
+        # follows the reference's biased convention — compare the mean and
+        # the biased-corrected var
+        n = x.size // C
+        np.testing.assert_allclose(np.asarray(new_state["mean"]),
+                                   tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_state["var"]),
+            # unbias-corrected torch running var back to biased: the batch
+            # contribution was scaled by n/(n-1)
+            (tbn.running_var.numpy() - layer.decay * 1.0) * (n - 1) / n
+            + layer.decay * 1.0,
+            rtol=1e-4, atol=1e-5)
+
+        # eval mode from identical running stats
+        tbn.eval()
+        with torch.no_grad():
+            tbn.running_mean.copy_(_t(new_state["mean"]))
+            tbn.running_var.copy_(_t(new_state["var"]))
+        ref_eval = tbn(_t(np.transpose(x, (0, 3, 1, 2)))).detach().numpy()
+        ours_eval, _ = layer.apply(params, jnp.asarray(x), new_state,
+                                   train=False)
+        np.testing.assert_allclose(np.asarray(ours_eval),
+                                   ref_eval.transpose(0, 2, 3, 1),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestLSTMParity:
+    def test_graves_lstm_matches_torch_lstm(self):
+        """Zero peepholes reduce GravesLSTM to the standard LSTM; gate
+        columns [a,f,o,i] (LSTMHelpers parity) remap to torch's (i,f,g,o)."""
+        from deeplearning4j_tpu import GravesLSTM
+
+        rng = np.random.default_rng(8)
+        F, H, B, T = 6, 5, 3, 7
+        layer = GravesLSTM(n_in=F, n_out=H, activation="tanh")
+        it = InputType.recurrent(F, T)
+        params = _f32(layer.init_params(jax.random.PRNGKey(4), it))
+        params = dict(params)
+        for k in ("pF", "pI", "pO"):
+            params[k] = jnp.zeros_like(params[k])
+        x = rng.normal(size=(B, T, F)).astype(np.float32)
+        ours, _ = layer.apply(params, jnp.asarray(x), layer.init_state(it))
+
+        W = np.asarray(params["W"])    # [F, 4H], columns [a, f, o, i]
+        RW = np.asarray(params["RW"])  # [H, 4H]
+        b = np.asarray(params["b"])    # [4H]
+
+        def reorder(m):
+            # ours [a, f, o, i] -> torch (i, f, g(a), o)
+            a, f, o, i = (m[..., :H], m[..., H:2 * H],
+                          m[..., 2 * H:3 * H], m[..., 3 * H:])
+            return np.concatenate([i, f, a, o], axis=-1)
+
+        tl = torch.nn.LSTM(F, H, batch_first=True)
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(_t(reorder(W).T))
+            tl.weight_hh_l0.copy_(_t(reorder(RW).T))
+            tl.bias_ih_l0.copy_(_t(reorder(b)))
+            tl.bias_hh_l0.copy_(torch.zeros(4 * H))
+        ref, _ = tl(_t(x))
+        np.testing.assert_allclose(np.asarray(ours), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
