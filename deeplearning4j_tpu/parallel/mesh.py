@@ -87,6 +87,26 @@ def global_put_tree(tree, sharding):
     return jax.tree_util.tree_map(lambda a: global_put(a, sharding), tree)
 
 
+def global_put_local(local_arr, sharding):
+    """Assemble a global array from PER-PROCESS shards (SURVEY.md §7 hard
+    part (d): per-host input pipelines feeding one mesh batch).
+
+    Unlike :func:`global_put` (every process holds the full array — the
+    broadcast pattern), each process passes only ITS slice of the global
+    batch; jax stitches them into one global array over the sharding. This is
+    how real multi-host input pipelines feed training: every host reads only
+    its shard of the data. Single-process: plain device_put (the local shard
+    IS the global array).
+    """
+    import jax
+
+    if local_arr is None:
+        return None
+    if jax.process_count() == 1:
+        return jax.device_put(local_arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_arr)
+
+
 def replicated_sharding(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
 

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .mesh import make_mesh, replicated_sharding, data_sharding, global_put, global_put_tree
+from .mesh import (make_mesh, replicated_sharding, data_sharding, global_put,
+                   global_put_local, global_put_tree)
 
 
 def _stack_tree(tree, n: int):
@@ -65,6 +66,7 @@ class ParallelWrapper:
         mesh=None,
         model_axis: Optional[str] = None,
         expert_axis: Optional[str] = None,
+        data_is_local: bool = False,
     ):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh(workers)
@@ -91,6 +93,19 @@ class ParallelWrapper:
             if (model_axis or expert_axis)
             else np.prod(self.mesh.devices.shape)
         )
+        # data_is_local: each PROCESS feeds only its shard of the global
+        # batch (per-host input pipelines, SURVEY.md §7(d)); default is the
+        # broadcast pattern (every process holds the full batch). Sync mode
+        # only — periodic mode stacks per-replica batches globally.
+        self.data_is_local = data_is_local
+        if data_is_local and averaging_frequency > 1:
+            raise ValueError("data_is_local requires sync mode "
+                             "(averaging_frequency=1)")
+        if data_is_local and self.workers % jax.process_count() != 0:
+            raise ValueError(
+                f"data_is_local needs the {self.workers}-way data sharding to "
+                f"divide evenly over {jax.process_count()} processes"
+            )
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
@@ -140,12 +155,15 @@ class ParallelWrapper:
         """One SPMD step on a globally-sharded batch; grads psum over ICI."""
         net = self.net
         shard = self._batch_sharding()
+        put = global_put_local if self.data_is_local else global_put
         with self.timer.phase("data"):
-            x = global_put(np.asarray(global_ds.features), shard)
-            y = global_put(np.asarray(global_ds.labels), shard)
+            x = put(np.asarray(global_ds.features), shard)
+            y = put(np.asarray(global_ds.labels), shard)
             net._rng, step_key = jax.random.split(net._rng)
-            lm = global_put(getattr(global_ds, "labels_mask", None), shard)
-            fm = global_put(getattr(global_ds, "features_mask", None), shard)
+            lm_ = getattr(global_ds, "labels_mask", None)
+            fm_ = getattr(global_ds, "features_mask", None)
+            lm = None if lm_ is None else put(np.asarray(lm_), shard)
+            fm = None if fm_ is None else put(np.asarray(fm_), shard)
         with self.timer.phase("step"):
             net.params, net.opt_state, net.state, loss = net._train_step(
                 net.params, net.opt_state, net.state, x, y, step_key, lm, fm
@@ -270,10 +288,13 @@ class ParallelWrapper:
                 it.reset()
             if getattr(it, "prefetch_supported", False):
                 it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
+            group_size = self.workers
+            if self.data_is_local:
+                group_size = self.workers // jax.process_count()
             group: List[Any] = []
             for ds in it:
                 group.append(ds)
-                if len(group) < self.workers:
+                if len(group) < group_size:
                     continue
                 if sync:
                     self._fit_sync(_concat_group(group))
@@ -289,7 +310,20 @@ class ParallelWrapper:
                 import warnings  # noqa: PLC0415
 
                 partial = _concat_group(group)
-                if sync and partial.num_examples() % self.workers == 0:
+                if self.data_is_local:
+                    # A trailing partial cannot train here: each process
+                    # decides locally, and a process entering the collective
+                    # step alone (or with a different local size) hangs or
+                    # mis-assembles the global batch. Per-host pipelines must
+                    # feed the same number of equally-sized steps per host
+                    # (pad or repeat the tail on the data side).
+                    warnings.warn(
+                        "ParallelWrapper(data_is_local=True) dropped a "
+                        f"trailing partial group of {len(group)} local "
+                        "minibatch(es); size per-host epochs evenly",
+                        stacklevel=2,
+                    )
+                elif sync and partial.num_examples() % self.workers == 0:
                     if partial.num_examples() != self.workers * (
                         group[0].num_examples()
                     ) and self.iteration > len(group):

@@ -28,7 +28,8 @@ def main() -> None:
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--out", required=True)
-    ap.add_argument("--mode", choices=["sync", "periodic"], default="periodic")
+    ap.add_argument("--mode", choices=["sync", "periodic", "sync_localdata"],
+                    default="periodic")
     ap.add_argument("--local-devices", type=int, default=2)
     args = ap.parse_args()
 
@@ -93,12 +94,34 @@ def main() -> None:
     mesh = make_mesh(n_devices)
     if args.mode == "periodic":
         master = ParameterAveragingTrainingMaster(averaging_frequency=2, mesh=mesh)
+        master.execute_training(net, ListDataSetIterator(batches))
+        stats = master.get_stats().summary()
+        assert stats.get("fit", 0) > 0, f"no fit phase recorded: {stats}"
+    elif args.mode == "sync_localdata":
+        # per-host input pipeline (SURVEY §7(d)): THIS process feeds only its
+        # contiguous share of each global step's batch, in per-device-sized
+        # minibatches — the assembled global array is bit-identical to the
+        # broadcast runs' (same examples, same order)
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        pidx, pcnt = jax.process_index(), jax.process_count()
+        per_dev = batches[0].features.shape[0]  # one original batch per device
+        local = []
+        for k in range(0, len(batches), n_devices):
+            step = batches[k : k + n_devices]
+            gx = np.concatenate([b.features for b in step])
+            gy = np.concatenate([b.labels for b in step])
+            share = gx.shape[0] // pcnt
+            lo = pidx * share
+            for s in range(lo, lo + share, per_dev):
+                local.append(DataSet(gx[s : s + per_dev], gy[s : s + per_dev]))
+        wrapper = ParallelWrapper(net, mesh=mesh, data_is_local=True)
+        wrapper.fit(ListDataSetIterator(local))
     else:
         master = SyncAllReduceTrainingMaster(mesh=mesh)
-    master.execute_training(net, ListDataSetIterator(batches))
-
-    stats = master.get_stats().summary()
-    assert stats.get("fit", 0) > 0, f"no fit phase recorded: {stats}"
+        master.execute_training(net, ListDataSetIterator(batches))
+        stats = master.get_stats().summary()
+        assert stats.get("fit", 0) > 0, f"no fit phase recorded: {stats}"
 
     # Gather replicated host values (resharding collective on multi-process).
     rep = replicated_sharding(mesh)

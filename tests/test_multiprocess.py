@@ -102,3 +102,21 @@ def test_two_processes_match_single_process(mode, tmp_path):
     assert mp_meta["loss"] == pytest.approx(sp_meta["loss"], rel=1e-4)
     # training actually moved: params differ from a fresh init
     assert any(np.abs(v).sum() > 0 for v in mp_params.values())
+
+
+def test_per_host_input_pipeline_matches_broadcast(tmp_path):
+    """SURVEY §7 hard part (d): each process loads ONLY its shard of every
+    global batch (make_array_from_process_local_data) and training matches
+    the broadcast pattern bit-for-bit-close."""
+    out = str(tmp_path)
+    _run_cluster("sync_localdata", num_processes=2, out_dir=out, local_devices=2)
+    _run_cluster("sync", num_processes=2, out_dir=out, local_devices=2)
+
+    local_params, local_meta = _load(out, "sync_localdata", 2)
+    bcast_params, bcast_meta = _load(out, "sync", 2)
+    assert local_meta["process_count"] == bcast_meta["process_count"] == 2
+    assert set(local_params) == set(bcast_params)
+    for k in bcast_params:
+        np.testing.assert_allclose(
+            local_params[k], bcast_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"param {k}: per-host pipeline diverged from broadcast")
