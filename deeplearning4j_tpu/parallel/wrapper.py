@@ -101,11 +101,25 @@ class ParallelWrapper:
         if data_is_local and averaging_frequency > 1:
             raise ValueError("data_is_local requires sync mode "
                              "(averaging_frequency=1)")
-        if data_is_local and self.workers % jax.process_count() != 0:
-            raise ValueError(
-                f"data_is_local needs the {self.workers}-way data sharding to "
-                f"divide evenly over {jax.process_count()} processes"
-            )
+        if data_is_local:
+            # every process must address an equal, non-zero share of the
+            # mesh: a mesh over a device subset leaves some process with
+            # zero addressable shards (and another with extra), which
+            # mis-assembles the global batch instead of failing loudly
+            pidx = jax.process_index()
+            local_devs = sum(1 for d in self.mesh.devices.flat
+                             if d.process_index == pidx)
+            total = int(np.prod(self.mesh.devices.shape))
+            if local_devs == 0 or local_devs * jax.process_count() != total:
+                raise ValueError(
+                    f"data_is_local needs every process to address an equal "
+                    f"share of the mesh; process {pidx} addresses "
+                    f"{local_devs}/{total} devices"
+                )
+            # NOTE: per-host pipelines must feed IDENTICAL step counts on
+            # every host — a host with more full groups enters a collective
+            # the others never join and the cluster hangs (inherent to SPMD;
+            # pad or truncate per-host data to equal length).
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
@@ -314,13 +328,16 @@ class ParallelWrapper:
                     # A trailing partial cannot train here: each process
                     # decides locally, and a process entering the collective
                     # step alone (or with a different local size) hangs or
-                    # mis-assembles the global batch. Per-host pipelines must
-                    # feed the same number of equally-sized steps per host
-                    # (pad or repeat the tail on the data side).
+                    # mis-assembles the global batch. Dropping it locally is
+                    # only safe when every host drops the same way — hosts
+                    # MUST feed identical full-group counts (see the
+                    # constructor note); this warning may print on a
+                    # different host than the one that then hangs.
                     warnings.warn(
                         "ParallelWrapper(data_is_local=True) dropped a "
                         f"trailing partial group of {len(group)} local "
-                        "minibatch(es); size per-host epochs evenly",
+                        "minibatch(es); ALL hosts must feed identical step "
+                        "counts or the cluster deadlocks",
                         stacklevel=2,
                     )
                 elif sync and partial.num_examples() % self.workers == 0:

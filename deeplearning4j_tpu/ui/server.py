@@ -60,6 +60,20 @@ async function firstSession(){
   if (q.get('session')) return q.get('session');
   const s = await getJSON('/api/sessions'); return s.length ? s[s.length-1] : null;
 }
+// per-worker filter (reference: TrainModule's worker selection): keeps a
+// <select id="worker"> in sync with the session's workers; '' = all
+async function workerParam(session){
+  const sel = document.getElementById('worker');
+  if (!sel) return '';
+  const ws = await getJSON('/api/workers?session='+encodeURIComponent(session));
+  const want = ['', ...ws];
+  if (sel.options.length != want.length){
+    const cur = sel.value;
+    sel.innerHTML = want.map(w=>`<option value="${esc(w)}">${w?esc(w):'all workers'}</option>`).join('');
+    if (want.includes(cur)) sel.value = cur;
+  }
+  return sel.value ? '&worker='+encodeURIComponent(sel.value) : '';
+}
 function lineChart(svg, xs, ys, color){
   if (!xs.length) return;
   const W = +svg.getAttribute('width')-20, H = +svg.getAttribute('height'), pad=30;
@@ -130,6 +144,7 @@ _MODEL = _page("Model", """
   <option value="gradient">gradients</option>
   <option value="update">updates</option>
 </select></label>
+<label>Worker: <select id="worker"></select></label>
 </div>
 <div class="card"><h3>Mean magnitude vs iteration</h3><svg id="mm" width="800" height="220"></svg></div>
 <div class="card"><h3>Latest histogram</h3><svg id="hist" width="420" height="180"></svg></div>
@@ -138,9 +153,10 @@ _MODEL = _page("Model", """
 let session=null;
 async function refresh(){
   session = session || await firstSession(); if (!session) return;
+  const wq = await workerParam(session);
   const kind = document.getElementById('kind').value;
   const sel = document.getElementById('layer');
-  const mm = await getJSON('/api/meanmag?session='+encodeURIComponent(session));
+  const mm = await getJSON('/api/meanmag?session='+encodeURIComponent(session)+wq);
   const series = mm[kind] || {};
   const keys = Object.keys(series);
   if (sel.options.length != keys.length){
@@ -150,7 +166,7 @@ async function refresh(){
   }
   const name = sel.value || keys[0]; if (!name) return;
   lineChart(document.getElementById('mm'), mm.iterations, series[name]);
-  const h = await getJSON('/api/histograms?session='+encodeURIComponent(session));
+  const h = await getJSON('/api/histograms?session='+encodeURIComponent(session)+wq);
   const hk = h[kind+'_histograms'] || {};
   if (hk[name]) histChart(document.getElementById('hist'), hk[name].bins, hk[name].counts);
   const all = document.getElementById('allhist'); all.innerHTML='';
@@ -162,6 +178,7 @@ async function refresh(){
     histChart(document.getElementById('h_'+k.replace(/[^a-zA-Z0-9]/g,'_')), hk[k].bins, hk[k].counts, '#693');
 }
 document.getElementById('kind').addEventListener('change', refresh);
+document.getElementById('worker').addEventListener('change', refresh);
 document.getElementById('layer').addEventListener('change', refresh);
 refresh(); setInterval(refresh, 5000);
 </script>""")
@@ -362,6 +379,12 @@ class _Handler(BaseHTTPRequestHandler):
         sess = q.get("session", "")
         if path == "/api/sessions":
             out = sorted({s for st in storages for s in st.list_session_ids()})
+            return self._send(200, json.dumps(out).encode())
+        if path == "/api/workers":
+            # workers with UPDATE records only: static-only pseudo-workers
+            # (e.g. post_tsne's 'tsne') would render blank charts
+            out = sorted({r.get("worker_id", "0")
+                          for r in self._updates(sess)})
             return self._send(200, json.dumps(out).encode())
         if path == "/api/updates":
             out = self._updates(sess, q.get("worker"))

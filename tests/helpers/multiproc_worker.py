@@ -92,11 +92,9 @@ def main() -> None:
     ]
 
     mesh = make_mesh(n_devices)
+    master = None
     if args.mode == "periodic":
         master = ParameterAveragingTrainingMaster(averaging_frequency=2, mesh=mesh)
-        master.execute_training(net, ListDataSetIterator(batches))
-        stats = master.get_stats().summary()
-        assert stats.get("fit", 0) > 0, f"no fit phase recorded: {stats}"
     elif args.mode == "sync_localdata":
         # per-host input pipeline (SURVEY §7(d)): THIS process feeds only its
         # contiguous share of each global step's batch, in per-device-sized
@@ -119,6 +117,7 @@ def main() -> None:
         wrapper.fit(ListDataSetIterator(local))
     else:
         master = SyncAllReduceTrainingMaster(mesh=mesh)
+    if master is not None:
         master.execute_training(net, ListDataSetIterator(batches))
         stats = master.get_stats().summary()
         assert stats.get("fit", 0) > 0, f"no fit phase recorded: {stats}"

@@ -370,3 +370,32 @@ class TestConvActivationsAndTsne:
 
         with pytest.raises(ValueError):
             post_tsne(InMemoryStatsStorage(), "s", np.zeros((5,)))
+
+
+class TestWorkerFilter:
+    def test_workers_endpoint_and_per_worker_queries(self):
+        """Reference: TrainModule's per-worker view — /api/workers lists a
+        session's workers and the data endpoints filter by one."""
+        server = UIServer(port=0)
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            base = f"http://127.0.0.1:{server.port}"
+            for w, score in (("0", 0.5), ("1", 0.9)):
+                st.put_update({"session_id": "mw", "worker_id": w,
+                               "timestamp": float(w) + 1, "iteration": 1,
+                               "score": score,
+                               "param_mean_magnitudes": {"0_W": score}})
+            ws = json.loads(urllib.request.urlopen(
+                f"{base}/api/workers?session=mw").read())
+            assert ws == ["0", "1"]
+            mm = json.loads(urllib.request.urlopen(
+                f"{base}/api/meanmag?session=mw&worker=1").read())
+            assert mm["param"]["0_W"] == [0.9]
+            ups = json.loads(urllib.request.urlopen(
+                f"{base}/api/updates?session=mw&worker=0").read())
+            assert [u["score"] for u in ups] == [0.5]
+            html = urllib.request.urlopen(f"{base}/train/model").read().decode()
+            assert 'id="worker"' in html
+        finally:
+            server.stop()
