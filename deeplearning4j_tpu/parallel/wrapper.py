@@ -116,6 +116,14 @@ class ParallelWrapper:
                     f"share of the mesh; process {pidx} addresses "
                     f"{local_devs}/{total} devices"
                 )
+            if self.workers % jax.process_count() != 0:
+                # group_size = workers // process_count must tile the data
+                # sharding exactly (e.g. data=4 over 3 processes cannot)
+                raise ValueError(
+                    f"data_is_local needs the {self.workers}-way data "
+                    f"sharding to divide evenly over "
+                    f"{jax.process_count()} processes"
+                )
             # NOTE: per-host pipelines must feed IDENTICAL step counts on
             # every host — a host with more full groups enters a collective
             # the others never join and the cluster hangs (inherent to SPMD;

@@ -381,10 +381,11 @@ class _Handler(BaseHTTPRequestHandler):
             out = sorted({s for st in storages for s in st.list_session_ids()})
             return self._send(200, json.dumps(out).encode())
         if path == "/api/workers":
-            # workers with UPDATE records only: static-only pseudo-workers
-            # (e.g. post_tsne's 'tsne') would render blank charts
-            out = sorted({r.get("worker_id", "0")
-                          for r in self._updates(sess)})
+            # workers with UPDATE records only (static-only pseudo-workers
+            # like post_tsne's 'tsne' would render blank charts); backends
+            # answer from their keys, no record materialization
+            out = sorted({w for st in storages
+                          for w in st.list_update_worker_ids(sess)})
             return self._send(200, json.dumps(out).encode())
         if path == "/api/updates":
             out = self._updates(sess, q.get("worker"))

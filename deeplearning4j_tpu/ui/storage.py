@@ -51,6 +51,13 @@ class StatsStorage(StatsStorageRouter):
     def list_worker_ids(self, session_id: str) -> List[str]:
         raise NotImplementedError
 
+    def list_update_worker_ids(self, session_id: str) -> List[str]:
+        """Workers with UPDATE records (excludes static-only pseudo-workers);
+        default derives from get_all_updates — backends override with an
+        index scan."""
+        return sorted({r.get("worker_id", "0")
+                       for r in self.get_all_updates(session_id)})
+
     def get_static_info(self, session_id: str, worker_id: Optional[str] = None) -> List[dict]:
         raise NotImplementedError
 
@@ -102,6 +109,11 @@ class InMemoryStatsStorage(StatsStorage):
             return sorted(
                 {w for s, w in list(self._static) + list(self._updates) if s == session_id}
             )
+
+    def list_update_worker_ids(self, session_id: str) -> List[str]:
+        # O(#workers) key scan — no record materialization
+        with self._lock:
+            return sorted({w for s, w in self._updates if s == session_id})
 
     def _collect(self, store, session_id, worker_id):
         with self._lock:
@@ -226,6 +238,13 @@ class SqliteStatsStorage(StatsStorage):
 
     def get_all_updates(self, session_id, worker_id=None):
         return self._get("update", session_id, worker_id)
+
+    def list_update_worker_ids(self, session_id: str) -> List[str]:
+        with self._conn() as c:
+            return [r[0] for r in c.execute(
+                "SELECT DISTINCT worker_id FROM records "
+                "WHERE kind='update' AND session_id=? ORDER BY 1",
+                (session_id,))]
 
 
 class RemoteStatsStorageRouter(StatsStorageRouter):

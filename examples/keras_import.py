@@ -1,0 +1,93 @@
+"""Import a Keras HDF5 model, verify its predictions, and fine-tune it.
+
+Reference example: the modelimport workflow (KerasModelImport.
+importKerasModelAndWeights) — a model trained elsewhere in Keras drops into
+this framework for inference and continued training. Since this image has no
+Keras, the script writes a Keras-1.x-format HDF5 itself (the exact archive
+layout the importer reads) — substitute any real .h5 path.
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+def _make_keras_h5(path: str, rng) -> tuple:
+    import h5py
+
+    W1 = rng.normal(size=(6, 16)).astype(np.float32)
+    b1 = np.zeros(16, np.float32)
+    W2 = rng.normal(size=(16, 3)).astype(np.float32)
+    b2 = np.zeros(3, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": 16,
+                        "activation": "relu", "bias": True,
+                        "batch_input_shape": [None, 6]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "output_dim": 3,
+                        "activation": "softmax", "bias": True}},
+        ],
+    }
+    training_config = {
+        "optimizer_config": {"class_name": "Adam", "config": {"lr": 1e-3}},
+        "loss": "categorical_crossentropy",
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        f.attrs["training_config"] = json.dumps(training_config).encode()
+        g = f.create_group("model_weights")
+        g.attrs["layer_names"] = np.array([b"dense_1", b"dense_2"], dtype="S64")
+        for lname, weights in {
+            "dense_1": [("dense_1_W", W1), ("dense_1_b", b1)],
+            "dense_2": [("dense_2_W", W2), ("dense_2_b", b2)],
+        }.items():
+            lg = g.create_group(lname)
+            lg.attrs["weight_names"] = np.array(
+                [wn.encode() for wn, _ in weights], dtype="S64")
+            for wn, arr in weights:
+                lg.create_dataset(wn, data=arr)
+    return W1, b1, W2, b2
+
+
+def main(quick: bool = False) -> float:
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.modelimport.keras import (
+        import_keras_sequential_model_and_weights,
+    )
+
+    rng = np.random.default_rng(0)
+    path = os.path.join(tempfile.mkdtemp(), "keras_mlp.h5")
+    W1, b1, W2, b2 = _make_keras_h5(path, rng)
+
+    net = import_keras_sequential_model_and_weights(path)
+    print(f"imported: {[type(l).__name__ for l in net.conf.layers]}, "
+          f"updater={net.conf.updater.updater}")
+
+    # predictions must equal the source model's math exactly
+    x = rng.normal(size=(8, 6)).astype(np.float32)
+    h = np.maximum(x @ W1 + b1, 0.0)
+    z = h @ W2 + b2
+    expect = np.exp(z - z.max(-1, keepdims=True))
+    expect /= expect.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(net.output(x)), expect,
+                               rtol=1e-4, atol=1e-5)
+    print("imported predictions match the source weights")
+
+    # ...and training continues from the imported state
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(DataSet(x, y), epochs=2 if quick else 10)
+    loss = float(net._last_loss)
+    print(f"fine-tuned loss: {loss:.4f}")
+    return loss
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -65,3 +65,10 @@ def test_long_context_example():
     import numpy as np
 
     assert np.isfinite(loss)
+
+
+def test_keras_import_example():
+    loss = _mod("keras_import").main(quick=True)
+    import numpy as np
+
+    assert np.isfinite(loss)
