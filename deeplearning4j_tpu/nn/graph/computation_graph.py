@@ -45,6 +45,7 @@ class ComputationGraph:
         self._rnn_step_fn = None
         self._tbptt_step = None
         self._grad_stats_step = None
+        self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
 
@@ -73,6 +74,7 @@ class ComputationGraph:
         self._rnn_step_fn = None
         self._rnn_state = None
         self._grad_stats_step = None
+        self._multi_step_cache = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -214,6 +216,95 @@ class ComputationGraph:
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------- on-device multi-step
+    def _build_multi_step(self, num_steps: int, num_batches: int):
+        """ONE device dispatch for ``num_steps`` steps — lax.scan over batches
+        staged in HBM (each input/label stacked ``[K, B, ...]``, step i uses
+        batch ``i % K``). See MultiLayerNetwork._build_multi_step: same RNG
+        split chain as sequential ``_fit_batch``, so numerics are identical to
+        per-step dispatch while the whole loop stays on-chip."""
+        tx = self._tx
+
+        def run(params, opt_state, state, rng, xs_list, ys_list):
+            def body(carry, i):
+                params, opt, st, rng = carry
+                rng, step_key = jax.random.split(rng)
+                idx = i % num_batches
+                inputs = [
+                    jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+                    for x in xs_list
+                ]
+                labels = [
+                    jax.lax.dynamic_index_in_dim(y, idx, 0, keepdims=False)
+                    for y in ys_list
+                ]
+
+                def loss_of(p):
+                    loss, new_state, _ = self._loss(
+                        p, st, inputs, labels, step_key, True, None, None
+                    )
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+                updates, new_opt = tx.update(grads, opt, params)
+                new_params = optax.apply_updates(params, updates)
+                return (new_params, new_opt, new_state, rng), loss
+
+            (params, opt_state, state, rng), losses = jax.lax.scan(
+                body, (params, opt_state, state, rng), jnp.arange(num_steps)
+            )
+            return params, opt_state, state, rng, losses
+
+        donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def fit_on_device(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
+        """Whole training loop in ONE dispatch (TPU-native fit; see
+        MultiLayerNetwork.fit_on_device). ``features``/``labels``: lists (one
+        per network input/output) of stacked batches ``[K, B, ...]``; a single
+        array is accepted for single-input/-output graphs. Masks and TBPTT are
+        not supported on this path — use :meth:`fit`."""
+        self.init()
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError("fit_on_device does not support TBPTT; use fit()")
+        if not isinstance(features, (list, tuple)):
+            features = [features]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        xs_list = [jnp.asarray(x) for x in features]
+        ys_list = [jnp.asarray(y) for y in labels]
+        num_batches = int(xs_list[0].shape[0])
+        if num_batches == 0:
+            raise ValueError("fit_on_device needs at least one staged batch")
+        # dynamic_index_in_dim CLAMPS out-of-range indices — a K mismatch in
+        # any input/label would silently pair the wrong batches
+        for i, arr in enumerate(xs_list + ys_list):
+            if int(arr.shape[0]) != num_batches:
+                kind = "input" if i < len(xs_list) else "label"
+                raise ValueError(
+                    f"{kind} array {i % max(len(xs_list), 1)} stages "
+                    f"{int(arr.shape[0])} batches, expected {num_batches}"
+                )
+        n_steps = int(steps) if steps is not None else num_batches
+        if self._multi_step_cache is None:
+            self._multi_step_cache = {}
+        cache_key = (n_steps, num_batches)
+        fn = self._multi_step_cache.get(cache_key)
+        if fn is None:
+            fn = self._build_multi_step(n_steps, num_batches)
+            self._multi_step_cache[cache_key] = fn
+        (self.params, self.opt_state, self.state, self._rng, losses) = fn(
+            self.params, self.opt_state, self.state, self._rng, xs_list, ys_list
+        )
+        losses = np.asarray(losses)  # host fetch = the sync point
+        self.last_batch_size = int(xs_list[0].shape[1])
+        for loss in losses:
+            self.iteration += 1
+            self._last_loss = loss
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, loss)
+        return losses
 
     def fit(self, data, epochs: int = 1) -> "ComputationGraph":
         """Train (reference: ComputationGraph.fit(MultiDataSet):743).

@@ -79,6 +79,7 @@ class MultiLayerNetwork:
         self._rnn_state = None  # streaming rnnTimeStep state, one entry per layer
         self._rnn_step_fn = None
         self._grad_stats_step = None
+        self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
 
@@ -108,6 +109,7 @@ class MultiLayerNetwork:
         self._rnn_state = None
         self._rnn_step_fn = None
         self._grad_stats_step = None
+        self._multi_step_cache = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -227,6 +229,106 @@ class MultiLayerNetwork:
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------- on-device multi-step
+    def _build_multi_step(self, num_steps: int, num_batches: int,
+                          with_masks: bool = False):
+        """ONE device dispatch for ``num_steps`` optimizer steps: lax.scan of
+        the train step over batches staged in HBM (stacked ``[K, B, ...]``),
+        cycling ``i % K``.
+
+        The reference's fit loop dispatches per minibatch
+        (MultiLayerNetwork.fit:917) — on TPU that pays a host round-trip per
+        step, which over a tunnel/network-attached device costs more than the
+        step itself. Scanning keeps the whole loop on-chip; per-step RNG uses
+        the same split chain as sequential ``_fit_batch``, so results are
+        bit-identical to per-step dispatch.
+        """
+        tx = self._tx
+
+        def run(params, opt_state, state, rng, xs, ys, xmasks, ymasks):
+            def body(carry, i):
+                params, opt, st, rng = carry
+                rng, step_key = jax.random.split(rng)
+                idx = i % num_batches
+                x = jax.lax.dynamic_index_in_dim(xs, idx, 0, keepdims=False)
+                y = jax.lax.dynamic_index_in_dim(ys, idx, 0, keepdims=False)
+                fm = (
+                    jax.lax.dynamic_index_in_dim(xmasks, idx, 0, keepdims=False)
+                    if with_masks and xmasks is not None else None
+                )
+                lm = (
+                    jax.lax.dynamic_index_in_dim(ymasks, idx, 0, keepdims=False)
+                    if with_masks and ymasks is not None else None
+                )
+
+                def loss_of(p):
+                    loss, new_state, _ = self._loss(p, st, x, y, step_key, True, lm, fm)
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+                updates, new_opt = tx.update(grads, opt, params)
+                new_params = optax.apply_updates(params, updates)
+                return (new_params, new_opt, new_state, rng), loss
+
+            (params, opt_state, state, rng), losses = jax.lax.scan(
+                body, (params, opt_state, state, rng), jnp.arange(num_steps)
+            )
+            return params, opt_state, state, rng, losses
+
+        donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def fit_on_device(self, xs, ys, steps: Optional[int] = None,
+                      features_masks=None, labels_masks=None) -> np.ndarray:
+        """Run a whole training loop in ONE device dispatch (TPU-native fit).
+
+        ``xs``/``ys``: stacked batches ``[K, B, ...]`` staged in HBM; step i
+        trains on batch ``i % K``. ``steps`` defaults to K (one pass). Returns
+        the per-step losses as a host array. Gradient-stats listeners are not
+        served by this path (use :meth:`fit`); ``iteration_done`` fires per
+        step afterwards with the device-computed losses.
+        """
+        self.init()
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError("fit_on_device does not support TBPTT; use fit()")
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        num_batches = int(xs.shape[0])
+        if num_batches == 0:
+            raise ValueError("fit_on_device needs at least one staged batch")
+        # dynamic_index_in_dim CLAMPS out-of-range indices — a K mismatch
+        # would silently train features i against labels min(i, K_y-1)
+        for name, arr in (("ys", ys), ("features_masks", features_masks),
+                          ("labels_masks", labels_masks)):
+            if arr is not None and int(jnp.asarray(arr).shape[0]) != num_batches:
+                raise ValueError(
+                    f"{name} stages {int(jnp.asarray(arr).shape[0])} batches, "
+                    f"xs stages {num_batches}"
+                )
+        n_steps = int(steps) if steps is not None else num_batches
+        with_masks = features_masks is not None or labels_masks is not None
+        cache_key = (n_steps, num_batches,
+                     features_masks is not None, labels_masks is not None)
+        if getattr(self, "_multi_step_cache", None) is None:
+            self._multi_step_cache = {}
+        fn = self._multi_step_cache.get(cache_key)
+        if fn is None:
+            fn = self._build_multi_step(n_steps, num_batches, with_masks)
+            self._multi_step_cache[cache_key] = fn
+        (self.params, self.opt_state, self.state, self._rng, losses) = fn(
+            self.params, self.opt_state, self.state, self._rng, xs, ys,
+            None if features_masks is None else jnp.asarray(features_masks),
+            None if labels_masks is None else jnp.asarray(labels_masks),
+        )
+        losses = np.asarray(losses)  # host fetch = the sync point
+        self.last_batch_size = int(xs.shape[1])
+        for loss in losses:
+            self.iteration += 1
+            self._last_loss = loss
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, loss)
+        return losses
 
     def fit(self, data, epochs: int = 1) -> "MultiLayerNetwork":
         """Train (reference: MultiLayerNetwork.fit(DataSetIterator):917).

@@ -46,8 +46,11 @@ _KV_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 def _fwd_kernel(block_k: int, causal: bool, scale: float,
                 q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref):
-    """One q-tile vs all k-tiles. Refs: q [1,Bq,D]; k/v [1,T,D]; mask [1,T];
-    out o [1,Bq,D], lse [1,Bq]."""
+    """One q-tile vs all k-tiles. Refs: q [1,Bq,D]; k/v [1,T,D]; mask
+    [1,1,T]; out o [1,Bq,D], lse [1,1,Bq]. (Mask/lse ride a unit middle axis:
+    TPU lowering requires each block's last two dims to divide (8, 128) or
+    equal the array dims — a [1, T] block on a [BH, T] array violates the
+    sublane rule, a [1, 1, T] block on [BH, 1, T] does not.)"""
     q = q_ref[0].astype(jnp.float32)  # [Bq, D]
     bq, d = q.shape
     t = k_ref.shape[1]
@@ -58,7 +61,7 @@ def _fwd_kernel(block_k: int, causal: bool, scale: float,
         k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * scale  # [Bq, Bk]
-        kmask = mask_ref[0, pl.dslice(j * block_k, block_k)]  # [Bk]
+        kmask = mask_ref[0, 0, pl.dslice(j * block_k, block_k)]  # [Bk]
         s = jnp.where(kmask[None, :] > 0, s, _NEG_INF)
         if causal:
             rows = qi0 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -88,7 +91,7 @@ def _fwd_kernel(block_k: int, causal: bool, scale: float,
     # backward's exp(s - lse) = exp(-1e30) = 0 instead of exp(0) = 1.
     m_fin = jnp.where(m <= _NEG_INF / 2, 0.0, m)
     lse = jnp.where(l > 0, m_fin + jnp.log(l_safe), 0.0)
-    lse_ref[0] = lse.astype(lse_ref.dtype)
+    lse_ref[0, 0] = lse.astype(lse_ref.dtype)
 
 
 def _dq_kernel(block_k: int, causal: bool, scale: float,
@@ -97,8 +100,8 @@ def _dq_kernel(block_k: int, causal: bool, scale: float,
     """dq for one q-tile: loop over k-tiles (flash backward, dq pass)."""
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)
-    delta = delta_ref[0].astype(jnp.float32)  # rowsum(do * o)
+    lse = lse_ref[0, 0].astype(jnp.float32)
+    delta = delta_ref[0, 0].astype(jnp.float32)  # rowsum(do * o)
     bq, d = q.shape
     t = k_ref.shape[1]
     qi0 = pl.program_id(1) * bq
@@ -107,7 +110,7 @@ def _dq_kernel(block_k: int, causal: bool, scale: float,
         k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * scale
-        kmask = mask_ref[0, pl.dslice(j * block_k, block_k)]
+        kmask = mask_ref[0, 0, pl.dslice(j * block_k, block_k)]
         s = jnp.where(kmask[None, :] > 0, s, _NEG_INF)
         if causal:
             rows = qi0 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -127,20 +130,21 @@ def _dkv_kernel(block_q: int, causal: bool, scale: float,
                 q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref):
     """dk/dv for one k-tile: loop over q-tiles (flash backward, dk/dv pass).
-    Refs: k/v tile [1,Bk,D]; q/do [1,T,D]; lse/delta [1,T]; mask tile [1,Bk]."""
+    Refs: k/v tile [1,Bk,D]; q/do [1,T,D]; lse/delta [1,1,T]; mask tile
+    [1,1,Bk] (unit middle axis — see _fwd_kernel)."""
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     bk, d = k.shape
     tq = q_ref.shape[1]
     kj0 = pl.program_id(1) * bk
-    kmask = mask_ref[0]  # [Bk]
+    kmask = mask_ref[0, 0]  # [Bk]
 
     def body(i, carry):
         dk, dv = carry
         q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
         s = (q @ k.T) * scale  # [Bq, Bk]
         s = jnp.where(kmask[None, :] > 0, s, _NEG_INF)
         if causal:
@@ -187,15 +191,15 @@ def _flash_call(q, k, v, mask, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v, mask)
@@ -209,7 +213,7 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k):
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
     q, k, v, mask, out, lse = residuals
     bh, t, d = q.shape
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k, causal, scale),
         grid=(bh, t // block_q),
@@ -217,10 +221,10 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -233,10 +237,10 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
             pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, j: (b, j)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
             pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -284,7 +288,7 @@ def flash_attention(q, k, v, causal: bool = False,
         mask = jnp.ones((b, t), jnp.float32)
     else:
         mask = key_mask.astype(jnp.float32)
-    maskf = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, t)
+    maskf = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, 1, t)
 
     # one pad straight to the lcm: q must reach a block_k multiple for the
     # dkv q-loop and k a block_q multiple for the dq k-loop; zero mask
@@ -295,7 +299,7 @@ def flash_attention(q, k, v, causal: bool = False,
     qf, t_real = _pad_to(qf, 1, lcm)
     kf, _ = _pad_to(kf, 1, lcm)
     vf, _ = _pad_to(vf, 1, lcm)
-    maskf, _ = _pad_to(maskf, 1, lcm)
+    maskf, _ = _pad_to(maskf, 2, lcm)
 
     out = _flash_core(qf, kf, vf, maskf, causal, scale, block_q, block_k)
     return out[:, :t_real, :].reshape(b, h, t_real, d)

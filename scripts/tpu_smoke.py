@@ -1,0 +1,176 @@
+"""TPU hardware smoke: validate the Pallas tier on a real chip.
+
+The test suite pins the CPU backend (pallas runs in interpret mode there),
+so compiled-kernel behavior on actual TPU hardware is only observable when
+the tunnel is up. This script runs each Pallas kernel compiled on the chip
+and checks numerics against the XLA-native reference path:
+
+  - flash attention fwd + grads vs the xla attention path (causal + masks)
+  - fused LSTM cell fwd + grads vs the pure-jnp cell math
+  - fused LRN fwd + grads vs the windowed-sum XLA formula
+
+Exit 0 and a JSON summary line on success; nonzero with the failing check
+named otherwise. Run: ``python scripts/tpu_smoke.py`` (no args) with the
+tunnel attached. Takes ~2-4 min of compiles on a cold cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _close(name, a, b, atol, results, rtol=1e-2):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    err = float(np.max(np.abs(a - b) / (np.abs(b) + 1.0)))
+    ok = bool(np.allclose(a, b, atol=atol, rtol=rtol))
+    results[name] = {"ok": ok, "max_rel_err": round(err, 6)}
+    return ok
+
+
+def check_flash_attention(results) -> bool:
+    """Compared under f32 matmul precision: with the MXU's default bf16
+    multiply, flash-vs-XLA causal grads differ ~2% purely from arithmetic
+    (measured; drops to 2e-4 under float32 precision), which would mask real
+    logic bugs at these tolerances."""
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+    from deeplearning4j_tpu.parallel.ring_attention import attention as attention_xla
+
+    with jax.default_matmul_precision("float32"):
+        return _check_flash_inner(results, flash_attention, attention_xla)
+
+
+def _check_flash_inner(results, flash_attention, attention_xla) -> bool:
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 4, 256, 64
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    kmask = jnp.asarray(rng.random((B, T)) > 0.2)
+    ok = True
+    for causal in (False, True):
+        ref = attention_xla(q, k, v, causal=causal, key_mask=kmask)
+        out = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal, key_mask=kmask)
+        )(q, k, v)
+        ok &= _close(f"flash_fwd_causal={causal}", out, ref, 2e-3, results)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal, key_mask=kmask) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attention_xla(q, k, v, causal=causal, key_mask=kmask) ** 2
+            )
+
+        g1 = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip(("dq", "dk", "dv"), g1, g2):
+            ok &= _close(f"flash_{name}_causal={causal}", a, b, 5e-3, results)
+    return ok
+
+
+def check_fused_lstm(results) -> bool:
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(1)
+    B, Hd = 8, 128
+    zx = jnp.asarray(rng.normal(size=(B, 4 * Hd)), jnp.float32)
+    h = jnp.asarray(rng.normal(size=(B, Hd)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(B, Hd)), jnp.float32)
+    RW = jnp.asarray(rng.normal(size=(Hd, 4 * Hd)) * 0.1, jnp.float32)
+    pF = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, jnp.float32)
+    pI = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, jnp.float32)
+    pO = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, jnp.float32)
+
+    def ref(zx, h, c):
+        z = zx + h @ RW
+        a, f, o, i = jnp.split(z, 4, axis=1)
+        a = jnp.tanh(a)
+        f = jax.nn.sigmoid(f + c * pF)
+        i = jax.nn.sigmoid(i + c * pI)
+        c_new = f * c + i * a
+        o = jax.nn.sigmoid(o + c_new * pO)
+        return o * jnp.tanh(c_new), c_new
+
+    def fused(zx, h, c):
+        return pk.fused_lstm_cell(zx, h, c, RW, pF, pI, pO)
+
+    (h1, c1) = jax.jit(fused)(zx, h, c)
+    (h2, c2) = ref(zx, h, c)
+    ok = _close("lstm_h", h1, h2, 2e-4, results)
+    ok &= _close("lstm_c", c1, c2, 2e-4, results)
+
+    def loss_f(zx, h, c):
+        hn, cn = fused(zx, h, c)
+        return jnp.sum(hn**2) + jnp.sum(jnp.tanh(cn))
+
+    def loss_r(zx, h, c):
+        hn, cn = ref(zx, h, c)
+        return jnp.sum(hn**2) + jnp.sum(jnp.tanh(cn))
+
+    g1 = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(zx, h, c)
+    g2 = jax.grad(loss_r, argnums=(0, 1, 2))(zx, h, c)
+    for name, a, b in zip(("dzx", "dh", "dc"), g1, g2):
+        ok &= _close(f"lstm_{name}", a, b, 5e-4, results)
+    return ok
+
+
+def check_fused_lrn(results) -> bool:
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 14, 14, 64)), jnp.float32)
+    k, n, alpha, beta = 2.0, 5, 1e-4, 0.75
+
+    def ref(x):
+        half = n // 2
+        sq = x**2
+        pads = [(0, 0)] * 3 + [(half, half)]
+        padded = jnp.pad(sq, pads)
+        win = sum(
+            padded[..., i : i + x.shape[-1]] for i in range(n)
+        )
+        return x / (k + alpha * win) ** beta
+
+    y1 = jax.jit(lambda x: pk.fused_lrn(x, k=k, n=n, alpha=alpha, beta=beta))(x)
+    y2 = ref(x)
+    ok = _close("lrn_fwd", y1, y2, 2e-4, results)
+    g1 = jax.jit(
+        jax.grad(lambda x: jnp.sum(pk.fused_lrn(x, k=k, n=n, alpha=alpha, beta=beta) ** 2))
+    )(x)
+    g2 = jax.grad(lambda x: jnp.sum(ref(x) ** 2))(x)
+    ok &= _close("lrn_grad", g1, g2, 5e-4, results)
+    return ok
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    results: dict = {}
+    ok = True
+    for name, fn in (
+        ("flash_attention", check_flash_attention),
+        ("fused_lstm", check_fused_lstm),
+        ("fused_lrn", check_fused_lrn),
+    ):
+        try:
+            ok &= fn(results)
+        except Exception as e:  # noqa: BLE001 - report, keep checking the rest
+            results[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+            ok = False
+    print(json.dumps({"backend": backend, "ok": ok, "checks": results}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

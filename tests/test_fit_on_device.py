@@ -1,0 +1,194 @@
+"""fit_on_device: whole-training-loop-in-one-dispatch parity tests.
+
+The on-device loop (lax.scan of the train step over HBM-staged batches) must
+be numerically IDENTICAL to the sequential per-batch dispatch path — it uses
+the same RNG split chain as ``_fit_batch`` — so staging is a pure performance
+choice, never a semantics change. (TPU-native counterpart to the reference's
+per-minibatch fit loop, MultiLayerNetwork.fit:917 / ComputationGraph.fit:743.)
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    RnnOutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.nn.conf.computation_graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+
+def _mlp_conf(seed=7, dropout=0.0):
+    return MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu", dropout=dropout),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(5),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )
+
+
+def _batches(k, b=8, f=5, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(k, b, f)).astype(np.float32)
+    ys = np.eye(c, dtype=np.float32)[rng.integers(0, c, size=(k, b))]
+    return xs, ys
+
+
+def _tree_allclose(a, b, atol=1e-6):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=1e-5)
+
+
+@pytest.mark.parametrize("steps", [2, 5])
+def test_mln_matches_sequential(steps):
+    xs, ys = _batches(k=2)
+    seq = MultiLayerNetwork(_mlp_conf()).init()
+    seq._train_step = seq._build_train_step()
+    seq_losses = []
+    for i in range(steps):
+        seq._fit_batch(DataSet(xs[i % 2], ys[i % 2]))
+        seq_losses.append(float(seq._last_loss))
+
+    dev = MultiLayerNetwork(_mlp_conf()).init()
+    losses = dev.fit_on_device(xs, ys, steps=steps)
+
+    assert losses.shape == (steps,)
+    np.testing.assert_allclose(losses, seq_losses, atol=1e-6, rtol=1e-5)
+    _tree_allclose(dev.params, seq.params)
+    _tree_allclose(dev.opt_state, seq.opt_state)
+    assert dev.iteration == steps
+
+
+def test_mln_dropout_rng_chain_parity():
+    """Dropout draws per-step keys; the scan must reproduce the sequential
+    split chain exactly, not merely statistically."""
+    xs, ys = _batches(k=3, seed=1)
+    seq = MultiLayerNetwork(_mlp_conf(dropout=0.5)).init()
+    seq._train_step = seq._build_train_step()
+    for i in range(4):
+        seq._fit_batch(DataSet(xs[i % 3], ys[i % 3]))
+
+    dev = MultiLayerNetwork(_mlp_conf(dropout=0.5)).init()
+    dev.fit_on_device(xs, ys, steps=4)
+    _tree_allclose(dev.params, seq.params)
+
+
+def test_mln_masked_sequences():
+    rng = np.random.default_rng(3)
+    k, b, t, f, c = 2, 4, 6, 3, 2
+    xs = rng.normal(size=(k, b, t, f)).astype(np.float32)
+    ys = np.eye(c, dtype=np.float32)[rng.integers(0, c, size=(k, b, t))]
+    fmask = (rng.random((k, b, t)) > 0.3).astype(np.float32)
+    conf = lambda: MultiLayerConfiguration(  # noqa: E731
+        layers=[
+            GravesLSTM(n_out=8),
+            RnnOutputLayer(n_out=c, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(f, t),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=11,
+    )
+    seq = MultiLayerNetwork(conf()).init()
+    seq._train_step = seq._build_train_step()
+    for i in range(3):
+        seq._fit_batch(
+            DataSet(xs[i % k], ys[i % k], features_mask=fmask[i % k],
+                    labels_mask=fmask[i % k])
+        )
+
+    dev = MultiLayerNetwork(conf()).init()
+    dev.fit_on_device(xs, ys, steps=3, features_masks=fmask, labels_masks=fmask)
+    _tree_allclose(dev.params, seq.params, atol=1e-5)
+
+
+def test_mln_listener_sees_every_step():
+    xs, ys = _batches(k=1)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    seen = []
+
+    class L:
+        def iteration_done(self, model, iteration, score):
+            seen.append((iteration, float(score)))
+
+    net.set_listeners(L())
+    losses = net.fit_on_device(xs, ys, steps=3)
+    assert [i for i, _ in seen] == [1, 2, 3]
+    np.testing.assert_allclose([s for _, s in seen], losses, rtol=1e-6)
+
+
+def test_mln_tbptt_rejected():
+    conf = _mlp_conf()
+    conf.backprop_type = "tbptt"
+    net = MultiLayerNetwork(conf).init()
+    xs, ys = _batches(k=1)
+    with pytest.raises(ValueError, match="TBPTT"):
+        net.fit_on_device(xs, ys)
+
+
+def _graph_conf(seed=9):
+    return (
+        ComputationGraphConfiguration.builder()
+        .seed(seed)
+        .updater(UpdaterConfig(updater="adam", learning_rate=1e-2))
+        .add_inputs("in")
+        .add_layer("h", DenseLayer(n_out=12, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "h")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(5))
+        .build()
+    )
+
+
+def test_parallel_wrapper_sync_matches_sequential():
+    """Wrapper.fit_on_device (scan of the SPMD step, psum inside the scan)
+    equals the wrapper's per-step dispatch path on the same global batches."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    rng = np.random.default_rng(4)
+    k, b_global = 3, 16  # batch shards over the 8-device data axis
+    xs = rng.normal(size=(k, b_global, 5)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=(k, b_global))]
+
+    seq_net = MultiLayerNetwork(_mlp_conf(seed=21)).init()
+    seq = ParallelWrapper(seq_net, workers=8, averaging_frequency=1)
+    seq._setup_sync()
+    for i in range(5):
+        seq._fit_sync(DataSet(xs[i % k], ys[i % k]))
+
+    dev_net = MultiLayerNetwork(_mlp_conf(seed=21)).init()
+    dev = ParallelWrapper(dev_net, workers=8, averaging_frequency=1)
+    losses = dev.fit_on_device(xs, ys, steps=5)
+
+    assert losses.shape == (5,)
+    assert dev.iteration == 5
+    _tree_allclose(dev_net.params, seq_net.params, atol=1e-6)
+    _tree_allclose(dev_net.opt_state, seq_net.opt_state, atol=1e-6)
+
+
+def test_graph_matches_sequential():
+    xs, ys = _batches(k=2, seed=5)
+    seq = ComputationGraph(_graph_conf()).init()
+    seq._train_step = seq._build_train_step()
+    for i in range(4):
+        seq._fit_batch(seq._as_multi(DataSet(xs[i % 2], ys[i % 2])))
+
+    dev = ComputationGraph(_graph_conf()).init()
+    losses = dev.fit_on_device(xs, ys, steps=4)
+    assert losses.shape == (4,)
+    _tree_allclose(dev.params, seq.params)
+    _tree_allclose(dev.opt_state, seq.opt_state)
