@@ -4,13 +4,17 @@ Reference analog: trainedmodels/TrainedModels.java (VGG16) + the example
 configs users built with MultiLayerConfiguration/ComputationGraphConfiguration.
 """
 
+from .alexnet import alexnet_conf
+from .googlenet import googlenet_conf
 from .lenet import lenet_mnist_conf
 from .resnet import resnet_conf, resnet18_conf, resnet34_conf, resnet50_conf
 from .char_rnn import char_rnn
 from ..modelimport.trained_models import vgg16_configuration
 
 __all__ = [
+    "alexnet_conf",
     "char_rnn",
+    "googlenet_conf",
     "lenet_mnist_conf",
     "resnet_conf",
     "resnet18_conf",

@@ -1,0 +1,59 @@
+"""AlexNet (one-tower variant) — the reference era's flagship ImageNet CNN.
+
+Role parity: dl4j-examples AnimalsClassification / the model-zoo AlexNet the
+reference ecosystem shipped (reference's own layer set: conv + LRN + overlap
+max-pool + dropout-regularized dense — nn/conf/layers/LocalResponseNormalization.java
+is exactly this model's normalization). TPU-native: LRN dispatches to the
+Pallas fused kernel (ops/pallas_kernels.py) when measured faster; convs lower
+to XLA MXU convolutions in NHWC.
+"""
+
+from __future__ import annotations
+
+from ..nn.conf.inputs import InputType
+from ..nn.conf.multi_layer import MultiLayerConfiguration
+from ..nn.layers.convolution import ConvolutionLayer
+from ..nn.layers.dense import DenseLayer, OutputLayer
+from ..nn.layers.normalization import LocalResponseNormalization
+from ..nn.layers.pooling import SubsamplingLayer
+from ..nn.updaters import UpdaterConfig
+
+
+def alexnet_conf(
+    height: int = 224,
+    width: int = 224,
+    channels: int = 3,
+    n_classes: int = 1000,
+    learning_rate: float = 1e-2,
+    updater: str = "nesterovs",
+    dropout: float = 0.5,
+    dtype: str = "float32",
+    seed: int = 12345,
+) -> MultiLayerConfiguration:
+    """Krizhevsky-2012 single-tower AlexNet: 5 conv (2 LRN'd) + 3 dense."""
+    return MultiLayerConfiguration(
+        layers=[
+            ConvolutionLayer(n_out=96, kernel=(11, 11), stride=(4, 4),
+                             convolution_mode="same", activation="relu"),
+            LocalResponseNormalization(),
+            SubsamplingLayer(pooling_type="max", kernel=(3, 3), stride=(2, 2)),
+            ConvolutionLayer(n_out=256, kernel=(5, 5), stride=(1, 1),
+                             convolution_mode="same", activation="relu"),
+            LocalResponseNormalization(),
+            SubsamplingLayer(pooling_type="max", kernel=(3, 3), stride=(2, 2)),
+            ConvolutionLayer(n_out=384, kernel=(3, 3), stride=(1, 1),
+                             convolution_mode="same", activation="relu"),
+            ConvolutionLayer(n_out=384, kernel=(3, 3), stride=(1, 1),
+                             convolution_mode="same", activation="relu"),
+            ConvolutionLayer(n_out=256, kernel=(3, 3), stride=(1, 1),
+                             convolution_mode="same", activation="relu"),
+            SubsamplingLayer(pooling_type="max", kernel=(3, 3), stride=(2, 2)),
+            DenseLayer(n_out=4096, activation="relu", dropout=dropout),
+            DenseLayer(n_out=4096, activation="relu", dropout=dropout),
+            OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.convolutional(height, width, channels),
+        updater=UpdaterConfig(updater=updater, learning_rate=learning_rate),
+        dtype=dtype,
+        seed=seed,
+    )

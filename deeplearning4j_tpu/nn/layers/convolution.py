@@ -33,7 +33,13 @@ def _pair(v) -> Tuple[int, int]:
 
 def conv_output_size(size: int, k: int, s: int, p: int, mode: str, dilation: int = 1) -> int:
     """Output spatial size per the reference's ConvolutionMode rules
-    (ConvolutionUtils.getOutputSize; Same at ConvolutionLayer.java:135-141)."""
+    (ConvolutionUtils.getOutputSize; Same at ConvolutionLayer.java:135-141).
+
+    Raises when the output would be empty (reference parity:
+    ConvolutionUtils.getOutputSize throws on invalid input/kernel combos) —
+    a silent 0-size dim produces an empty tensor downstream and a network
+    whose loss is frozen at uniform, with no error anywhere.
+    """
     k_eff = k + (k - 1) * (dilation - 1)
     if mode == "same":
         if p:
@@ -42,15 +48,22 @@ def conv_output_size(size: int, k: int, s: int, p: int, mode: str, dilation: int
                 "ConvolutionMode=same ignores explicit padding; set padding=0 "
                 f"(got padding={p})"
             )
-        return -(-size // s)  # ceil(size / stride)
-    if mode == "strict":
+        out = -(-size // s)  # ceil(size / stride)
+    elif mode == "strict":
         if (size - k_eff + 2 * p) % s != 0:
             raise ValueError(
                 f"ConvolutionMode=strict: (in={size} - k={k_eff} + 2*p={p}) not divisible by stride {s}"
             )
-        return (size - k_eff + 2 * p) // s + 1
-    # truncate: floor
-    return (size - k_eff + 2 * p) // s + 1
+        out = (size - k_eff + 2 * p) // s + 1
+    else:  # truncate: floor
+        out = (size - k_eff + 2 * p) // s + 1
+    if out < 1:
+        raise ValueError(
+            f"Convolution/pooling output size is {out} (input={size}, "
+            f"kernel={k}, stride={s}, padding={p}, dilation={dilation}, "
+            f"mode={mode}): input too small for this layer stack"
+        )
+    return out
 
 
 def _same_pads(size: int, k: int, s: int, dilation: int = 1) -> Tuple[int, int]:

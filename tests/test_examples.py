@@ -45,6 +45,11 @@ def test_parallel_training_example():
     assert acc > 0.5
 
 
+def test_on_device_training_example():
+    acc = _mod("on_device_training").main(quick=True)
+    assert acc > 0.5
+
+
 def test_early_stopping_example():
     result = _mod("early_stopping").main(quick=True)
     assert result.best_model is not None

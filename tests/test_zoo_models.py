@@ -1,0 +1,86 @@
+"""AlexNet + GoogLeNet zoo configs: structure, JSON round-trip, and tiny
+end-to-end training (model-zoo role parity — see models/alexnet.py,
+models/googlenet.py docstrings)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+)
+from deeplearning4j_tpu.datasets.iterators import MultiDataSet
+from deeplearning4j_tpu.models import alexnet_conf, googlenet_conf
+
+
+class TestAlexNet:
+    def test_structure_and_json(self):
+        conf = alexnet_conf()
+        # 5 convs, 2 LRNs, 3 pools, 3 dense/output
+        kinds = [type(l).__name__ for l in conf.layers]
+        assert kinds.count("ConvolutionLayer") == 5
+        assert kinds.count("LocalResponseNormalization") == 2
+        assert kinds.count("SubsamplingLayer") == 3
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.to_dict() == conf.to_dict()
+
+    def test_too_small_input_raises(self):
+        """32x32 collapses to a 0-size spatial dim at the last pool; the
+        framework must refuse loudly (a silent empty tensor trains a dead
+        network whose loss freezes at ln(n_classes) — regression)."""
+        import pytest
+
+        with pytest.raises(ValueError, match="output size"):
+            alexnet_conf(height=32, width=32, n_classes=4).layer_input_types()
+
+    def test_tiny_trains(self, rng):
+        conf = alexnet_conf(height=64, width=64, n_classes=4, dropout=0.0,
+                            updater="adam", learning_rate=1e-3)
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(8, 64, 64, 3))
+        y = np.eye(4)[rng.integers(0, 4, size=8)]
+        first = net.loss_fn(net.params, x, y, train=False)
+        net.fit((x, y), epochs=8)
+        assert np.isfinite(net.score())
+        assert net.score() < float(first)
+        out = net.output(x)
+        assert out.shape == (8, 4)
+
+
+class TestGoogLeNet:
+    def test_structure_and_json(self):
+        conf = googlenet_conf()
+        # 9 inception modules, each a 4-way MergeVertex concat
+        merges = [n for n, v in conf.vertices.items()
+                  if type(v).__name__ == "MergeVertex"]
+        assert len(merges) == 9
+        assert all(len(conf.vertex_inputs[m]) == 4 for m in merges)
+        out_t = conf.output_types()[0]
+        assert out_t.size == 1000
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.to_dict() == conf.to_dict()
+
+    def test_aux_heads_multi_output(self):
+        conf = googlenet_conf(n_classes=10, aux_heads=True)
+        assert conf.network_outputs == ["out", "aux1", "aux2"]
+
+    def test_tiny_trains_with_aux(self, rng):
+        """GoogLeNet with aux heads: multi-output losses sum and the graph
+        trains end to end."""
+        # 112x112 is the smallest canonical-ish size where the aux heads'
+        # avgpool(5,stride 3) still sees >=5x5 at stage 4 (the output-size
+        # validator rejects smaller inputs loudly)
+        conf = googlenet_conf(height=112, width=112, n_classes=4, dropout=0.0,
+                              aux_heads=True, updater="adam",
+                              learning_rate=1e-3)
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(4, 112, 112, 3))
+        y = np.eye(4)[rng.integers(0, 4, size=4)]
+        labels = [y, y, y]  # main + two aux heads share targets
+        first = net.loss_fn(net.params, [x], labels, train=False)
+        net.fit(MultiDataSet(features=[x], labels=labels), epochs=6)
+        assert np.isfinite(net.score())
+        assert net.score() < float(first)
+        outs = net.output(x)
+        assert len(outs) == 3 and outs[0].shape == (4, 4)
