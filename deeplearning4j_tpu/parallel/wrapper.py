@@ -198,19 +198,30 @@ class ParallelWrapper:
 
     def fit_on_device(self, xs, ys, steps: Optional[int] = None,
                       features_masks=None, labels_masks=None):
-        """Sync-mode training loop in ONE dispatch: K global batches staged
-        sharded over the data axes (stacked ``[K, B_global, ...]``; batch dim
-        is axis 1), then lax.scan of the SPMD train step — gradient psums ride
-        ICI *inside* the scan, with zero host round-trips between steps.
+        """Whole training loop in ONE dispatch, in either wrapper mode.
 
-        Numerics match sequential :meth:`fit` exactly (same RNG chain — see
-        MultiLayerNetwork.fit_on_device). Multi-process: every process calls
-        this with the same K and steps; under ``data_is_local`` each passes
-        only its per-process share of each global batch.
+        Sync mode (``averaging_frequency=1``): ``xs``/``ys`` are K global
+        batches ``[K, B_global, ...]`` staged sharded over the data axes
+        (batch dim is axis 1); lax.scan of the SPMD train step — gradient
+        psums ride ICI *inside* the scan, with zero host round-trips between
+        steps.
+
+        Periodic mode (``averaging_frequency=F > 1``): ``xs``/``ys`` are K
+        replica-stacked groups ``[K, workers, batch, ...]`` (the same shape
+        each sequential ``_fit_periodic`` step consumes); the scan runs every
+        replica's independent step per tick and folds the
+        averageAndPropagate mean/broadcast in via ``lax.cond`` on the same
+        ``iteration % F`` schedule — Spark-parity parameter averaging with
+        the host out of the loop entirely.
+
+        Both paths match sequential :meth:`fit` numerics exactly (same RNG
+        chains). Multi-process: every process calls this with the same K and
+        steps; under ``data_is_local`` each passes only its per-process share
+        of each global batch.
         """
         if self.averaging_frequency > 1:
-            raise ValueError("fit_on_device supports sync mode only "
-                             "(averaging_frequency=1)")
+            return self._fit_on_device_periodic(xs, ys, steps,
+                                                features_masks, labels_masks)
         if not self._sync_ready:
             self._setup_sync()
         from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
@@ -233,6 +244,131 @@ class ParallelWrapper:
             if getattr(net, "_phase_timer", None) is self.timer:
                 net._phase_timer = None
         self.iteration += len(losses)
+        return losses
+
+    def _build_periodic_multi_step(self, num_steps: int, num_groups: int,
+                                   start_iter: int, with_masks: bool):
+        """lax.scan over the vmapped per-replica step with the averaging
+        fold-in: tick i runs every replica's independent step, then
+        ``lax.cond((start_iter + i + 1) % F == 0)`` applies the
+        averageAndPropagate mean/broadcast — the exact schedule sequential
+        ``_fit_periodic`` follows, so numerics match per-step dispatch."""
+        one_step, average = self._one_step, self._avg_pure
+        n, F = self.workers, self.averaging_frequency
+
+        def run(replica, rng, xs, ys, xmasks, ymasks):
+            def body(carry, i):
+                (params, opt, state), rng = carry
+                rng, k = jax.random.split(rng)
+                keys = jax.random.split(k, n)
+                idx = i % num_groups
+                x = jax.lax.dynamic_index_in_dim(xs, idx, 0, keepdims=False)
+                y = jax.lax.dynamic_index_in_dim(ys, idx, 0, keepdims=False)
+                fm = (jax.lax.dynamic_index_in_dim(xmasks, idx, 0, keepdims=False)
+                      if with_masks and xmasks is not None else None)
+                lm = (jax.lax.dynamic_index_in_dim(ymasks, idx, 0, keepdims=False)
+                      if with_masks and ymasks is not None else None)
+                params, opt, state, losses = jax.vmap(one_step)(
+                    params, opt, state, x, y, keys, lm, fm
+                )
+                params, opt, state = jax.lax.cond(
+                    (start_iter + i + 1) % F == 0,
+                    lambda t: average(*t),
+                    lambda t: t,
+                    (params, opt, state),
+                )
+                return ((params, opt, state), rng), jnp.mean(losses)
+
+            (replica, rng), losses = jax.lax.scan(
+                body, (replica, rng), jnp.arange(num_steps)
+            )
+            return replica, rng, losses
+
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _fit_on_device_periodic(self, xs, ys, steps, features_masks, labels_masks):
+        if self._replica is None:
+            self._setup_periodic()
+        net = self.net
+        xs = np.asarray(xs)
+        ys = np.asarray(ys)
+        num_groups = int(xs.shape[0])
+        if num_groups == 0:
+            raise ValueError("fit_on_device needs at least one staged group")
+        if int(xs.shape[1]) != self.workers:
+            raise ValueError(
+                f"periodic fit_on_device groups must stack one batch per "
+                f"replica: got axis-1 size {int(xs.shape[1])}, "
+                f"workers={self.workers}"
+            )
+        for name, arr in (("ys", ys), ("features_masks", features_masks),
+                          ("labels_masks", labels_masks)):
+            if arr is not None and int(np.asarray(arr).shape[0]) != num_groups:
+                raise ValueError(
+                    f"{name} stages {int(np.asarray(arr).shape[0])} groups, "
+                    f"xs stages {num_groups}"
+                )
+        n_steps = int(steps) if steps is not None else num_groups
+        if n_steps <= 0:  # match the sync path: no-op, no dispatch
+            return np.zeros((0,), np.float32)
+        with_masks = features_masks is not None or labels_masks is not None
+        # the averaging schedule is phase-dependent: bake the entry
+        # iteration's offset into the compiled program (and its cache key)
+        phase = self.iteration % self.averaging_frequency
+        if getattr(self, "_periodic_multi_cache", None) is None:
+            self._periodic_multi_cache = {}
+        cache_key = (n_steps, num_groups, phase,
+                     features_masks is not None, labels_masks is not None)
+        fn = self._periodic_multi_cache.get(cache_key)
+        if fn is None:
+            fn = self._build_periodic_multi_step(n_steps, num_groups, phase,
+                                                 with_masks)
+            self._periodic_multi_cache[cache_key] = fn
+        shard0 = data_sharding(self.mesh)
+        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
+
+        # groups [K, workers, batch, ...]: replica axis is 1
+        group_shard = NamedSharding(self.mesh, PartitionSpec(None, *shard0.spec))
+        try:
+            with self.timer.phase("data"):
+                xs = global_put(xs, group_shard)
+                ys = global_put(ys, group_shard)
+                fm = (None if features_masks is None
+                      else global_put(np.asarray(features_masks), group_shard))
+                lm = (None if labels_masks is None
+                      else global_put(np.asarray(labels_masks), group_shard))
+            with self.timer.phase("step"):
+                # the scan body splits the carried rng exactly as sequential
+                # _fit_periodic splits net._rng each step — seed the carry
+                # with net._rng itself and write back the final carry so a
+                # later sequential step continues the same chain
+                self._replica, net._rng, losses = fn(
+                    self._replica, net._rng, xs, ys, fm, lm
+                )
+                losses = np.asarray(losses)  # host fetch = sync
+        finally:
+            if getattr(net, "_phase_timer", None) is self.timer:
+                net._phase_timer = None
+        self.iteration += n_steps
+        base_iter = net.iteration
+        net.iteration += n_steps
+        # score reporting parity with sequential _fit_periodic:
+        # report_score_after_averaging pins the score to the LAST averaging
+        # boundary in the run (if any); otherwise every step reports
+        F = self.averaging_frequency
+        avg_steps = [j for j in range(n_steps) if (phase + j + 1) % F == 0]
+        if self.report_score_after_averaging:
+            if avg_steps:
+                net._last_loss = losses[avg_steps[-1]]
+        else:
+            net._last_loss = losses[-1]
+        for j, loss in enumerate(losses):
+            for lst in net.listeners:
+                lst.iteration_done(net, base_iter + j + 1, loss)
+        # propagate trained weights into the wrapped net, exactly as fit()
+        # does at the end of its epochs (net.output/save must see them)
+        self._finalize_periodic()
         return losses
 
     # --------------------------------------------------------- periodic mode
@@ -265,6 +401,7 @@ class ParallelWrapper:
 
         # vmap over the replica axis: every replica steps independently in one
         # XLA program; sharding over "data" keeps each on its own device.
+        self._one_step = one_step  # pure, un-jitted: reused by the scanned loop
         self._vstep = jax.jit(jax.vmap(one_step))
 
         avg_upd = self.average_updaters
@@ -276,7 +413,9 @@ class ParallelWrapper:
             s = _stack_tree(_mean_tree(state), n)
             return p, o, s
 
+        self._avg_pure = average  # pure, un-jitted: reused by the scanned loop
         self._avg_fn = jax.jit(average)
+        self._periodic_multi_cache = None  # closures above changed
 
     def _fit_periodic(self, stacked_ds) -> None:
         """stacked_ds features/labels: [workers, batch, ...] — one independent
