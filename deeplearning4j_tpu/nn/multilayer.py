@@ -59,6 +59,18 @@ def _compute_cast(conf_dtype: str, params, x):
     return params, _cast_input(conf_dtype, params, x)
 
 
+def _check_staged_counts(num_batches: int, named_arrays) -> None:
+    """Shared fit_on_device guard: dynamic_index_in_dim CLAMPS out-of-range
+    indices, so a staged-batch-count mismatch would silently train features i
+    against labels min(i, K-1) — refuse loudly instead."""
+    for name, arr in named_arrays:
+        if arr is not None and int(jnp.asarray(arr).shape[0]) != num_batches:
+            raise ValueError(
+                f"{name} stages {int(jnp.asarray(arr).shape[0])} batches, "
+                f"expected {num_batches}"
+            )
+
+
 class MultiLayerNetwork:
     """Sequential network over a :class:`MultiLayerConfiguration`."""
 
@@ -297,15 +309,9 @@ class MultiLayerNetwork:
         num_batches = int(xs.shape[0])
         if num_batches == 0:
             raise ValueError("fit_on_device needs at least one staged batch")
-        # dynamic_index_in_dim CLAMPS out-of-range indices — a K mismatch
-        # would silently train features i against labels min(i, K_y-1)
-        for name, arr in (("ys", ys), ("features_masks", features_masks),
-                          ("labels_masks", labels_masks)):
-            if arr is not None and int(jnp.asarray(arr).shape[0]) != num_batches:
-                raise ValueError(
-                    f"{name} stages {int(jnp.asarray(arr).shape[0])} batches, "
-                    f"xs stages {num_batches}"
-                )
+        _check_staged_counts(num_batches, (("ys", ys),
+                                           ("features_masks", features_masks),
+                                           ("labels_masks", labels_masks)))
         n_steps = int(steps) if steps is not None else num_batches
         with_masks = features_masks is not None or labels_masks is not None
         cache_key = (n_steps, num_batches,

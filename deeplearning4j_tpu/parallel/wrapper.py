@@ -247,7 +247,7 @@ class ParallelWrapper:
         return losses
 
     def _build_periodic_multi_step(self, num_steps: int, num_groups: int,
-                                   start_iter: int, with_masks: bool):
+                                   start_iter: int):
         """lax.scan over the vmapped per-replica step with the averaging
         fold-in: tick i runs every replica's independent step, then
         ``lax.cond((start_iter + i + 1) % F == 0)`` applies the
@@ -265,9 +265,9 @@ class ParallelWrapper:
                 x = jax.lax.dynamic_index_in_dim(xs, idx, 0, keepdims=False)
                 y = jax.lax.dynamic_index_in_dim(ys, idx, 0, keepdims=False)
                 fm = (jax.lax.dynamic_index_in_dim(xmasks, idx, 0, keepdims=False)
-                      if with_masks and xmasks is not None else None)
+                      if xmasks is not None else None)
                 lm = (jax.lax.dynamic_index_in_dim(ymasks, idx, 0, keepdims=False)
-                      if with_masks and ymasks is not None else None)
+                      if ymasks is not None else None)
                 params, opt, state, losses = jax.vmap(one_step)(
                     params, opt, state, x, y, keys, lm, fm
                 )
@@ -302,17 +302,14 @@ class ParallelWrapper:
                 f"replica: got axis-1 size {int(xs.shape[1])}, "
                 f"workers={self.workers}"
             )
-        for name, arr in (("ys", ys), ("features_masks", features_masks),
-                          ("labels_masks", labels_masks)):
-            if arr is not None and int(np.asarray(arr).shape[0]) != num_groups:
-                raise ValueError(
-                    f"{name} stages {int(np.asarray(arr).shape[0])} groups, "
-                    f"xs stages {num_groups}"
-                )
+        from ..nn.multilayer import _check_staged_counts  # noqa: PLC0415
+
+        _check_staged_counts(num_groups, (("ys", ys),
+                                          ("features_masks", features_masks),
+                                          ("labels_masks", labels_masks)))
         n_steps = int(steps) if steps is not None else num_groups
         if n_steps <= 0:  # match the sync path: no-op, no dispatch
             return np.zeros((0,), np.float32)
-        with_masks = features_masks is not None or labels_masks is not None
         # the averaging schedule is phase-dependent: bake the entry
         # iteration's offset into the compiled program (and its cache key)
         phase = self.iteration % self.averaging_frequency
@@ -322,8 +319,7 @@ class ParallelWrapper:
                      features_masks is not None, labels_masks is not None)
         fn = self._periodic_multi_cache.get(cache_key)
         if fn is None:
-            fn = self._build_periodic_multi_step(n_steps, num_groups, phase,
-                                                 with_masks)
+            fn = self._build_periodic_multi_step(n_steps, num_groups, phase)
             self._periodic_multi_cache[cache_key] = fn
         shard0 = data_sharding(self.mesh)
         from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
@@ -350,22 +346,20 @@ class ParallelWrapper:
         finally:
             if getattr(net, "_phase_timer", None) is self.timer:
                 net._phase_timer = None
-        self.iteration += n_steps
-        base_iter = net.iteration
-        net.iteration += n_steps
-        # score reporting parity with sequential _fit_periodic:
-        # report_score_after_averaging pins the score to the LAST averaging
-        # boundary in the run (if any); otherwise every step reports
+        # replay the sequential per-step bookkeeping so listeners observe
+        # iteration/score in lockstep (reference IterationListener contract):
+        # score updates at averaging boundaries when
+        # report_score_after_averaging, else every step — then the callback
         F = self.averaging_frequency
-        avg_steps = [j for j in range(n_steps) if (phase + j + 1) % F == 0]
-        if self.report_score_after_averaging:
-            if avg_steps:
-                net._last_loss = losses[avg_steps[-1]]
-        else:
-            net._last_loss = losses[-1]
         for j, loss in enumerate(losses):
+            self.iteration += 1
+            net.iteration += 1
+            at_boundary = (phase + j + 1) % F == 0
+            if (at_boundary and self.report_score_after_averaging) or (
+                    not self.report_score_after_averaging):
+                net._last_loss = loss
             for lst in net.listeners:
-                lst.iteration_done(net, base_iter + j + 1, loss)
+                lst.iteration_done(net, net.iteration, loss)
         # propagate trained weights into the wrapped net, exactly as fit()
         # does at the end of its epochs (net.output/save must see them)
         self._finalize_periodic()

@@ -119,6 +119,43 @@ class TestDenseAndNormParity:
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)
 
 
+class TestLRNParity:
+    def test_lrn_matches_torch(self):
+        """Cross-channel LRN vs torch.nn.LocalResponseNorm. The conventions
+        differ: the reference (and this repo) uses denominator
+        (k + alpha * sum)^beta while torch uses (k + alpha/size * sum)^beta —
+        so torch gets alpha*n. Torch normalizes over the channel dim of
+        [N,C,H,W]; ours is NHWC trailing-axis."""
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LocalResponseNormalization,
+        )
+
+        rng = np.random.default_rng(11)
+        k, n, alpha, beta = 2.0, 5, 1e-3, 0.75
+        layer = LocalResponseNormalization(k=k, n=n, alpha=alpha, beta=beta)
+        x = rng.normal(size=(2, 6, 6, 16)).astype(np.float32)
+        ours, _ = layer.apply({}, jnp.asarray(x), {})
+        t_lrn = torch.nn.LocalResponseNorm(size=n, alpha=alpha * n, beta=beta, k=k)
+        ref = t_lrn(_t(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-5, atol=1e-6)
+
+    def test_embedding_matches_torch(self):
+        from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+
+        rng = np.random.default_rng(12)
+        layer = EmbeddingLayer(n_in=20, n_out=8, activation="identity",
+                               has_bias=False)
+        params = _f32(layer.init_params(jax.random.PRNGKey(2),
+                                        InputType.feed_forward(20)))
+        idx = rng.integers(0, 20, size=(7, 1))
+        ours, _ = layer.apply(params, jnp.asarray(idx), {})
+        emb = torch.nn.Embedding(20, 8)
+        with torch.no_grad():
+            emb.weight.copy_(_t(params["W"]))
+        ref = emb(torch.from_numpy(idx[:, 0])).detach().numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-6, atol=1e-7)
+
+
 class TestLossParity:
     def test_mcxent_matches_torch_cross_entropy(self):
         rng = np.random.default_rng(5)
