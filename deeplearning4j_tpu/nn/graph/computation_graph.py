@@ -306,16 +306,29 @@ class ComputationGraph:
                 lst.iteration_done(self, self.iteration, loss)
         return losses
 
-    def fit(self, data, epochs: int = 1) -> "ComputationGraph":
+    def fit(self, data, epochs: int = 1,
+            stage_on_device: int = 0) -> "ComputationGraph":
         """Train (reference: ComputationGraph.fit(MultiDataSet):743).
 
         ``data``: MultiDataSet, DataSet, (x, y) tuple, or an iterator of any.
+
+        ``stage_on_device=K``: buffer K uniform mask-free batches and run
+        them as ONE scanned dispatch (see MultiLayerNetwork.fit — same
+        bit-identical contract; masked/TBPTT/grad-stats batches train
+        per-batch).
         """
         from ...datasets.iterators import AsyncDataSetIterator, as_iterator
 
         self.init()
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        stage = int(stage_on_device)
+        if stage > 1 and (
+            self.conf.backprop_type == "tbptt"
+            or any(not getattr(lst, "supports_staged", False)
+                   for lst in self.listeners)
+        ):
+            stage = 0  # opt-in contract: see IterationListener.supports_staged
         for _ in range(epochs):
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_start"):
@@ -325,13 +338,70 @@ class ComputationGraph:
                 it.reset()
             if getattr(it, "prefetch_supported", False):
                 it = AsyncDataSetIterator(it)
-            for ds in it:
-                self._fit_batch(self._as_multi(ds))
+            if stage > 1:
+                self._fit_epoch_staged(it, stage)
+            else:
+                for ds in it:
+                    self._fit_batch(self._as_multi(ds))
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self, self.epoch)
         return self
+
+    @staticmethod
+    def _stage_signature(mds):
+        """Uniform-group key: staging requires identical shapes and NO masks
+        (the graph's fit_on_device path doesn't thread masks)."""
+        has_masks = (
+            (mds.features_masks is not None
+             and any(m is not None for m in mds.features_masks))
+            or (mds.labels_masks is not None
+                and any(m is not None for m in mds.labels_masks))
+        )
+        return (
+            tuple(np.shape(f) for f in mds.features),
+            tuple(np.shape(l) for l in mds.labels),
+            has_masks,
+        )
+
+    def _fit_epoch_staged(self, it, stage: int) -> None:
+        """See MultiLayerNetwork._fit_epoch_staged: full uniform groups run
+        as one scanned dispatch; stragglers/masked/shape-breaking batches
+        train per-batch in order."""
+        group: list = []
+        sig = None
+
+        def flush_per_batch():
+            nonlocal group, sig
+            for mds in group:
+                self._fit_batch(mds)
+            group, sig = [], None
+
+        def flush_staged():
+            nonlocal group, sig
+            xs = [np.stack([np.asarray(m.features[i]) for m in group])
+                  for i in range(len(group[0].features))]
+            ys = [np.stack([np.asarray(m.labels[i]) for m in group])
+                  for i in range(len(group[0].labels))]
+            self.fit_on_device(xs, ys, steps=stage)
+            group, sig = [], None
+
+        for ds in it:
+            mds = self._as_multi(ds)
+            s = self._stage_signature(mds)
+            if s[2]:  # masked: never stageable — train immediately, in order
+                flush_per_batch()
+                self._fit_batch(mds)
+                continue
+            if group and s != sig:
+                flush_per_batch()
+            sig = s
+            group.append(mds)
+            if len(group) == stage:
+                flush_staged()
+        if group:
+            flush_per_batch()
 
     @staticmethod
     def _as_multi(ds):

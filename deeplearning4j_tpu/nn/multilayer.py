@@ -336,17 +336,36 @@ class MultiLayerNetwork:
                 lst.iteration_done(self, self.iteration, loss)
         return losses
 
-    def fit(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+    def fit(self, data, epochs: int = 1,
+            stage_on_device: int = 0) -> "MultiLayerNetwork":
         """Train (reference: MultiLayerNetwork.fit(DataSetIterator):917).
 
         ``data``: (x, y) tuple, a DataSet, or a DataSetIterator. Iterators are
         auto-wrapped in async prefetch (reference :920-924) unless already async.
+
+        ``stage_on_device=K`` (TPU fast path): buffer K equal-shape batches,
+        stack them in HBM, and run all K optimizer steps as ONE dispatch via
+        :meth:`fit_on_device`. Numerics are bit-identical to the default
+        per-batch path (same RNG chain); batches that can't join a full
+        uniform group (trailing stragglers, shape changes, mask-presence
+        changes) train per-batch, and gradient-stats listeners or TBPTT
+        disable staging since the scanned step can't serve them.
         """
         from ..datasets.iterators import DataSet, AsyncDataSetIterator, as_iterator
 
         self.init()
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        stage = int(stage_on_device)
+        if stage > 1 and (
+            self.conf.backprop_type == "tbptt"
+            or any(not getattr(lst, "supports_staged", False)
+                   for lst in self.listeners)
+        ):
+            stage = 0  # TBPTT needs per-batch segmenting; listeners must
+            #            OPT IN to staging (iteration_done replays after the
+            #            scan, so per-iteration model state is unavailable —
+            #            see IterationListener.supports_staged)
 
         for ep in range(epochs):
             for lst in self.listeners:
@@ -357,13 +376,62 @@ class MultiLayerNetwork:
                 it.reset()  # reference resets the iterator each epoch (fit:917)
             if getattr(it, "prefetch_supported", False):
                 it = AsyncDataSetIterator(it)
-            for ds in it:
-                self._fit_batch(ds)
+            if stage > 1:
+                self._fit_epoch_staged(it, stage)
+            else:
+                for ds in it:
+                    self._fit_batch(ds)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self, self.epoch)
         return self
+
+    @staticmethod
+    def _stage_signature(ds):
+        """Batches may only share a staged group when shapes AND mask
+        presence match — otherwise np.stack would fail or mask semantics
+        would silently change."""
+        return (
+            np.shape(ds.features), np.shape(ds.labels),
+            getattr(ds, "features_mask", None) is not None,
+            getattr(ds, "labels_mask", None) is not None,
+        )
+
+    def _fit_epoch_staged(self, it, stage: int) -> None:
+        """Group ``stage`` uniform batches per fit_on_device dispatch; any
+        batch that breaks uniformity (and the trailing partial group) trains
+        through the ordinary per-batch step, preserving order and numerics."""
+        group: list = []
+        sig = None
+        def flush_per_batch():
+            nonlocal group, sig
+            for ds in group:
+                self._fit_batch(ds)
+            group, sig = [], None
+
+        def flush_staged():
+            nonlocal group, sig
+            xs = np.stack([np.asarray(d.features) for d in group])
+            ys = np.stack([np.asarray(d.labels) for d in group])
+            fm = (np.stack([np.asarray(d.features_mask) for d in group])
+                  if sig[2] else None)
+            lm = (np.stack([np.asarray(d.labels_mask) for d in group])
+                  if sig[3] else None)
+            self.fit_on_device(xs, ys, steps=stage,
+                               features_masks=fm, labels_masks=lm)
+            group, sig = [], None
+
+        for ds in it:
+            s = self._stage_signature(ds)
+            if group and s != sig:
+                flush_per_batch()
+            sig = s
+            group.append(ds)
+            if len(group) == stage:
+                flush_staged()
+        if group:
+            flush_per_batch()
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])

@@ -21,7 +21,17 @@ logger = logging.getLogger(__name__)
 
 
 class IterationListener:
-    """SPI (reference: optimize/api/IterationListener.java)."""
+    """SPI (reference: optimize/api/IterationListener.java).
+
+    ``supports_staged``: True when the listener consumes only the
+    (iteration, score) arguments — such listeners work under the staged
+    fit path (``fit(stage_on_device=K)``), where ``iteration_done`` replays
+    AFTER a whole scanned dispatch and ``model``'s params/state already
+    hold end-of-window values. Listeners that read per-iteration model
+    state (params, gradients, inputs) must leave this False so staging
+    auto-disables and they keep observing true per-step state."""
+
+    supports_staged = False
 
     def iteration_done(self, model, iteration: int, score) -> None:
         pass
@@ -40,6 +50,8 @@ class TrainingListener(IterationListener):
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (reference: ScoreIterationListener)."""
 
+    supports_staged = True  # consumes only (iteration, score)
+
     def __init__(self, print_every: int = 10):
         self.print_every = max(1, print_every)
 
@@ -50,6 +62,8 @@ class ScoreIterationListener(TrainingListener):
 
 class CollectScoresIterationListener(TrainingListener):
     """Accumulate (iteration, score) pairs (reference: CollectScoresIterationListener)."""
+
+    supports_staged = True  # consumes only (iteration, score)
 
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)
@@ -63,6 +77,9 @@ class CollectScoresIterationListener(TrainingListener):
 class PerformanceListener(TrainingListener):
     """Throughput: samples/sec + batches/sec (reference: PerformanceListener.java —
     the in-tree measurement hook called out in SURVEY.md §6)."""
+
+    supports_staged = True  # wall-clock + score only; staged throughput is
+    #                           attributed to the window's steps evenly
 
     def __init__(self, frequency: int = 1, report_score: bool = False):
         self.frequency = max(1, frequency)

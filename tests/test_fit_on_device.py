@@ -154,6 +154,66 @@ def _graph_conf(seed=9):
     )
 
 
+def test_fit_stage_on_device_equals_plain_fit():
+    """fit(it, stage_on_device=K) is bit-identical to fit(it): full groups go
+    through the scanned dispatch, stragglers and shape-changing batches fall
+    back per-batch, and the RNG chain is one and the same."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    rng = np.random.default_rng(8)
+    batches = []
+    for i in range(7):  # 7 batches, K=3: two staged groups + 1 straggler
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        batches.append(DataSet(x, y))
+    # a shape-changing batch mid-stream forces a per-batch flush
+    xb = rng.normal(size=(4, 5)).astype(np.float32)
+    yb = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    batches.insert(4, DataSet(xb, yb))
+
+    plain = MultiLayerNetwork(_mlp_conf(seed=41)).init()
+    plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+
+    staged = MultiLayerNetwork(_mlp_conf(seed=41)).init()
+    staged.fit(ListDataSetIterator(list(batches)), epochs=2, stage_on_device=3)
+
+    _tree_allclose(staged.params, plain.params)
+    _tree_allclose(staged.opt_state, plain.opt_state)
+    assert staged.iteration == plain.iteration == 16
+
+
+def test_fit_stage_on_device_listener_contract():
+    """Score-only listeners opt in via supports_staged and fire per step;
+    listeners that read per-iteration model state auto-disable staging."""
+    from deeplearning4j_tpu import CollectScoresIterationListener
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    xs, ys = _batches(k=4)
+    data = [DataSet(xs[i], ys[i]) for i in range(4)]
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    collect = CollectScoresIterationListener()
+    assert collect.supports_staged
+    net.set_listeners(collect)
+    net.fit(ListDataSetIterator(list(data)), stage_on_device=2)
+    assert [i for i, _ in collect.scores] == [1, 2, 3, 4]
+
+    # a state-reading listener (no supports_staged) forces the per-batch
+    # path, where model params evolve under its feet as usual
+    snapshots = []
+
+    class ParamReader:
+        def iteration_done(self, model, iteration, score):
+            snapshots.append(float(np.asarray(
+                __import__("jax").tree_util.tree_leaves(model.params)[0]).sum()))
+
+    net2 = MultiLayerNetwork(_mlp_conf()).init()
+    net2.set_listeners(ParamReader())
+    net2.fit(ListDataSetIterator(list(data)), stage_on_device=2)
+    assert len(snapshots) == 4
+    assert len(set(snapshots)) == 4  # params differ at every step = per-batch path
+
+
 def test_parallel_wrapper_sync_matches_sequential():
     """Wrapper.fit_on_device (scan of the SPMD step, psum inside the scan)
     equals the wrapper's per-step dispatch path on the same global batches."""
@@ -223,6 +283,26 @@ def test_parallel_wrapper_periodic_matches_sequential():
     dev2 = dev.fit_on_device(xs[1:2], ys[1:2], steps=1)
     assert dev2.shape == (1,)
     _tree_allclose(dev._replica, seq._replica, atol=1e-6)
+
+
+def test_graph_fit_stage_on_device_equals_plain_fit():
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    rng = np.random.default_rng(9)
+    batches = [
+        DataSet(rng.normal(size=(8, 5)).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+        for _ in range(5)  # K=2: two staged groups + straggler
+    ]
+    plain = ComputationGraph(_graph_conf(seed=43)).init()
+    plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+
+    staged = ComputationGraph(_graph_conf(seed=43)).init()
+    staged.fit(ListDataSetIterator(list(batches)), epochs=2, stage_on_device=2)
+
+    _tree_allclose(staged.params, plain.params)
+    _tree_allclose(staged.opt_state, plain.opt_state)
+    assert staged.iteration == plain.iteration == 10
 
 
 def test_graph_matches_sequential():
