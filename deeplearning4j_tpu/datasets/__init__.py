@@ -2,16 +2,19 @@
 
 from .iterators import (
     AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
     BucketingSequenceIterator,
     DataSet,
     DataSetIterator,
     DevicePrefetchIterator,
     ExistingDataSetIterator,
     IteratorDataSetIterator,
+    IteratorMultiDataSetIterator,
     ListDataSetIterator,
     MultiDataSet,
     MultipleEpochsIterator,
     NumpyDataSetIterator,
+    ReconstructionDataSetIterator,
     SamplingDataSetIterator,
 )
 from .records import (
@@ -44,6 +47,7 @@ from .fetchers import (
     read_idx,
 )
 from .normalizers import (
+    CombinedPreProcessor,
     DataNormalization,
     ImagePreProcessingScaler,
     NormalizerMinMaxScaler,
@@ -52,11 +56,14 @@ from .normalizers import (
 )
 
 __all__ = [
-    "AsyncDataSetIterator",
-    "BucketingSequenceIterator", "DataSet", "DataSetIterator",
+    "AsyncDataSetIterator", "AsyncMultiDataSetIterator",
+    "BucketingSequenceIterator", "CombinedPreProcessor", "DataSet",
+    "DataSetIterator",
     "DevicePrefetchIterator", "ExistingDataSetIterator", "IteratorDataSetIterator",
+    "IteratorMultiDataSetIterator",
     "ListDataSetIterator", "MultiDataSet", "MultipleEpochsIterator",
-    "NumpyDataSetIterator", "SamplingDataSetIterator",
+    "NumpyDataSetIterator", "ReconstructionDataSetIterator",
+    "SamplingDataSetIterator",
     "CollectionRecordReader", "CollectionSequenceRecordReader",
     "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",
     "LineRecordReader", "RecordReader", "SequenceRecordReader",

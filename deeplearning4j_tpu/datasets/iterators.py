@@ -220,6 +220,92 @@ class IteratorDataSetIterator(DataSetIterator):
                 feats, labs, metas = [], [], []
 
 
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Labels = features, for autoencoder/pretrain targets
+    (reference: ReconstructionDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        for ds in self.base:
+            yield DataSet(ds.features, ds.features,
+                          features_mask=ds.features_mask,
+                          labels_mask=ds.features_mask,
+                          example_metadata=ds.example_metadata)
+
+
+class IteratorMultiDataSetIterator(DataSetIterator):
+    """Re-batch a stream of (possibly single-example) MultiDataSets
+    (reference: IteratorMultiDataSetIterator.java); trailing partial batches
+    are emitted, as in the reference."""
+
+    def __init__(self, examples: Iterable[MultiDataSet], batch: int):
+        self.examples = examples
+        self.batch = batch
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        buf: List[MultiDataSet] = []
+        count = 0
+
+        def cat_masks(mask_lists, n):
+            """Concat per-position masks; None only when every batch agrees."""
+            if all(ml is None for ml in mask_lists):
+                return None
+            out = []
+            for i in range(n):
+                col = [None if ml is None else ml[i] for ml in mask_lists]
+                if all(m is None for m in col):
+                    out.append(None)
+                elif any(m is None for m in col):
+                    raise ValueError(
+                        f"cannot re-batch MultiDataSets with inconsistent "
+                        f"mask presence at position {i}"
+                    )
+                else:
+                    out.append(np.concatenate([np.asarray(m) for m in col]))
+            return out
+
+        def emit():
+            nonlocal buf, count
+            n_in = len(buf[0].features)
+            n_out = len(buf[0].labels)
+            metas = None
+            if any(m.example_metadata for m in buf):
+                metas = []
+                for m in buf:
+                    metas.extend(m.example_metadata or
+                                 [None] * m.num_examples())
+            mds = MultiDataSet(
+                features=[np.concatenate([np.asarray(m.features[i]) for m in buf])
+                          for i in range(n_in)],
+                labels=[np.concatenate([np.asarray(m.labels[i]) for m in buf])
+                        for i in range(n_out)],
+                features_masks=cat_masks([m.features_masks for m in buf], n_in),
+                labels_masks=cat_masks([m.labels_masks for m in buf], n_out),
+                example_metadata=metas,
+            )
+            buf, count = [], 0
+            return mds
+
+        for mds in self.examples:
+            buf.append(mds)
+            count += mds.num_examples()
+            if count >= self.batch:
+                yield emit()
+        if buf:
+            yield emit()
+
+
 _SENTINEL = object()
 
 
@@ -249,6 +335,12 @@ class AsyncDataSetIterator(DataSetIterator):
         from ..utils.collections import AsyncIterator  # noqa: PLC0415
 
         yield from AsyncIterator(self.base, queue_size=self.queue_size)
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """MultiDataSet flavor (reference: AsyncMultiDataSetIterator.java). The
+    prefetch pump is payload-agnostic, so this is the same machinery under
+    the reference's multi-input name."""
 
 
 class DevicePrefetchIterator(DataSetIterator):

@@ -30,6 +30,11 @@ class DataNormalization:
     def preprocess(self, ds: DataSet) -> DataSet:
         return self.transform(ds)
 
+    def revert(self, ds: DataSet) -> DataSet:
+        """Inverse of transform (reference: DataNormalization.revertFeatures).
+        Concrete normalizers override; stateless ones may be irreversible."""
+        raise NotImplementedError(f"{type(self).__name__} has no revert()")
+
     # -- persistence ----------------------------------------------------
     def to_json(self) -> str:
         d = {k: v.tolist() if isinstance(v, np.ndarray) else v
@@ -40,6 +45,11 @@ class DataNormalization:
     @staticmethod
     def from_json(s: str) -> "DataNormalization":
         d = json.loads(s)
+        if d.get("@type") == "CombinedPreProcessor":
+            return CombinedPreProcessor(*(
+                DataNormalization.from_json(json.dumps(p))
+                for p in d["preprocessors"]
+            ))
         cls = {c.__name__: c for c in (
             NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler
         )}[d.pop("@type")]
@@ -126,6 +136,15 @@ class NormalizerMinMaxScaler(DataNormalization):
         return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
                        ds.features_mask, ds.labels_mask, ds.example_metadata)
 
+    def revert(self, ds: DataSet) -> DataSet:
+        """Inverse transform (reference: NormalizerMinMaxScaler.revertFeatures)."""
+        shape = ds.features.shape
+        x = ds.features.reshape(shape[0], -1).astype(np.float64)
+        rng = np.maximum(self.max - self.min, 1e-12)
+        x = (x - self.lo) / (self.hi - self.lo) * rng + self.min
+        return DataSet(x.reshape(shape).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
+
 
 class ImagePreProcessingScaler(DataNormalization):
     """Pixel scaling [0,255] → [lo,hi] without a fit pass (reference:
@@ -143,6 +162,60 @@ class ImagePreProcessingScaler(DataNormalization):
         x = ds.features / self.max_pixel * (self.hi - self.lo) + self.lo
         return DataSet(x.astype(np.float32), ds.labels,
                        ds.features_mask, ds.labels_mask, ds.example_metadata)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        x = (ds.features - self.lo) / (self.hi - self.lo) * self.max_pixel
+        return DataSet(x.astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask, ds.example_metadata)
+
+
+class CombinedPreProcessor(DataNormalization):
+    """Apply several preprocessors in order (reference:
+    CombinedPreProcessor.java builder). fit() fits each stage on the
+    previous stages' OUTPUT; transform() chains forward, revert() unwinds
+    in reverse."""
+
+    def __init__(self, *preprocessors: DataNormalization):
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, data) -> "CombinedPreProcessor":
+        # each stage must see the PREVIOUS stages' output, or its statistics
+        # describe data it will never receive at transform time. Streaming:
+        # later stages fit on a generator of transformed batches (no
+        # materialization); multi-stage fit re-iterates `data`, so iterators
+        # are reset() between passes — a one-shot generator works only for a
+        # single stage (the inner fit raises "saw no data" otherwise).
+        def transformed(chain):
+            for ds in _batches(data):
+                for q in chain:
+                    ds = q.transform(ds)
+                yield ds
+
+        for i, p in enumerate(self.preprocessors):
+            if i > 0 and hasattr(data, "reset"):
+                data.reset()
+            if i == 0:
+                p.fit(data)
+            else:
+                p.fit(transformed(self.preprocessors[:i]))
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def revert(self, ds):
+        for p in reversed(self.preprocessors):
+            ds = p.revert(ds)
+        return ds
+
+    # -- persistence: nested, unlike the flat-__dict__ base implementation
+    def to_json(self) -> str:
+        return json.dumps({
+            "@type": "CombinedPreProcessor",
+            "preprocessors": [json.loads(p.to_json()) for p in self.preprocessors],
+        })
 
 
 class NormalizingIterator(DataSetIterator):

@@ -145,6 +145,109 @@ class TestIterators:
         sh = ds.shuffle(seed=3)
         assert sorted(sh.features.ravel()) == list(range(10))
 
+    def test_reconstruction_iterator(self):
+        from deeplearning4j_tpu.datasets import (
+            ListDataSetIterator,
+            ReconstructionDataSetIterator,
+        )
+
+        base = ListDataSetIterator([
+            DataSet(np.full((2, 3), i, float), np.zeros((2, 1))) for i in range(3)
+        ])
+        out = list(ReconstructionDataSetIterator(base))
+        assert len(out) == 3
+        for i, ds in enumerate(out):
+            np.testing.assert_array_equal(ds.labels, ds.features)
+            assert float(ds.features[0, 0]) == i
+
+    def test_iterator_multi_dataset_iterator_rebatches(self):
+        from deeplearning4j_tpu.datasets import (
+            IteratorMultiDataSetIterator,
+            MultiDataSet,
+        )
+
+        singles = [
+            MultiDataSet(features=[np.full((1, 2), i, float),
+                                   np.full((1, 3), i, float)],
+                         labels=[np.full((1, 1), i, float)])
+            for i in range(5)
+        ]
+        got = list(IteratorMultiDataSetIterator(singles, batch=2))
+        assert [m.num_examples() for m in got] == [2, 2, 1]  # trailing emitted
+        np.testing.assert_array_equal(got[0].features[0][:, 0], [0, 1])
+        np.testing.assert_array_equal(got[1].features[1][:, 0], [2, 3])
+        assert got[0].features[1].shape == (2, 3)
+
+    def test_combined_preprocessor_chains_and_reverts(self):
+        from deeplearning4j_tpu.datasets import (
+            CombinedPreProcessor,
+            NormalizerMinMaxScaler,
+            NormalizerStandardize,
+        )
+
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(loc=5.0, scale=3.0, size=(40, 4)), np.zeros((40, 2)))
+        pre = CombinedPreProcessor(NormalizerStandardize(), NormalizerMinMaxScaler())
+        pre.fit(ds)
+        out = pre.transform(ds)
+        assert out.features.min() >= -1e-9 and out.features.max() <= 1 + 1e-9
+        back = pre.revert(out)
+        np.testing.assert_allclose(back.features, ds.features, rtol=1e-6, atol=1e-8)
+
+    def test_iterator_multi_dataset_iterator_masks_and_metadata(self):
+        from deeplearning4j_tpu.datasets import (
+            IteratorMultiDataSetIterator,
+            MultiDataSet,
+        )
+
+        singles = [
+            MultiDataSet(features=[np.full((1, 2, 3), i, float)],
+                         labels=[np.full((1, 2, 1), i, float)],
+                         features_masks=[np.full((1, 2), i % 2, float)],
+                         labels_masks=[np.full((1, 2), i % 2, float)],
+                         example_metadata=[f"rec{i}"])
+            for i in range(4)
+        ]
+        got = list(IteratorMultiDataSetIterator(singles, batch=2))
+        assert got[0].features_masks[0].shape == (2, 2)
+        np.testing.assert_array_equal(got[1].features_masks[0][:, 0], [0, 1])
+        assert got[0].example_metadata == ["rec0", "rec1"]
+
+    def test_combined_preprocessor_json_roundtrip_and_resets(self):
+        from deeplearning4j_tpu.datasets import (
+            CombinedPreProcessor,
+            DataNormalization,
+            ListDataSetIterator,
+            NormalizerMinMaxScaler,
+            NormalizerStandardize,
+        )
+
+        rng = np.random.default_rng(1)
+        batches = [DataSet(rng.normal(size=(10, 3)), np.zeros((10, 1)))
+                   for _ in range(3)]
+        it = ListDataSetIterator(batches)  # resettable: both stages see data
+        pre = CombinedPreProcessor(NormalizerStandardize(), NormalizerMinMaxScaler())
+        pre.fit(it)
+        restored = DataNormalization.from_json(pre.to_json())
+        out_a = pre.transform(batches[0])
+        out_b = restored.transform(batches[0])
+        np.testing.assert_allclose(out_a.features, out_b.features, rtol=1e-6)
+
+    def test_async_multi_dataset_iterator_passthrough(self):
+        from deeplearning4j_tpu.datasets import (
+            AsyncMultiDataSetIterator,
+            IteratorMultiDataSetIterator,
+            MultiDataSet,
+        )
+
+        singles = [MultiDataSet(features=[np.full((1, 2), i, float)],
+                                labels=[np.full((1, 1), i, float)])
+                   for i in range(4)]
+        base = IteratorMultiDataSetIterator(singles, batch=2)
+        got = list(AsyncMultiDataSetIterator(base))
+        assert [m.num_examples() for m in got] == [2, 2]
+        np.testing.assert_array_equal(got[1].features[0][:, 0], [2, 3])
+
 
 def test_device_prefetch_iterator_preserves_stream():
     import numpy as np
