@@ -242,9 +242,12 @@ class ReconstructionDataSetIterator(DataSetIterator):
 
 
 class IteratorMultiDataSetIterator(DataSetIterator):
-    """Re-batch a stream of (possibly single-example) MultiDataSets
-    (reference: IteratorMultiDataSetIterator.java); trailing partial batches
-    are emitted, as in the reference."""
+    """Re-batch a stream of MultiDataSets into EXACT ``batch``-sized batches
+    (reference: IteratorMultiDataSetIterator.java — the overflowing source
+    batch is split and the remainder queued). Only the trailing batch may be
+    short; everything else honors the static-batch-shape contract. Mixed
+    mask presence merges like the reference's MultiDataSet.merge: unmasked
+    members contribute all-ones masks."""
 
     def __init__(self, examples: Iterable[MultiDataSet], batch: int):
         self.examples = examples
@@ -257,8 +260,10 @@ class IteratorMultiDataSetIterator(DataSetIterator):
         buf: List[MultiDataSet] = []
         count = 0
 
-        def cat_masks(mask_lists, n):
-            """Concat per-position masks; None only when every batch agrees."""
+        def cat_masks(buf, kind, n):
+            """Concat per-position masks across buffered sets; members
+            without a mask get all-ones of the masked members' shape."""
+            mask_lists = [getattr(m, kind) for m in buf]
             if all(ml is None for ml in mask_lists):
                 return None
             out = []
@@ -266,17 +271,19 @@ class IteratorMultiDataSetIterator(DataSetIterator):
                 col = [None if ml is None else ml[i] for ml in mask_lists]
                 if all(m is None for m in col):
                     out.append(None)
-                elif any(m is None for m in col):
-                    raise ValueError(
-                        f"cannot re-batch MultiDataSets with inconsistent "
-                        f"mask presence at position {i}"
+                    continue
+                trailing = next(np.asarray(m).shape[1:] for m in col
+                                if m is not None)
+                parts = []
+                for m, mds in zip(col, buf):
+                    parts.append(
+                        np.ones((mds.num_examples(),) + trailing, np.float32)
+                        if m is None else np.asarray(m)
                     )
-                else:
-                    out.append(np.concatenate([np.asarray(m) for m in col]))
+                out.append(np.concatenate(parts))
             return out
 
-        def emit():
-            nonlocal buf, count
+        def concat_all(buf):
             n_in = len(buf[0].features)
             n_out = len(buf[0].labels)
             metas = None
@@ -285,25 +292,41 @@ class IteratorMultiDataSetIterator(DataSetIterator):
                 for m in buf:
                     metas.extend(m.example_metadata or
                                  [None] * m.num_examples())
-            mds = MultiDataSet(
+            return MultiDataSet(
                 features=[np.concatenate([np.asarray(m.features[i]) for m in buf])
                           for i in range(n_in)],
                 labels=[np.concatenate([np.asarray(m.labels[i]) for m in buf])
                         for i in range(n_out)],
-                features_masks=cat_masks([m.features_masks for m in buf], n_in),
-                labels_masks=cat_masks([m.labels_masks for m in buf], n_out),
+                features_masks=cat_masks(buf, "features_masks", n_in),
+                labels_masks=cat_masks(buf, "labels_masks", n_out),
                 example_metadata=metas,
             )
-            buf, count = [], 0
-            return mds
+
+        def take(mds, sl):
+            """Row-slice every array (and metadata) of a MultiDataSet."""
+            return MultiDataSet(
+                features=[f[sl] for f in mds.features],
+                labels=[l[sl] for l in mds.labels],
+                features_masks=None if mds.features_masks is None
+                else [None if m is None else m[sl] for m in mds.features_masks],
+                labels_masks=None if mds.labels_masks is None
+                else [None if m is None else m[sl] for m in mds.labels_masks],
+                example_metadata=None if mds.example_metadata is None
+                else mds.example_metadata[sl],
+            )
 
         for mds in self.examples:
             buf.append(mds)
             count += mds.num_examples()
-            if count >= self.batch:
-                yield emit()
+            while count >= self.batch:
+                merged = concat_all(buf)
+                exact = take(merged, slice(None, self.batch))
+                rest_n = count - self.batch
+                buf = [take(merged, slice(self.batch, None))] if rest_n else []
+                count = rest_n
+                yield exact
         if buf:
-            yield emit()
+            yield concat_all(buf)
 
 
 _SENTINEL = object()

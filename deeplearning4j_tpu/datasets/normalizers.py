@@ -36,19 +36,21 @@ class DataNormalization:
         raise NotImplementedError(f"{type(self).__name__} has no revert()")
 
     # -- persistence ----------------------------------------------------
-    def to_json(self) -> str:
+    def _to_dict(self) -> dict:
         d = {k: v.tolist() if isinstance(v, np.ndarray) else v
              for k, v in self.__dict__.items()}
         d["@type"] = type(self).__name__
-        return json.dumps(d)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self._to_dict())
 
     @staticmethod
-    def from_json(s: str) -> "DataNormalization":
-        d = json.loads(s)
+    def _from_dict(d: dict) -> "DataNormalization":
+        d = dict(d)
         if d.get("@type") == "CombinedPreProcessor":
             return CombinedPreProcessor(*(
-                DataNormalization.from_json(json.dumps(p))
-                for p in d["preprocessors"]
+                DataNormalization._from_dict(p) for p in d["preprocessors"]
             ))
         cls = {c.__name__: c for c in (
             NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler
@@ -57,6 +59,10 @@ class DataNormalization:
         for k, v in d.items():
             setattr(obj, k, np.asarray(v, np.float64) if isinstance(v, list) else v)
         return obj
+
+    @staticmethod
+    def from_json(s: str) -> "DataNormalization":
+        return DataNormalization._from_dict(json.loads(s))
 
 
 def _batches(data):
@@ -211,11 +217,11 @@ class CombinedPreProcessor(DataNormalization):
         return ds
 
     # -- persistence: nested, unlike the flat-__dict__ base implementation
-    def to_json(self) -> str:
-        return json.dumps({
+    def _to_dict(self) -> dict:
+        return {
             "@type": "CombinedPreProcessor",
-            "preprocessors": [json.loads(p.to_json()) for p in self.preprocessors],
-        })
+            "preprocessors": [p._to_dict() for p in self.preprocessors],
+        }
 
 
 class NormalizingIterator(DataSetIterator):

@@ -194,6 +194,47 @@ class TestIterators:
         back = pre.revert(out)
         np.testing.assert_allclose(back.features, ds.features, rtol=1e-6, atol=1e-8)
 
+    def test_iterator_multi_dataset_iterator_exact_batches(self):
+        """Overflowing source batches split to EXACT batch size (static-shape
+        contract); the remainder carries into the next batch; only the
+        trailing batch may be short."""
+        from deeplearning4j_tpu.datasets import (
+            IteratorMultiDataSetIterator,
+            MultiDataSet,
+        )
+
+        sources = [
+            MultiDataSet(features=[np.arange(i * 10, i * 10 + 3)
+                                   .reshape(3, 1).astype(float)],
+                         labels=[np.zeros((3, 1))])
+            for i in range(3)  # 9 examples in 3-example chunks
+        ]
+        got = list(IteratorMultiDataSetIterator(sources, batch=4))
+        assert [m.num_examples() for m in got] == [4, 4, 1]
+        np.testing.assert_array_equal(got[0].features[0][:, 0], [0, 1, 2, 10])
+        np.testing.assert_array_equal(got[1].features[0][:, 0], [11, 12, 20, 21])
+        np.testing.assert_array_equal(got[2].features[0][:, 0], [22])
+
+    def test_iterator_multi_dataset_iterator_mixed_mask_presence(self):
+        """Unmasked members merge with all-ones masks (MultiDataSet.merge
+        semantics), not an error."""
+        from deeplearning4j_tpu.datasets import (
+            IteratorMultiDataSetIterator,
+            MultiDataSet,
+        )
+
+        masked = MultiDataSet(features=[np.zeros((2, 3, 1))],
+                              labels=[np.zeros((2, 3, 1))],
+                              features_masks=[np.asarray([[1., 1., 0.],
+                                                          [1., 0., 0.]])])
+        unmasked = MultiDataSet(features=[np.ones((2, 3, 1))],
+                                labels=[np.ones((2, 3, 1))])
+        got = list(IteratorMultiDataSetIterator([masked, unmasked], batch=4))
+        assert len(got) == 1
+        np.testing.assert_array_equal(
+            got[0].features_masks[0],
+            [[1, 1, 0], [1, 0, 0], [1, 1, 1], [1, 1, 1]])
+
     def test_iterator_multi_dataset_iterator_masks_and_metadata(self):
         from deeplearning4j_tpu.datasets import (
             IteratorMultiDataSetIterator,
