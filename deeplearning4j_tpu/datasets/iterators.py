@@ -250,8 +250,10 @@ class IteratorMultiDataSetIterator(DataSetIterator):
     members contribute all-ones masks."""
 
     def __init__(self, examples: Iterable[MultiDataSet], batch: int):
+        if int(batch) < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
         self.examples = examples
-        self.batch = batch
+        self.batch = int(batch)
 
     def batch_size(self):
         return self.batch
@@ -318,13 +320,17 @@ class IteratorMultiDataSetIterator(DataSetIterator):
         for mds in self.examples:
             buf.append(mds)
             count += mds.num_examples()
-            while count >= self.batch:
+            if count >= self.batch:
+                # merge ONCE per buffer fill, then yield successive slices
+                # (numpy row-slices are views) — re-concatenating the
+                # shrinking remainder each split would be O(N^2/batch)
                 merged = concat_all(buf)
-                exact = take(merged, slice(None, self.batch))
-                rest_n = count - self.batch
-                buf = [take(merged, slice(self.batch, None))] if rest_n else []
-                count = rest_n
-                yield exact
+                k = 0
+                while count - k >= self.batch:
+                    yield take(merged, slice(k, k + self.batch))
+                    k += self.batch
+                buf = [take(merged, slice(k, None))] if count - k else []
+                count -= k
         if buf:
             yield concat_all(buf)
 

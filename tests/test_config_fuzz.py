@@ -99,6 +99,81 @@ def _random_rnn_stack(rng):
 FAMILIES = [_random_ff_stack, _random_cnn_stack, _random_rnn_stack]
 
 
+@pytest.mark.parametrize("case", range(12))
+def test_random_graph_invariants(case):
+    """Random DAGs: chains with fan-out branches re-joined by Merge or
+    ElementWise vertices, sometimes a second output head — the graph-tier
+    invariants mirror the sequential ones."""
+    from deeplearning4j_tpu import (
+        ComputationGraph,
+        ComputationGraphConfiguration,
+        ElementWiseVertex,
+        MergeVertex,
+    )
+
+    rng = np.random.default_rng(2000 + case)
+    f_in = int(rng.integers(3, 8))
+    b = (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(f_in))
+        .seed(int(rng.integers(0, 10_000)))
+        .updater(UpdaterConfig(updater="adam", learning_rate=1e-3))
+    )
+    tip = "in"
+    n_blocks = int(rng.integers(1, 4))
+    for i in range(n_blocks):
+        kind = rng.integers(0, 3)
+        if kind == 0:  # plain chain layer
+            b.add_layer(f"d{i}", DenseLayer(
+                n_out=int(rng.integers(4, 12)),
+                activation=ACTS[rng.integers(0, len(ACTS))]), tip)
+            tip = f"d{i}"
+        elif kind == 1:  # fan out, concat
+            b.add_layer(f"a{i}", DenseLayer(n_out=int(rng.integers(3, 8)),
+                                            activation="relu"), tip)
+            b.add_layer(f"b{i}", DenseLayer(n_out=int(rng.integers(3, 8)),
+                                            activation="tanh"), tip)
+            b.add_vertex(f"m{i}", MergeVertex(), f"a{i}", f"b{i}")
+            tip = f"m{i}"
+        else:  # fan out same-width, elementwise add
+            w = int(rng.integers(4, 10))
+            b.add_layer(f"a{i}", DenseLayer(n_out=w, activation="relu"), tip)
+            b.add_layer(f"b{i}", DenseLayer(n_out=w, activation="tanh"), tip)
+            b.add_vertex(f"e{i}", ElementWiseVertex(op="add"), f"a{i}", f"b{i}")
+            tip = f"e{i}"
+    n_cls = int(rng.integers(2, 5))
+    b.add_layer("out", OutputLayer(n_out=n_cls, activation="softmax",
+                                   loss="mcxent"), tip)
+    outputs = ["out"]
+    two_heads = bool(rng.integers(0, 2)) and n_blocks > 1
+    if two_heads:
+        b.add_layer("out2", OutputLayer(n_out=2, activation="softmax",
+                                        loss="mcxent"), tip)
+        outputs.append("out2")
+    b.set_outputs(*outputs)
+    conf = b.build()
+
+    net = ComputationGraph(conf).init()
+    x = rng.normal(size=(4, f_in)).astype(np.float32)
+    labels = [np.eye(n_cls, dtype=np.float32)[rng.integers(0, n_cls, 4)]]
+    if two_heads:
+        labels.append(np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+    outs = net.output(x)
+    # single-output graphs return the bare array (reference convenience)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    assert len(outs) == len(outputs)
+    assert np.asarray(outs[0]).shape == (4, n_cls)
+
+    from deeplearning4j_tpu.datasets.iterators import MultiDataSet
+
+    net.fit(MultiDataSet(features=[x], labels=labels))
+    assert np.isfinite(float(net.score()))
+    conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert conf2.to_dict() == conf.to_dict()
+
+
 @pytest.mark.parametrize("case", range(24))
 def test_random_config_invariants(case):
     rng = np.random.default_rng(1000 + case)
