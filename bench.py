@@ -229,7 +229,9 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
 
 
 def _with_self_baseline(result: dict) -> dict:
-    """vs_baseline = value / first-ever recorded value for this metric."""
+    """vs_baseline = value / first-ever recorded value for this metric.
+    Also maintains a "_latest" map (most recent value per metric) so a
+    fallback run can report the newest healthy measurement, not the first."""
     baselines = {}
     if os.path.exists(SELF_BASELINE_PATH):
         try:
@@ -237,15 +239,22 @@ def _with_self_baseline(result: dict) -> dict:
                 baselines = json.load(f)
         except (OSError, json.JSONDecodeError):
             baselines = {}
+    if not isinstance(baselines, dict):
+        baselines = {}
     base = baselines.get(result["metric"])
     if base is None:
         baselines[result["metric"]] = result["value"]
-        try:
-            with open(SELF_BASELINE_PATH, "w") as f:
-                json.dump(baselines, f)
-        except OSError:
-            pass
         base = result["value"]
+    latest = baselines.get("_latest")
+    if not isinstance(latest, dict):
+        latest = {}
+        baselines["_latest"] = latest
+    latest[result["metric"]] = result["value"]
+    try:
+        with open(SELF_BASELINE_PATH, "w") as f:
+            json.dump(baselines, f)
+    except OSError:
+        pass
     result["vs_baseline"] = round(result["value"] / base, 3) if base else 1.0
     return result
 
@@ -392,6 +401,26 @@ if __name__ == "__main__":
             _force_cpu()
             _enable_compilation_cache()
             result = bench_mlp_mnist()
+            # The tunnel was unavailable THIS run; surface the most recent
+            # healthy measurements ("_latest" in BENCH_SELF.json, falling
+            # back to the first-recorded baselines for files written before
+            # that key existed) so the driver artifact still carries them —
+            # clearly labeled as prior measurements, not this run's.
+            try:
+                with open(SELF_BASELINE_PATH) as f:
+                    prior = json.load(f)
+                if isinstance(prior, dict):
+                    latest = prior.get("_latest")
+                    src = latest if isinstance(latest, dict) else prior
+                    tpu_keys = {
+                        k: v for k, v in src.items()
+                        if k not in (result.get("metric"), "_latest")
+                        and isinstance(v, (int, float))
+                    }
+                    if tpu_keys:
+                        result["prior_tpu_measurements"] = tpu_keys
+            except Exception:  # a bad stats file must not cost the metric line
+                pass
         result = _with_self_baseline(result)
     except BaseException as e:  # noqa: BLE001 - the line must print regardless
         result = {
