@@ -228,21 +228,24 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
     }
 
 
+def _load_baselines() -> dict:
+    """Parse BENCH_SELF.json defensively: any malformed content reads as {}."""
+    try:
+        with open(SELF_BASELINE_PATH) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
 def _with_self_baseline(result: dict) -> dict:
     """vs_baseline = value / first-ever recorded value for this metric.
     Also maintains a "_latest" map (most recent value per metric) so a
     fallback run can report the newest healthy measurement, not the first."""
-    baselines = {}
-    if os.path.exists(SELF_BASELINE_PATH):
-        try:
-            with open(SELF_BASELINE_PATH) as f:
-                baselines = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            baselines = {}
-    if not isinstance(baselines, dict):
-        baselines = {}
+    baselines = _load_baselines()
     base = baselines.get(result["metric"])
-    if base is None:
+    if not isinstance(base, (int, float)) or not base:
+        # absent OR corrupted (non-numeric/zero): this run becomes the anchor
         baselines[result["metric"]] = result["value"]
         base = result["value"]
     latest = baselines.get("_latest")
@@ -251,8 +254,12 @@ def _with_self_baseline(result: dict) -> dict:
         baselines["_latest"] = latest
     latest[result["metric"]] = result["value"]
     try:
-        with open(SELF_BASELINE_PATH, "w") as f:
+        # atomic replace: the SIGALRM backstop can os._exit mid-run, and a
+        # truncated stats file would wipe every baseline on the next read
+        tmp = SELF_BASELINE_PATH + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(baselines, f)
+        os.replace(tmp, SELF_BASELINE_PATH)
     except OSError:
         pass
     result["vs_baseline"] = round(result["value"] / base, 3) if base else 1.0
@@ -407,18 +414,21 @@ if __name__ == "__main__":
             # that key existed) so the driver artifact still carries them —
             # clearly labeled as prior measurements, not this run's.
             try:
-                with open(SELF_BASELINE_PATH) as f:
-                    prior = json.load(f)
-                if isinstance(prior, dict):
-                    latest = prior.get("_latest")
-                    src = latest if isinstance(latest, dict) else prior
-                    tpu_keys = {
-                        k: v for k, v in src.items()
-                        if k not in (result.get("metric"), "_latest")
-                        and isinstance(v, (int, float))
-                    }
-                    if tpu_keys:
-                        result["prior_tpu_measurements"] = tpu_keys
+                prior = _load_baselines()
+                latest = prior.get("_latest")
+                # flat first-recorded entries, overridden by any newer
+                # value — metrics measured before "_latest" existed still
+                # surface
+                src = dict(prior)
+                if isinstance(latest, dict):
+                    src.update(latest)
+                tpu_keys = {
+                    k: v for k, v in src.items()
+                    if k not in (result.get("metric"), "_latest")
+                    and isinstance(v, (int, float))
+                }
+                if tpu_keys:
+                    result["prior_tpu_measurements"] = tpu_keys
             except Exception:  # a bad stats file must not cost the metric line
                 pass
         result = _with_self_baseline(result)
