@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..multilayer import _cast_input, _cast_params
+from ..multilayer import _cast_input, _cast_params, _format_summary_table
 from .vertices import LayerVertex
 
 
@@ -95,6 +95,27 @@ class ComputationGraph:
 
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+
+    def summary(self) -> str:
+        """Vertex table in topological order: name, type, inputs, out type,
+        param count (reference: ComputationGraph.summary())."""
+        self.init()
+        vit = self.conf.vertex_input_types()
+        rows = [("vertex", "type", "inputs", "out", "params")]
+        total = 0
+        for name in self._topo:
+            vertex = self.conf.vertices[name]
+            n = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(self.params[name]))
+            total += n
+            out_t = vertex.get_output_type(*vit[name])
+            vtype = (type(vertex.layer).__name__
+                     if isinstance(vertex, LayerVertex) and vertex.layer is not None
+                     else type(vertex).__name__)
+            rows.append((name, vtype,
+                         ",".join(self.conf.vertex_inputs[name]),
+                         str(out_t), f"{n:,}"))
+        return _format_summary_table(rows, total)
 
     # ------------------------------------------------------- functional core
     def _activations(self, params, inputs, state, train, rng, masks, rnn_state=None):

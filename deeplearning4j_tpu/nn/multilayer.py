@@ -59,6 +59,16 @@ def _compute_cast(conf_dtype: str, params, x):
     return params, _cast_input(conf_dtype, params, x)
 
 
+def _format_summary_table(rows, total: int) -> str:
+    """Fixed-width table + totals footer, shared by both summary() methods."""
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.insert(1, "-" * len(lines[0]))
+    lines.append(f"Total params: {total:,}")
+    return "\n".join(lines)
+
+
 def _check_staged_counts(num_batches: int, named_arrays) -> None:
     """Shared fit_on_device guard: dynamic_index_in_dim CLAMPS out-of-range
     indices, so a staged-batch-count mismatch would silently train features i
@@ -144,6 +154,21 @@ class MultiLayerNetwork:
 
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+
+    def summary(self) -> str:
+        """Layer table: name, in/out types, param count (reference:
+        MultiLayerNetwork.summary())."""
+        self.init()
+        its = self.conf.layer_input_types()
+        rows = [("idx", "layer", "in", "out", "params")]
+        total = 0
+        for i, (layer, it) in enumerate(zip(self.conf.layers, its)):
+            n = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(self.params[i]))
+            total += n
+            rows.append((str(i), type(layer).__name__, str(it),
+                         str(layer.get_output_type(it)), f"{n:,}"))
+        return _format_summary_table(rows, total)
 
     # ------------------------------------------------------- functional core
     def _forward(

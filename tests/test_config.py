@@ -94,3 +94,35 @@ def test_unknown_layer_type_rejected():
     d["layers"][0]["@type"] = "NoSuchLayer"
     with pytest.raises(ValueError, match="NoSuchLayer"):
         MultiLayerConfiguration.from_dict(d)
+
+
+class TestSummary:
+    def test_mln_summary(self):
+        from deeplearning4j_tpu.models import lenet_mnist_conf
+        from deeplearning4j_tpu import MultiLayerNetwork
+
+        s = MultiLayerNetwork(lenet_mnist_conf()).init().summary()
+        assert "ConvolutionLayer" in s and "cnn(28x28x1)" in s
+        assert "Total params: 431,080" in s
+        assert len(s.splitlines()) == 6 + 3  # 6 layers + header + rule + total
+
+    def test_graph_summary(self):
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        ComputationGraphConfiguration,
+                                        DenseLayer, InputType, MergeVertex,
+                                        OutputLayer, UpdaterConfig)
+
+        conf = (ComputationGraphConfiguration.builder()
+                .add_inputs("in").set_input_types(InputType.feed_forward(4))
+                .updater(UpdaterConfig())
+                .add_layer("a", DenseLayer(n_out=3, activation="relu"), "in")
+                .add_layer("b", DenseLayer(n_out=3, activation="tanh"), "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out").build())
+        s = ComputationGraph(conf).init().summary()
+        assert "MergeVertex" in s and "a,b" in s
+        assert "DenseLayer" in s  # LayerVertex shows its layer class
+        assert "ff(6)" in s  # merge output 3+3
+        assert "Total params:" in s
