@@ -64,7 +64,7 @@ def _format_summary_table(rows, total: int) -> str:
     widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
     lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
              for r in rows]
-    lines.insert(1, "-" * len(lines[0]))
+    lines.insert(1, "-" * max(len(l) for l in lines))
     lines.append(f"Total params: {total:,}")
     return "\n".join(lines)
 

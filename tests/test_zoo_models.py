@@ -65,6 +65,41 @@ class TestGoogLeNet:
         conf = googlenet_conf(n_classes=10, aux_heads=True)
         assert conf.network_outputs == ["out", "aux1", "aux2"]
 
+    def test_transfer_learning_head_surgery(self, rng):
+        """Zoo-scale transfer: freeze GoogLeNet through the last inception
+        module, replace the classifier head for a new class count — the
+        standard fine-tuning workflow on a real multi-branch graph."""
+        from deeplearning4j_tpu import (OutputLayer, TransferLearning,
+                                        UpdaterConfig)
+        from deeplearning4j_tpu.nn.layers.frozen import FrozenLayer
+        from deeplearning4j_tpu.nn.transferlearning import FineTuneConfiguration
+
+        conf = googlenet_conf(height=64, width=64, n_classes=100, dropout=0.0,
+                              updater="adam", learning_rate=1e-3)
+        net = ComputationGraph(conf).init()
+        stem_w_before = np.asarray(net.params["stem_conv1"]["W"])
+
+        new_net = (
+            TransferLearning.GraphBuilder(net)
+            .fine_tune_configuration(FineTuneConfiguration(
+                updater=UpdaterConfig(updater="adam", learning_rate=5e-3)))
+            .set_feature_extractor("i5b")  # freezes everything upstream
+            .remove_vertex_and_connections("out")
+            .add_layer("new_out", OutputLayer(n_out=4, activation="softmax",
+                                              loss="mcxent"), "drop")
+            .set_outputs("new_out")
+            .build()
+        )
+        assert isinstance(new_net.conf.vertices["stem_conv1"].layer, FrozenLayer)
+        assert new_net.params["new_out"]["W"].shape == (1024, 4)
+
+        x = rng.normal(size=(4, 64, 64, 3))
+        y = np.eye(4)[rng.integers(0, 4, size=4)]
+        new_net.fit((x, y), epochs=2)
+        np.testing.assert_array_equal(
+            np.asarray(new_net.params["stem_conv1"]["W"]), stem_w_before)
+        assert new_net.output(x).shape == (4, 4)
+
     def test_tiny_trains_with_aux(self, rng):
         """GoogLeNet with aux heads: multi-output losses sum and the graph
         trains end to end."""
