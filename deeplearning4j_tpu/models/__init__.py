@@ -9,11 +9,13 @@ from .googlenet import googlenet_conf
 from .lenet import lenet_mnist_conf
 from .resnet import resnet_conf, resnet18_conf, resnet34_conf, resnet50_conf
 from .char_rnn import char_rnn
+from .dbn import dbn_conf
 from ..modelimport.trained_models import vgg16_configuration
 
 __all__ = [
     "alexnet_conf",
     "char_rnn",
+    "dbn_conf",
     "googlenet_conf",
     "lenet_mnist_conf",
     "resnet_conf",

@@ -119,3 +119,35 @@ class TestGoogLeNet:
         assert net.score() < float(first)
         outs = net.output(x)
         assert len(outs) == 3 and outs[0].shape == (4, 4)
+
+
+class TestDBN:
+    def test_pretrain_then_finetune(self, rng):
+        """The reference's founding workflow: greedy layerwise CD-k pretrain
+        over the RBM stack, then supervised fine-tune."""
+        from deeplearning4j_tpu.models import dbn_conf
+
+        conf = dbn_conf(n_in=12, layer_sizes=(10, 6), n_classes=3,
+                        visible_unit="gaussian", updater="adam",
+                        learning_rate=5e-3)
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(64, 12)).astype(np.float32)
+        w = rng.normal(size=(12, 3))
+        y = np.eye(3, dtype=np.float32)[(x @ w).argmax(-1)]
+
+        net.pretrain((x, y), epochs=3)  # unsupervised: labels unused
+        first = float(net.loss_fn(net.params, x, y, train=False))
+        net.fit((x, y), epochs=25)
+        assert np.isfinite(net.score())
+        assert net.score() < first
+        assert net.output(x).shape == (64, 3)
+
+    def test_structure_json(self):
+        from deeplearning4j_tpu.models import dbn_conf
+
+        conf = dbn_conf()
+        kinds = [type(l).__name__ for l in conf.layers]
+        assert kinds == ["RBM", "RBM", "RBM", "OutputLayer"]
+        assert conf.layers[0].visible_unit == "binary"
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.to_dict() == conf.to_dict()
