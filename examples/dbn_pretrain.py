@@ -1,0 +1,42 @@
+"""Deep Belief Network: layerwise RBM pretraining, then fine-tuning.
+
+Reference example: the workflow the reference project was founded on
+(DeepBeliefNetworkExample / MnistDBNExample) — greedy CD-k pretraining of a
+stacked-RBM feature hierarchy, then supervised backprop through the whole
+stack. Runs on the real handwritten-digit corpus bundled with sklearn.
+"""
+
+import argparse
+
+
+def main(quick: bool = False) -> float:
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    from deeplearning4j_tpu.models import dbn_conf
+
+    conf = dbn_conf(
+        n_in=64,                      # 8x8 digit scans, flattened
+        layer_sizes=(96, 48),
+        n_classes=10,
+        visible_unit="gaussian",      # real-valued pixel inputs
+        updater="adam",
+        learning_rate=2e-3,
+        seed=5,
+    )
+    net = MultiLayerNetwork(conf).init()
+    print(net.summary())
+
+    it = DigitsDataSetIterator(batch=128, train=True, flat=True)
+    net.pretrain(it, epochs=2 if quick else 5)      # unsupervised CD-k
+    net.fit(it, epochs=12 if quick else 25)          # supervised fine-tune
+    ev = net.evaluate(
+        DigitsDataSetIterator(batch=120, train=False, shuffle=False, flat=True)
+    )
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -50,6 +50,11 @@ def test_on_device_training_example():
     assert acc > 0.5
 
 
+def test_dbn_pretrain_example():
+    acc = _mod("dbn_pretrain").main(quick=True)
+    assert acc > 0.7  # 12 quick fine-tune epochs on real digit scans
+
+
 def test_early_stopping_example():
     result = _mod("early_stopping").main(quick=True)
     assert result.best_model is not None
