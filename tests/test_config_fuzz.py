@@ -96,7 +96,54 @@ def _random_rnn_stack(rng):
     return layers, it, x, y
 
 
-FAMILIES = [_random_ff_stack, _random_cnn_stack, _random_rnn_stack]
+def _random_attention_stack(rng):
+    """Beyond-reference family: SelfAttention/LayerNorm transformer blocks."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        LayerNormLayer,
+        SelfAttentionLayer,
+    )
+
+    f = int(rng.integers(2, 7))
+    t = int(rng.integers(4, 9))
+    layers = []
+    for _ in range(rng.integers(1, 3)):
+        heads = int(rng.integers(1, 4))
+        d = heads * int(rng.integers(2, 5))
+        layers.append(SelfAttentionLayer(
+            n_out=d, n_heads=heads, causal=bool(rng.integers(0, 2))))
+        if rng.integers(0, 2):
+            layers.append(LayerNormLayer())
+    n_cls = int(rng.integers(2, 4))
+    layers.append(RnnOutputLayer(n_out=n_cls, activation="softmax", loss="mcxent"))
+    it = InputType.recurrent(f, t)
+    x = rng.normal(size=(2, t, f)).astype(np.float32)
+    y = np.eye(n_cls, dtype=np.float32)[rng.integers(0, n_cls, (2, t))]
+    return layers, it, x, y
+
+
+def _random_moe_stack(rng):
+    """Beyond-reference family: routed mixture-of-experts blocks."""
+    from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer
+
+    f = int(rng.integers(4, 9))
+    layers = [DenseLayer(n_out=f, activation="relu")]  # residual needs in==out
+    layers.append(MixtureOfExpertsLayer(
+        n_out=f,
+        n_experts=int(rng.integers(2, 5)),
+        hidden=int(rng.integers(4, 12)),
+        top_k=int(rng.integers(1, 3)),
+        residual=True,
+    ))
+    n_cls = int(rng.integers(2, 4))
+    layers.append(OutputLayer(n_out=n_cls, activation="softmax", loss="mcxent"))
+    it = InputType.feed_forward(f)
+    x = rng.normal(size=(8, f)).astype(np.float32)
+    y = np.eye(n_cls, dtype=np.float32)[rng.integers(0, n_cls, 8)]
+    return layers, it, x, y
+
+
+FAMILIES = [_random_ff_stack, _random_cnn_stack, _random_rnn_stack,
+            _random_attention_stack, _random_moe_stack]
 
 
 @pytest.mark.parametrize("case", range(12))
@@ -174,7 +221,7 @@ def test_random_graph_invariants(case):
     assert conf2.to_dict() == conf.to_dict()
 
 
-@pytest.mark.parametrize("case", range(24))
+@pytest.mark.parametrize("case", range(30))
 def test_random_config_invariants(case):
     rng = np.random.default_rng(1000 + case)
     family = FAMILIES[case % len(FAMILIES)]
