@@ -30,6 +30,8 @@ from .stemming import PorterStemmer, StemmingPreprocessor
 from .stopwords import STOP_WORDS
 from .distributed import DistributedWord2Vec
 from .tokenization_plugins import JapaneseTokenizerFactory, KoreanTokenizerFactory
+from .uima_analyzers import (PosUimaTokenizerFactory, UimaSentenceIterator,
+                             UimaTokenizerFactory, pos_tag, segment_sentences)
 from .vectorizers import (
     BagOfWordsVectorizer,
     InvertedIndex,
@@ -48,6 +50,8 @@ from .serialization import (
 
 __all__ = [
     "STOP_WORDS", "PorterStemmer", "StemmingPreprocessor", "DistributedWord2Vec", "JapaneseTokenizerFactory", "KoreanTokenizerFactory",
+    "PosUimaTokenizerFactory", "UimaSentenceIterator", "UimaTokenizerFactory",
+    "pos_tag", "segment_sentences",
     "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex", "windows",
     "CnnSentenceDataSetIterator", "Word2VecDataSetIterator",
     "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",

@@ -166,3 +166,99 @@ def test_word2vec_dataset_iterator_label_at_last_step():
     np.testing.assert_allclose(ds.labels_mask[1], [0, 1, 0, 0])
     np.testing.assert_allclose(ds.labels[0, 2], [1, 0])
     np.testing.assert_allclose(ds.labels[1, 1], [0, 1])
+
+
+class TestUimaAnalyzers:
+    """Miniature UIMA tier (reference: deeplearning4j-nlp-uima —
+    UimaSentenceIterator / UimaTokenizer / PosUimaTokenizer)."""
+
+    def test_sentence_segmentation_protects_abbreviations(self):
+        from deeplearning4j_tpu.nlp import segment_sentences
+
+        text = ("Dr. Smith arrived at 3.14 p.m. yesterday. He met J. K. "
+                "Rowling (no relation). Was it planned? Nobody knew!")
+        sents = segment_sentences(text)
+        assert sents == [
+            "Dr. Smith arrived at 3.14 p.m. yesterday.",
+            "He met J. K. Rowling (no relation).",
+            "Was it planned?",
+            "Nobody knew!",
+        ]
+
+    def test_uima_sentence_iterator(self):
+        from deeplearning4j_tpu.nlp import UimaSentenceIterator
+
+        it = UimaSentenceIterator(["One sentence. Two sentences here.",
+                                   "Second document!"])
+        got = list(it)
+        assert got == ["One sentence.", "Two sentences here.",
+                       "Second document!"]
+        it.reset()
+        assert it.has_next() and it.next_sentence() == "One sentence."
+
+    def test_pos_filtered_tokens_none_semantics(self):
+        from deeplearning4j_tpu.nlp import PosUimaTokenizerFactory
+
+        f = PosUimaTokenizerFactory(allowed_pos_tags=["NN", "VB"])
+        toks = f.create("The quick dogs quickly chased the ball").get_tokens()
+        # determiners and the -ly adverb become NONE; nouns/verbs survive
+        assert toks[0] == "NONE" and "NONE" in toks
+        assert "dogs" in toks and "ball" in toks
+        assert "quickly" not in toks
+
+        stripped = PosUimaTokenizerFactory(
+            allowed_pos_tags=["NN"], strip_nones=True
+        ).create("The government of the people").get_tokens()
+        assert stripped == ["government", "people"]
+
+    def test_pos_tagger_rules(self):
+        from deeplearning4j_tpu.nlp import pos_tag
+
+        tags = pos_tag("The illumination quickly faded to darkness in 42 ways".split())
+        assert tags[0] == "DT"
+        assert tags[1] == "NN"       # -tion
+        assert tags[2] == "RB"       # -ly
+        assert tags[3] == "VBD"      # -ed
+        assert tags[4] == "TO"
+        assert tags[5] == "VB"       # after TO
+        assert tags[6] == "IN"
+        assert tags[7] == "CD"
+        assert tags[8] == "NNS"      # plural
+
+    def test_uima_tokenizer_factory_sentence_aware(self):
+        from deeplearning4j_tpu.nlp import UimaTokenizerFactory
+
+        toks = UimaTokenizerFactory().create("Hello world. Bye now.").get_tokens()
+        assert toks == ["Hello", "world", ".", "Bye", "now", "."]
+
+    def test_custom_tagger_seam(self):
+        from deeplearning4j_tpu.nlp import PosUimaTokenizerFactory
+
+        all_nn = lambda toks: ["NN"] * len(toks)  # noqa: E731
+        f = PosUimaTokenizerFactory(allowed_pos_tags=["NN"], tagger=all_nn)
+        assert f.create("a b c").get_tokens() == ["a", "b", "c"]
+
+    def test_pos_filter_preprocessor_keeps_sentinel(self):
+        from deeplearning4j_tpu.nlp import PosUimaTokenizerFactory
+        from deeplearning4j_tpu.nlp.tokenization import CommonPreprocessor
+
+        f = PosUimaTokenizerFactory(allowed_pos_tags=["NN"])
+        f.set_token_pre_processor(CommonPreprocessor())
+        toks = f.create("The Dog chased the Ball").get_tokens()
+        assert toks.count("NONE") >= 2  # sentinel survives preprocessing
+        assert "dog" in toks or "ball" in toks  # kept tokens preprocessed
+
+    def test_bad_tagger_length_raises(self):
+        import pytest
+
+        from deeplearning4j_tpu.nlp import PosUimaTokenizerFactory
+
+        f = PosUimaTokenizerFactory(allowed_pos_tags=["NN"],
+                                    tagger=lambda t: ["NN"])
+        with pytest.raises(ValueError, match="tagger returned"):
+            f.create("one two three")
+
+    def test_pos_tag_tolerates_empty_tokens(self):
+        from deeplearning4j_tpu.nlp import pos_tag
+
+        assert len(pos_tag("a  b".split(" "))) == 3
