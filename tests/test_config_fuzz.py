@@ -254,3 +254,20 @@ def test_random_config_invariants(case):
     # JSON round-trip is exact
     conf2 = MultiLayerConfiguration.from_json(conf.to_json())
     assert conf2.to_dict() == conf.to_dict()
+    # every 5th case: full checkpoint round-trip restores identical inference
+    if case % 5 == 0:
+        import os
+        import tempfile
+
+        from deeplearning4j_tpu.utils.serialization import (
+            restore_model,
+            write_model,
+        )
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "m.zip")
+            write_model(net, path)
+            net2 = restore_model(path)
+            np.testing.assert_allclose(
+                np.asarray(net.output(x)), np.asarray(net2.output(x)),
+                rtol=1e-6, atol=1e-7)
