@@ -55,6 +55,12 @@ def test_dbn_pretrain_example():
     assert acc > 0.7  # 12 quick fine-tune epochs on real digit scans
 
 
+def test_streaming_pipeline_example():
+    acc = _mod("streaming_pipeline").main(quick=True)
+    assert acc > 0.6  # >=18 online steps on the streamed concept
+    # (the trailing partial batch may or may not flush before stop())
+
+
 def test_early_stopping_example():
     result = _mod("early_stopping").main(quick=True)
     assert result.best_model is not None
