@@ -41,11 +41,12 @@ def main(quick: bool = False) -> float:
     )).init()
 
     served = []
+    batch = 32
     source = QueueSource()
     pipeline = StreamingPipeline(
         source,
         routes=[TrainRoute(net), ServeRoute(net, lambda x, p: served.append(p))],
-        batch=32,
+        batch=batch,
     ).start()
 
     # producer: stream labeled records in, as a Kafka consumer would
@@ -56,7 +57,7 @@ def main(quick: bool = False) -> float:
         y = np.eye(3, dtype=np.float32)[(x @ w).argmax()]
         source.put(x, y)
     deadline = time.time() + 60
-    while net.iteration < n // 32 and time.time() < deadline:
+    while net.iteration < n // batch and time.time() < deadline:
         pipeline.raise_if_failed()
         time.sleep(0.05)
     pipeline.stop()
