@@ -13,6 +13,7 @@ aggregation across output layers.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -303,8 +304,9 @@ class ComputationGraph:
         for i, arr in enumerate(xs_list + ys_list):
             if int(arr.shape[0]) != num_batches:
                 kind = "input" if i < len(xs_list) else "label"
+                idx = i if i < len(xs_list) else i - len(xs_list)
                 raise ValueError(
-                    f"{kind} array {i % max(len(xs_list), 1)} stages "
+                    f"{kind} array {idx} stages "
                     f"{int(arr.shape[0])} batches, expected {num_batches}"
                 )
         n_steps = int(steps) if steps is not None else num_batches
@@ -315,16 +317,24 @@ class ComputationGraph:
         if fn is None:
             fn = self._build_multi_step(n_steps, num_batches)
             self._multi_step_cache[cache_key] = fn
+        t0 = time.perf_counter()
         (self.params, self.opt_state, self.state, self._rng, losses) = fn(
             self.params, self.opt_state, self.state, self._rng, xs_list, ys_list
         )
         losses = np.asarray(losses)  # host fetch = the sync point
+        elapsed = time.perf_counter() - t0
         self.last_batch_size = int(xs_list[0].shape[1])
-        for loss in losses:
-            self.iteration += 1
-            self._last_loss = loss
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, loss)
+        # see MultiLayerNetwork.fit_on_device: even per-step attribution for
+        # throughput listeners during the tight replay loop
+        self.staged_step_time = elapsed / max(len(losses), 1)
+        try:
+            for loss in losses:
+                self.iteration += 1
+                self._last_loss = loss
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, loss)
+        finally:
+            self.staged_step_time = None
         return losses
 
     def fit(self, data, epochs: int = 1,

@@ -79,32 +79,49 @@ class PerformanceListener(TrainingListener):
     the in-tree measurement hook called out in SURVEY.md §6)."""
 
     supports_staged = True  # wall-clock + score only; staged throughput is
-    #                           attributed to the window's steps evenly
+    #                           attributed to the window's steps evenly via
+    #                           the model's staged_step_time hint (set by
+    #                           fit_on_device during the replay loop, where
+    #                           wall-clock deltas between callbacks are ~0).
+    #                           Per-step time is ACCUMULATED per callback
+    #                           (hint when staged, wall-clock delta when not)
+    #                           so a frequency window spanning a staged/
+    #                           per-batch boundary still sums real time. The
+    #                           first dispatch of a program includes its JIT
+    #                           compile, same as any cold-start interval.
 
     def __init__(self, frequency: int = 1, report_score: bool = False):
         self.frequency = max(1, frequency)
         self.report_score = report_score
         self._last_time: Optional[float] = None
         self._last_iter = 0
+        self._accum = 0.0  # time attributed to steps since the last record
         self.history: List[dict] = []
 
     def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        staged_dt = getattr(model, "staged_step_time", None)
+        if self._last_time is not None:
+            self._accum += staged_dt if staged_dt is not None \
+                else now - self._last_time
+        self._last_time = now
         if iteration % self.frequency:
             return
-        now = time.perf_counter()
-        if self._last_time is not None:
-            dt = now - self._last_time
-            iters = iteration - self._last_iter
+        iters = iteration - self._last_iter
+        if self._last_iter:  # the first qualifying callback only seeds
+            dt = self._accum
             batch = getattr(model, "last_batch_size", None)
             rec = {
                 "iteration": iteration,
                 "batches_per_sec": iters / dt if dt > 0 else float("inf"),
             }
             if batch:
-                rec["samples_per_sec"] = iters * batch / dt
+                rec["samples_per_sec"] = (
+                    iters * batch / dt if dt > 0 else float("inf")
+                )
             if self.report_score:
                 rec["score"] = float(score)
             self.history.append(rec)
             logger.info("perf: %s", rec)
-        self._last_time = now
         self._last_iter = iteration
+        self._accum = 0.0

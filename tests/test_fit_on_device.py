@@ -338,6 +338,30 @@ def test_graph_fit_stage_on_device_equals_plain_fit():
     assert staged.iteration == plain.iteration == 10
 
 
+def test_graph_staged_count_mismatch_names_right_array():
+    """The K-mismatch error must index labels from 0, not ``i % len(inputs)``
+    — a multi-output graph with a bad label 1 used to report 'label array 0'."""
+    conf = (
+        ComputationGraphConfiguration.builder()
+        .seed(1)
+        .updater(UpdaterConfig(updater="adam", learning_rate=1e-2))
+        .add_inputs("in")
+        .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+        .add_layer("out0", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "h")
+        .add_layer("out1", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "h")
+        .set_outputs("out0", "out1")
+        .set_input_types(InputType.feed_forward(5))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(2, 4, 5))]
+    ys = [np.eye(3)[rng.integers(0, 3, (2, 4))],
+          np.eye(2)[rng.integers(0, 2, (3, 4))]]  # stages 3, expected 2
+    with pytest.raises(ValueError, match=r"label array 1 stages 3"):
+        net.fit_on_device(xs, ys)
+
+
 def test_graph_matches_sequential():
     xs, ys = _batches(k=2, seed=5)
     seq = ComputationGraph(_graph_conf()).init()
