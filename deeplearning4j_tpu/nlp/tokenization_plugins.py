@@ -96,26 +96,39 @@ def _split_josa(eojeol: str) -> List[str]:
 
 
 class KoreanTokenizerFactory(TokenizerFactory):
-    """Korean segmentation (reference plugin: KoreanTokenizerFactory over
-    KoreanAnalyzer): whitespace-delimited eojeol with non-hangul script runs
-    split out. ``split_josa=True`` additionally strips trailing josa
-    (postpositions) so '학교에서' becomes stem '학교' + particle '에서'.
-    OPT-IN: the splitter is dictionary-free suffix matching, which also
-    clips nouns whose final syllable coincides with a josa (고양이 →
-    고양+이) — enable it for recall-oriented embedding vocabularies, keep
-    the default eojeol tokens for precision."""
+    """Korean morphological segmentation (reference plugin:
+    KoreanTokenizerFactory over twitter-korean-text,
+    deeplearning4j-nlp-korean/.../KoreanTokenizerFactory.java). Backed by
+    :mod:`deeplearning4j_tpu.nlp.korean` — a jamo-aware lexicon +
+    conjugation expansion + per-eojeol Viterbi lattice, NOT a gated import:
+    agglutinative eojeols split into stem + particles/endings the way the
+    reference's own test pins (라이브러리입니다 → 라이브러리/입니/다), and
+    dictionary nouns beat suffix clipping (고양이가 → 고양이/가).
+
+    ``extra_entries`` extends the lexicon. Legacy modes kept for
+    compatibility: ``script_runs_only=True`` emits whole eojeols (old
+    default); ``split_josa=True`` adds the dictionary-free trailing-josa
+    suffix strip on top of script runs (old opt-in)."""
 
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
-                 split_josa: bool = False):
+                 split_josa: bool = False, script_runs_only: bool = False,
+                 extra_entries=None):
         self.pre_processor = pre_processor
         self.split_josa = split_josa
+        self.script_runs_only = script_runs_only or split_josa
+        if not self.script_runs_only:
+            from .korean import KoreanSegmenter  # noqa: PLC0415
+
+            self._segmenter = KoreanSegmenter(extra_entries)
 
     def create(self, text: str) -> Tokenizer:
-        tokens: List[str] = []
-        for chunk in text.split():
-            for run in _script_runs(chunk):
-                if self.split_josa and _char_class(run[0]) == "hangul":
-                    tokens.extend(_split_josa(run))
-                else:
-                    tokens.append(run)
-        return Tokenizer(tokens, self.pre_processor)
+        if self.script_runs_only:
+            tokens: List[str] = []
+            for chunk in text.split():
+                for run in _script_runs(chunk):
+                    if self.split_josa and _char_class(run[0]) == "hangul":
+                        tokens.extend(_split_josa(run))
+                    else:
+                        tokens.append(run)
+            return Tokenizer(tokens, self.pre_processor)
+        return Tokenizer(self._segmenter.tokenize(text), self.pre_processor)

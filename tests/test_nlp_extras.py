@@ -116,8 +116,45 @@ def test_korean_tokenizer():
     assert "!" not in toks
 
 
+def test_korean_tokenizer_reference_parity():
+    """The reference's own KoreanTokenizerTest sentence and expected tokens
+    (deeplearning4j-nlp-korean/.../KoreanTokenizerTest.java): agglutinative
+    copula split 라이브러리입니다 → 라이브러리/입니/다, loanword compound
+    딥러닝 → 딥/러닝, particle 의 split off."""
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("세계 최초의 상용 수준 오픈소스 딥러닝 라이브러리입니다").get_tokens()
+    assert toks == ["세계", "최초", "의", "상용", "수준", "오픈소스",
+                    "딥", "러닝", "라이브러리", "입니", "다"]
+
+
+def test_korean_segmenter_morphology():
+    from deeplearning4j_tpu.nlp.korean import (
+        KoreanSegmenter, compose, decompose, has_batchim,
+    )
+
+    seg = KoreanSegmenter()
+    # noun + particle + contracted-past stem + ending
+    assert seg.tokenize("학교에서 친구를 만났다") == [
+        "학교", "에서", "친구", "를", "만났", "다"]
+    # dictionary noun beats josa suffix-clipping (고양이 used to clip to
+    # 고양+이 under the dictionary-free splitter)
+    assert seg.tokenize("고양이가 물을 마셨다")[:2] == ["고양이", "가"]
+    # polite-formal: consonant stem + 습니 + 다 (derived, not listed)
+    assert seg.tokenize("책이 있습니다") == ["책", "이", "있습니", "다"]
+    # batchim-aware allomorph scoring uses the jamo math
+    assert has_batchim("책") and not has_batchim("사과"[-1])
+    i, m, f = decompose("한")
+    assert compose(i, m, f) == "한"
+    # POS labels on the lattice output
+    pos = [(mm.surface, mm.pos) for mm in seg.segment("학생입니다")]
+    assert pos == [("학생", "noun"), ("입니", "vpol"), ("다", "eomi")]
+    # lexicon extension seam
+    seg2 = KoreanSegmenter(extra_entries=[("텐서플로", "noun", 2)])
+    assert "텐서플로" in seg2.tokenize("텐서플로를 씁니다")
+
+
 def test_korean_tokenizer_josa_splitting():
-    """Opt-in josa splitting (KoreanAnalyzer analog at the particle level)."""
+    """Legacy opt-in josa splitting (dictionary-free suffix strip)."""
     tf = KoreanTokenizerFactory(split_josa=True)
     toks = tf.create("학교에서 친구를 만났다").get_tokens()
     assert toks[:4] == ["학교", "에서", "친구", "를"]
