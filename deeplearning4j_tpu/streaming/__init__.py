@@ -9,6 +9,7 @@ from .pipeline import (
     StreamingPipeline,
     TrainRoute,
 )
+from .socket_transport import SocketRecordSink, SocketRecordSource, serve_records
 
 __all__ = [
     "KafkaSource",
@@ -16,6 +17,9 @@ __all__ = [
     "QueueSource",
     "RecordSource",
     "ServeRoute",
+    "SocketRecordSink",
+    "SocketRecordSource",
     "StreamingPipeline",
     "TrainRoute",
+    "serve_records",
 ]

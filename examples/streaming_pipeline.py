@@ -2,16 +2,39 @@
 
 Reference example: the camel-kafka streaming pipelines (dl4j-streaming) —
 records flow from a source through micro-batching into a TRAIN route
-(online fit) and a SERVE route (predictions to a sink), concurrently. Here
-the source is the in-process QueueSource; the Kafka source is the same
-`RecordSource` seam with a consumer factory.
+(online fit) and a SERVE route (predictions to a sink), concurrently. Two
+modes:
+
+- default: in-process QueueSource (the 'direct:' Camel route);
+- ``--two-process``: the producer runs as a SEPARATE OS process publishing
+  records over TCP (SocketRecordSink -> SocketRecordSource), which is the
+  reference's Kafka-between-JVMs topology with the broker replaced by the
+  framework's own length-prefixed socket transport.
 """
 
 import argparse
+import os
+import subprocess
+import sys
 import time
 
+_PRODUCER_SNIPPET = """
+import sys
+import numpy as np
+from deeplearning4j_tpu.streaming import SocketRecordSink
 
-def main(quick: bool = False) -> float:
+host, port, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rng = np.random.default_rng(0)
+w = rng.normal(size=(6, 3))
+with SocketRecordSink(host, port) as sink:
+    for _ in range(n):
+        x = rng.normal(size=6).astype(np.float32)
+        sink.put(x, np.eye(3, dtype=np.float32)[(x @ w).argmax()])
+print("PRODUCER_OK", flush=True)
+"""
+
+
+def main(quick: bool = False, two_process: bool = False) -> float:
     import numpy as np
 
     from deeplearning4j_tpu import (
@@ -25,6 +48,7 @@ def main(quick: bool = False) -> float:
     from deeplearning4j_tpu.streaming import (
         QueueSource,
         ServeRoute,
+        SocketRecordSource,
         StreamingPipeline,
         TrainRoute,
     )
@@ -42,20 +66,33 @@ def main(quick: bool = False) -> float:
 
     served = []
     batch = 32
-    source = QueueSource()
+    n = 600 if quick else 3000
+    source = SocketRecordSource() if two_process else QueueSource()
     pipeline = StreamingPipeline(
         source,
         routes=[TrainRoute(net), ServeRoute(net, lambda x, p: served.append(p))],
         batch=batch,
     ).start()
 
-    # producer: stream labeled records in, as a Kafka consumer would
-    n = 600 if quick else 3000
-    for _ in range(n):
-        pipeline.raise_if_failed()  # surface route errors, not "queue full"
-        x = rng.normal(size=6).astype(np.float32)
-        y = np.eye(3, dtype=np.float32)[(x @ w).argmax()]
-        source.put(x, y)
+    if two_process:
+        # producer OS process publishes over TCP (Kafka-producer role)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+                             + os.pathsep + env.get("PYTHONPATH", ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PRODUCER_SNIPPET,
+             source.host, str(source.port), str(n)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0 and "PRODUCER_OK" in out, out[-2000:]
+    else:
+        # producer thread-in-process: stream labeled records in
+        for _ in range(n):
+            pipeline.raise_if_failed()  # surface route errors, not "queue full"
+            x = rng.normal(size=6).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[(x @ w).argmax()]
+            source.put(x, y)
     deadline = time.time() + 60
     while net.iteration < n // batch and time.time() < deadline:
         pipeline.raise_if_failed()
@@ -65,7 +102,8 @@ def main(quick: bool = False) -> float:
     # the online-trained model now classifies the stream's concept
     xt = rng.normal(size=(300, 6)).astype(np.float32)
     acc = float((np.asarray(net.output(xt)).argmax(-1) == (xt @ w).argmax(-1)).mean())
-    print(f"streamed {n} records -> {net.iteration} online steps, "
+    mode = "two-process socket" if two_process else "in-process"
+    print(f"[{mode}] streamed {n} records -> {net.iteration} online steps, "
           f"served {len(served)} prediction batches, accuracy={acc:.3f}")
     return acc
 
@@ -73,4 +111,6 @@ def main(quick: bool = False) -> float:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(ap.parse_args().quick)
+    ap.add_argument("--two-process", action="store_true")
+    args = ap.parse_args()
+    main(args.quick, args.two_process)

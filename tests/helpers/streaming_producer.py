@@ -1,0 +1,26 @@
+"""Producer process for the socket streaming test: publish labelled records
+to a SocketRecordSource across the process boundary (the NDArrayKafkaClient
+role in the reference's Kafka pipeline)."""
+
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming import SocketRecordSink
+
+
+def main() -> int:
+    host, port, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    rng = np.random.default_rng(0)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    feats = (labels @ rng.normal(size=(3, 8))
+             + 0.1 * rng.normal(size=(n, 8))).astype(np.float32)
+    with SocketRecordSink(host, port) as sink:
+        for f, l in zip(feats, labels):
+            sink.put(f, l)
+    print("PRODUCER_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,12 @@ def test_streaming_pipeline_example():
     # (the trailing partial batch may or may not flush before stop())
 
 
+def test_streaming_pipeline_example_two_process():
+    """The producer runs as a separate OS process over the socket transport."""
+    acc = _mod("streaming_pipeline").main(quick=True, two_process=True)
+    assert acc > 0.6
+
+
 def test_early_stopping_example():
     result = _mod("early_stopping").main(quick=True)
     assert result.best_model is not None

@@ -237,6 +237,80 @@ def test_streaming_mixed_label_batches_split():
     assert route.batches == [True, False, True]
 
 
+def test_socket_record_transport_roundtrip():
+    """Records (labelled and not) cross a real TCP socket with shapes and
+    values intact (reference seam: NDArrayKafkaClient -> BaseKafkaPipeline)."""
+    from deeplearning4j_tpu.streaming import SocketRecordSink, SocketRecordSource
+
+    source = SocketRecordSource()
+    try:
+        with SocketRecordSink(source.host, source.port) as sink:
+            sink.put(np.arange(6, dtype=np.float32).reshape(2, 3),
+                     np.ones(3, np.float32))
+            sink.put(np.full((4,), 7.0))
+        got = []
+        deadline = time.time() + 10
+        while len(got) < 2 and time.time() < deadline:
+            rec = source.poll(timeout=0.1)
+            if rec is not None:
+                got.append(rec)
+        assert len(got) == 2
+        np.testing.assert_array_equal(
+            got[0][0], np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_array_equal(got[0][1], np.ones(3, np.float32))
+        assert got[1][0].shape == (4,) and got[1][1] is None
+    finally:
+        source.close()
+
+
+def test_socket_streaming_two_process():
+    """The distributed half of the streaming capability: a SEPARATE OS
+    process publishes records over TCP into this process's online-train and
+    serve routes (reference: Kafka between producer and training JVMs)."""
+    import os
+    import subprocess
+    import sys
+
+    from deeplearning4j_tpu.streaming import (
+        ServeRoute,
+        SocketRecordSource,
+        StreamingPipeline,
+        TrainRoute,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    producer = os.path.join(repo, "tests", "helpers", "streaming_producer.py")
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""      # never let the child touch the TPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    net = _toy_net(lr=0.1)
+    feats, labels = _toy_data(n=96)
+    s0 = net.score(DataSet(feats, labels))
+    served = []
+    source = SocketRecordSource()
+    train = TrainRoute(net)
+    serve = ServeRoute(net, sink=lambda x, y: served.append(y))
+    pipeline = StreamingPipeline(source, [train, serve], batch=32, linger=0.3)
+    with pipeline:
+        proc = subprocess.Popen(
+            [sys.executable, producer, source.host, str(source.port), "96"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo,
+        )
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0 and "PRODUCER_OK" in out, out[-2000:]
+        deadline = time.time() + 30
+        while train.batches_seen < 3 and time.time() < deadline:
+            pipeline.raise_if_failed()
+            time.sleep(0.05)
+    assert train.batches_seen >= 3
+    assert len(served) >= 3 and served[0].shape == (32, 3)
+    assert net.score(DataSet(feats, labels)) < s0  # it actually learned
+
+
 def test_gateway_concurrent_fit_serialized():
     from deeplearning4j_tpu.interop import GatewayClient, GatewayServer
 
