@@ -16,6 +16,7 @@ from .iterators import (
     NumpyDataSetIterator,
     ReconstructionDataSetIterator,
     SamplingDataSetIterator,
+    pad_to_bucket,
 )
 from .records import (
     CollectionRecordReader,
@@ -57,7 +58,7 @@ from .normalizers import (
 
 __all__ = [
     "AsyncDataSetIterator", "AsyncMultiDataSetIterator",
-    "BucketingSequenceIterator", "CombinedPreProcessor", "DataSet",
+    "BucketingSequenceIterator", "CombinedPreProcessor", "DataSet", "pad_to_bucket",
     "DataSetIterator",
     "DevicePrefetchIterator", "ExistingDataSetIterator", "IteratorDataSetIterator",
     "IteratorMultiDataSetIterator",

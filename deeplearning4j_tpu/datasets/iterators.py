@@ -434,6 +434,33 @@ def as_iterator(data) -> Iterable[DataSet]:
     return data
 
 
+def pad_to_bucket(x, boundaries: Sequence[int]):
+    """Pad a [B, T, F] (or [T, F]) sequence batch to the smallest bucket
+    boundary >= T. Returns ``(padded, mask, t)`` where ``mask`` is the
+    [B, bound] (or [bound]) features mask and ``t`` the real length — slice
+    model output with ``out[..., :t, :]``. The streaming companion of
+    :class:`BucketingSequenceIterator`: pass both to ``rnn_time_step`` so a
+    variable-length stream compiles at most ``len(boundaries)`` programs and
+    masked steps hold the recurrent state."""
+    x = np.asarray(x)
+    t_axis = x.ndim - 2
+    t = x.shape[t_axis]
+    bound = next((b for b in sorted(int(b) for b in boundaries) if t <= b),
+                 None)
+    if bound is None:
+        raise ValueError(
+            f"sequence length {t} exceeds the largest bucket "
+            f"{max(boundaries)}; add a larger boundary or truncate"
+        )
+    pad = [(0, 0)] * x.ndim
+    pad[t_axis] = (0, bound - t)
+    padded = np.pad(x, pad)
+    mask_shape = x.shape[:t_axis] + (bound,)
+    mask = np.zeros(mask_shape, dtype=np.float32)
+    mask[..., :t] = 1.0
+    return padded, mask, t
+
+
 class BucketingSequenceIterator(DataSetIterator):
     """Group variable-length sequences into a FIXED set of padded lengths.
 

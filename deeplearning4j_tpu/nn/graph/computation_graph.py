@@ -599,7 +599,7 @@ class ComputationGraph:
                 lst.iteration_done(self, self.iteration, loss)
 
     # ------------------------------------------------------------- streaming
-    def rnn_time_step(self, *inputs):
+    def rnn_time_step(self, *inputs, features_masks=None):
         """Stateful streaming inference (reference: ComputationGraph.rnnTimeStep:1801).
 
         Each input: [batch, features] (one step) or [batch, time, features].
@@ -608,7 +608,9 @@ class ComputationGraph:
 
         XLA shape note: single-step 2-D inputs normalize to [B, 1, F] and
         reuse one traced program; multi-step calls compile once per distinct
-        (batch, T) — bucket T for variable-length streaming.
+        (batch, T) — bucket T for variable-length streaming (pad via
+        ``datasets.iterators.pad_to_bucket`` and pass ``features_masks``;
+        masked steps hold recurrent h/c).
         """
         self.init()
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
@@ -617,6 +619,15 @@ class ComputationGraph:
         single_step = all(x.ndim == 2 for x in xs)
         if single_step:
             xs = [x[:, None, :] for x in xs]
+        if features_masks is not None and not isinstance(
+            features_masks, (list, tuple, dict)
+        ):
+            features_masks = [features_masks]
+        if isinstance(features_masks, (list, tuple)):
+            features_masks = dict(zip(self.conf.network_inputs, features_masks))
+        if features_masks is not None:
+            features_masks = {k: None if m is None else jnp.asarray(m)
+                              for k, m in features_masks.items()}
         batch = int(xs[0].shape[0])
         leaves = (
             jax.tree_util.tree_leaves(self._rnn_state)
@@ -626,12 +637,12 @@ class ComputationGraph:
             self._rnn_state = self._init_rnn_states(batch)
         if self._rnn_step_fn is None:
             self._rnn_step_fn = jax.jit(
-                lambda params, state, rnn, xs: self._forward(
-                    params, xs, state, False, None, None, rnn
+                lambda params, state, rnn, xs, masks: self._forward(
+                    params, xs, state, False, None, masks, rnn
                 )[::2]  # (outs, new_rnn) — per-token dispatch stays on device
             )
         outs, self._rnn_state = self._rnn_step_fn(
-            self.params, self.state, self._rnn_state, xs
+            self.params, self.state, self._rnn_state, xs, features_masks
         )
         if single_step:
             outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]

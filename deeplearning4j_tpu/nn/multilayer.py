@@ -604,7 +604,7 @@ class MultiLayerNetwork:
                 lst.iteration_done(self, self.iteration, loss)
 
     # ------------------------------------------------------------- streaming
-    def rnn_time_step(self, x):
+    def rnn_time_step(self, x, features_mask=None):
         """Stateful streaming inference (reference: MultiLayerNetwork.rnnTimeStep:2163).
 
         ``x``: [batch, features] (one step) or [batch, time, features]. LSTM
@@ -613,13 +613,19 @@ class MultiLayerNetwork:
         XLA shape note: single-step 2-D input is normalized to [B, 1, F] so
         streaming always reuses ONE traced program; multi-step calls compile
         once per distinct (batch, T). For variable-length streaming, bucket T
-        (pad to a few fixed lengths) to bound recompiles.
+        — pad to a few fixed lengths (``datasets.iterators.pad_to_bucket``)
+        and pass ``features_mask`` ([batch, time]): masked steps hold LSTM
+        h/c, so the streaming state after the call is exactly the state
+        after the sequence's REAL steps, and only len(buckets) programs ever
+        compile.
         """
         self.init()
         x = jnp.asarray(x)
         single_step = x.ndim == 2
         if single_step:
             x = x[:, None, :]
+        if features_mask is not None:
+            features_mask = jnp.asarray(features_mask)
         if self._rnn_state is None or (
             jax.tree_util.tree_leaves(self._rnn_state)
             and jax.tree_util.tree_leaves(self._rnn_state)[0].shape[0] != x.shape[0]
@@ -627,12 +633,13 @@ class MultiLayerNetwork:
             self._rnn_state = self._init_rnn_states(x.shape[0])
         if self._rnn_step_fn is None:
             self._rnn_step_fn = jax.jit(
-                lambda params, state, rnn, x: self._forward(
-                    params, x, state, False, None, rnn_state=rnn
+                lambda params, state, rnn, x, mask: self._forward(
+                    params, x, state, False, None, features_mask=mask,
+                    rnn_state=rnn,
                 )[::2]  # (out, new_rnn) — per-token dispatch stays on device
             )
         out, self._rnn_state = self._rnn_step_fn(
-            self.params, self.state, self._rnn_state, x
+            self.params, self.state, self._rnn_state, x, features_mask
         )
         if single_step and out.ndim == 3:
             out = out[:, 0, :]

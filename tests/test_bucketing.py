@@ -1,0 +1,150 @@
+"""Variable-length sequences with bounded recompiles (SURVEY §7 hard part f).
+
+XLA compiles one program per input shape; a ragged NLP corpus naively padded
+to each batch's max length causes a recompile storm. These tests pin the
+mitigation: BucketingSequenceIterator bounds fit() compiles to its
+num_programs() upper bound, and pad_to_bucket + the rnn_time_step mask bound
+streaming-inference compiles to len(boundaries) while keeping the recurrent
+state exactly what the real (unpadded) steps produce.
+"""
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import (
+    GravesLSTM,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    RnnOutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import (
+    BucketingSequenceIterator,
+    pad_to_bucket,
+)
+
+BOUNDS = (8, 16, 32)
+
+
+def _rnn_net(seed=0):
+    conf = MultiLayerConfiguration(
+        layers=[
+            GravesLSTM(n_out=8, activation="tanh"),
+            RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(4),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _ragged_corpus(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(n):
+        t = int(rng.integers(3, 30))
+        feats = rng.normal(size=(t, 4)).astype(np.float32)
+        labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, t)]
+        seqs.append((feats, labels))
+    return seqs
+
+
+def test_bucketing_bounds_fit_compiles():
+    """Two epochs over a 27-distinct-length corpus compile at most
+    num_programs() traces (<= buckets + trailing partials), not one per
+    distinct batch-max length."""
+    seqs = _ragged_corpus()
+    it = BucketingSequenceIterator(seqs, batch=8, boundaries=BOUNDS)
+    net = _rnn_net()
+    net.fit(it, epochs=2)
+    bound = it.num_programs()
+    assert bound <= 2 * len(BOUNDS)
+    compiles = net._train_step._cache_size()
+    assert compiles <= bound, (compiles, bound)
+    distinct_lengths = len({f.shape[0] for f, _ in seqs})
+    assert distinct_lengths > bound  # the storm the iterator prevents
+
+
+def test_bucketing_iterator_masks_and_order():
+    it = BucketingSequenceIterator(_ragged_corpus(), batch=8, boundaries=BOUNDS)
+    seen = 0
+    for ds in it:
+        b, t, f = ds.features.shape
+        assert t in BOUNDS and f == 4
+        assert ds.features_mask.shape == (b, t)
+        assert ds.labels_mask.shape == (b, t)
+        # mask is a prefix run of ones; features zero beyond it
+        for i in range(b):
+            n_real = int(ds.features_mask[i].sum())
+            assert ds.features_mask[i, :n_real].all()
+            assert not ds.features_mask[i, n_real:].any()
+            assert not ds.features[i, n_real:].any()
+        seen += b
+    assert seen == 40
+
+
+def test_pad_to_bucket_streaming_bounds_compiles_and_preserves_state():
+    net = _rnn_net(seed=7)
+    rng = np.random.default_rng(1)
+    for t in (5, 9, 17, 3, 30, 12, 7):
+        x = rng.normal(size=(2, t, 4)).astype(np.float32)
+        xp, mask, real_t = pad_to_bucket(x, BOUNDS)
+        assert real_t == t and xp.shape[1] in BOUNDS
+        out = np.asarray(net.rnn_time_step(xp, features_mask=mask))[:, :t]
+        assert out.shape == (2, t, 3)
+    # one program per touched bucket, regardless of the 7 distinct lengths
+    assert net._rnn_step_fn._cache_size() <= len(BOUNDS)
+
+    # masked padded steps hold h/c: state equals the exact-length run's
+    exact = _rnn_net(seed=7)
+    x = rng.normal(size=(2, 11, 4)).astype(np.float32)
+    exact_out = np.asarray(exact.rnn_time_step(x))
+    exact_state = exact._rnn_state
+
+    net.rnn_clear_previous_state()
+    xp, mask, t = pad_to_bucket(x, BOUNDS)
+    padded_out = np.asarray(net.rnn_time_step(xp, features_mask=mask))[:, :t]
+    np.testing.assert_allclose(padded_out, exact_out, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(exact_state),
+                    jax.tree_util.tree_leaves(net._rnn_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_pad_to_bucket_overlong_raises():
+    x = np.zeros((1, 40, 4), np.float32)
+    try:
+        pad_to_bucket(x, BOUNDS)
+    except ValueError as e:
+        assert "40" in str(e) and "32" in str(e)
+    else:
+        raise AssertionError("expected ValueError for overlong sequence")
+
+
+def test_graph_rnn_time_step_masked_bucketing():
+    from deeplearning4j_tpu.nn.conf.computation_graph import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+    conf = (
+        ComputationGraphConfiguration.builder()
+        .seed(5)
+        .updater(UpdaterConfig(updater="adam", learning_rate=1e-2))
+        .add_inputs("in")
+        .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"), "in")
+        .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                         loss="mcxent"), "lstm")
+        .set_outputs("out")
+        .set_input_types(InputType.recurrent(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(2)
+    for t in (5, 13, 20, 4):
+        x = rng.normal(size=(2, t, 4)).astype(np.float32)
+        xp, mask, real_t = pad_to_bucket(x, BOUNDS)
+        out = np.asarray(net.rnn_time_step(xp, features_masks=mask))[:, :real_t]
+        assert out.shape == (2, t, 3)
+    assert net._rnn_step_fn._cache_size() <= len(BOUNDS)
