@@ -28,9 +28,19 @@ def main() -> None:
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--out", required=True)
-    ap.add_argument("--mode", choices=["sync", "periodic", "sync_localdata"],
+    ap.add_argument("--mode",
+                    choices=["sync", "periodic", "sync_localdata", "dp_tp",
+                             "recovery"],
                     default="periodic")
     ap.add_argument("--local-devices", type=int, default=2)
+    # recovery-mode knobs (checkpoint-restart across a worker death):
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--start-round", type=int, default=0)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--resume-from", default=None)
+    ap.add_argument("--crash-rank", type=int, default=-1)
+    ap.add_argument("--crash-after-round", type=int, default=-1)
+    ap.add_argument("--tag", default="")
     args = ap.parse_args()
 
     import numpy as np
@@ -69,27 +79,40 @@ def main() -> None:
         SyncAllReduceTrainingMaster,
     )
 
+    # recovery mode uses adam so a correct run REQUIRES updater-state-exact
+    # resume (plain SGD would mask a dropped optimizer state)
+    updater = ("adam" if args.mode == "recovery" else "sgd")
     conf = MultiLayerConfiguration(
         layers=[
             DenseLayer(n_out=16, activation="tanh"),
             OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
         ],
         input_type=InputType.feed_forward(6),
-        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        updater=UpdaterConfig(updater=updater, learning_rate=0.1),
         seed=11,
     )
     net = MultiLayerNetwork(conf).init()
 
     # Identical on every process — the broadcast analog. 3 averaging rounds of
-    # n_devices minibatches each.
+    # n_devices minibatches each (recovery: --rounds rounds; dp_tp: global
+    # batches sized for the data-parallel factor).
     rng = np.random.default_rng(99)
-    batches = [
-        DataSet(
-            rng.normal(size=(8, 6)).astype(np.float32),
-            np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=8)],
-        )
-        for _ in range(3 * n_devices)
-    ]
+
+    def mk_batches(count, rows=8):
+        return [
+            DataSet(
+                rng.normal(size=(rows, 6)).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=rows)],
+            )
+            for _ in range(count)
+        ]
+
+    if args.mode == "recovery":
+        batches = mk_batches(args.rounds * n_devices)
+    elif args.mode == "dp_tp":
+        batches = mk_batches(6, rows=8 * (n_devices // 2))
+    else:
+        batches = mk_batches(3 * n_devices)
 
     mesh = make_mesh(n_devices)
     master = None
@@ -115,6 +138,53 @@ def main() -> None:
                 local.append(DataSet(gx[s : s + per_dev], gy[s : s + per_dev]))
         wrapper = ParallelWrapper(net, mesh=mesh, data_is_local=True)
         wrapper.fit(ListDataSetIterator(local))
+    elif args.mode == "dp_tp":
+        # tensor parallelism ACROSS the process boundary: params GSPMD-shard
+        # over the 'model' axis, batch over 'data' — with 2 processes x 2
+        # devices the model axis spans both processes' devices, so the
+        # tensor-parallel collectives ride the inter-process transport
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        dp = n_devices // 2
+        tp_mesh = make_mesh(n_devices, axis_names=("data", "model"),
+                            shape=(dp, 2))
+        wrapper = ParallelWrapper(net, mesh=tp_mesh, model_axis="model")
+        wrapper.fit(ListDataSetIterator(batches))
+        mesh = tp_mesh
+    elif args.mode == "recovery":
+        # checkpoint-restart recovery: one sync averaging round per
+        # execute_training call, checkpoint triple after every round; a
+        # crashing rank dies AFTER round --crash-after-round completes
+        # (mid-training from the job's perspective), the restarted job
+        # resumes from the triple at --start-round
+        from deeplearning4j_tpu import restore_model, write_model
+
+        if args.resume_from:
+            net = restore_model(args.resume_from)
+        master = SyncAllReduceTrainingMaster(mesh=mesh)
+        rep = replicated_sharding(mesh)
+        for r in range(args.start_round, args.rounds):
+            step = batches[r * n_devices : (r + 1) * n_devices]
+            master.execute_training(net, ListDataSetIterator(step))
+            if args.ckpt:
+                # checkpointing a sharded job is COLLECTIVE: every rank
+                # participates in the replicated fetch (a dead peer here
+                # would wedge it — which is exactly why the crash below
+                # happens after the round's checkpoint, like a worker dying
+                # between checkpoints in production), then rank 0 serializes
+                # host values and atomically replaces the per-round triple
+                saved = net.params, net.opt_state, net.state
+                net.params = jax.device_get(jax.device_put(net.params, rep))
+                net.opt_state = jax.device_get(jax.device_put(net.opt_state, rep))
+                if args.process_id == 0:
+                    tmp = f"{args.ckpt}.tmp"
+                    write_model(net, tmp)
+                    os.replace(tmp, f"{args.ckpt}.r{r}.zip")
+                net.params, net.opt_state, net.state = saved
+            if args.process_id == args.crash_rank and r == args.crash_after_round:
+                print(f"WORKER_CRASH pid={args.process_id} round={r}", flush=True)
+                os._exit(17)  # simulated kill -9 mid-training
+        master = None  # stats asserted only for the standard modes
     else:
         master = SyncAllReduceTrainingMaster(mesh=mesh)
     if master is not None:
@@ -131,8 +201,9 @@ def main() -> None:
     loss = float(net._last_loss)
 
     if args.process_id == 0:
-        np.savez(os.path.join(args.out, f"params_{args.mode}_{args.num_processes}p.npz"), **flat)
-        with open(os.path.join(args.out, f"meta_{args.mode}_{args.num_processes}p.json"), "w") as f:
+        stem = f"{args.mode}{args.tag}_{args.num_processes}p"
+        np.savez(os.path.join(args.out, f"params_{stem}.npz"), **flat)
+        with open(os.path.join(args.out, f"meta_{stem}.json"), "w") as f:
             json.dump({"loss": loss, "devices": n_devices,
                        "process_count": jax.process_count()}, f)
     print(f"WORKER_OK pid={args.process_id} loss={loss:.6f}", flush=True)

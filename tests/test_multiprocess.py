@@ -42,14 +42,15 @@ def _worker_env(local_devices: int) -> dict:
 
 
 def _run_cluster(mode: str, num_processes: int, out_dir: str,
-                 local_devices: int = 2, timeout: float = 300.0):
+                 local_devices: int = 2, timeout: float = 300.0,
+                 extra=()):
     port = _free_port()
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER,
              "--process-id", str(i), "--num-processes", str(num_processes),
              "--port", str(port), "--out", out_dir, "--mode", mode,
-             "--local-devices", str(local_devices)],
+             "--local-devices", str(local_devices), *extra],
             env=_worker_env(local_devices),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=REPO,
@@ -120,3 +121,98 @@ def test_per_host_input_pipeline_matches_broadcast(tmp_path):
         np.testing.assert_allclose(
             local_params[k], bcast_params[k], rtol=1e-5, atol=1e-6,
             err_msg=f"param {k}: per-host pipeline diverged from broadcast")
+
+
+def test_three_processes_match_single_process(tmp_path):
+    """Scale the matrix past minimal-viable: 3 OS processes x 2 devices form
+    one 6-device Gloo mesh (non-power-of-2) and match 1 process x 6 devices."""
+    out = str(tmp_path)
+    _run_cluster("sync", num_processes=3, out_dir=out, local_devices=2)
+    _run_cluster("sync", num_processes=1, out_dir=out, local_devices=6)
+
+    mp_params, mp_meta = _load(out, "sync", 3)
+    sp_params, sp_meta = _load(out, "sync", 1)
+    assert mp_meta["process_count"] == 3
+    assert mp_meta["devices"] == sp_meta["devices"] == 6
+    assert set(mp_params) == set(sp_params)
+    for k in sp_params:
+        np.testing.assert_allclose(
+            mp_params[k], sp_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"param {k} diverged between 3-process and 1-process runs")
+
+
+def test_dp_tp_across_process_boundary(tmp_path):
+    """dp x tp where the 'model' axis spans BOTH processes' devices: the
+    GSPMD tensor-parallel collectives cross the process boundary and the
+    result matches the same (2,2) mesh inside one process."""
+    out = str(tmp_path)
+    _run_cluster("dp_tp", num_processes=2, out_dir=out, local_devices=2)
+    _run_cluster("dp_tp", num_processes=1, out_dir=out, local_devices=4)
+
+    mp_params, mp_meta = _load(out, "dp_tp", 2)
+    sp_params, sp_meta = _load(out, "dp_tp", 1)
+    assert mp_meta["process_count"] == 2
+    assert mp_meta["devices"] == sp_meta["devices"] == 4
+    assert set(mp_params) == set(sp_params)
+    for k in sp_params:
+        np.testing.assert_allclose(
+            mp_params[k], sp_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"param {k} diverged between 2-process and 1-process dp x tp")
+
+
+def test_worker_death_checkpoint_restart_matches_uninterrupted(tmp_path):
+    """The recovery story (SURVEY §5.3 — 'can exceed the reference cheaply'):
+    one of 2 workers dies mid-training (os._exit after round 2, the
+    simulated kill -9); the survivor wedges in the next collective and the
+    driver tears the job down; a FRESH cluster restores the checkpoint
+    triple (adam state included) and finishes — final params match the
+    uninterrupted run to all-reduce tolerance."""
+    import time as _time
+
+    out_a = str(tmp_path / "a"); os.makedirs(out_a)
+    out_c = str(tmp_path / "c"); os.makedirs(out_c)
+    ckpt = str(tmp_path / "recovery_ckpt")
+    rounds = ["--rounds", "6"]
+
+    # A: uninterrupted 6 rounds
+    _run_cluster("recovery", num_processes=2, out_dir=out_a, extra=rounds)
+
+    # B: rank 1 dies after round 2's checkpoint; survivor gets torn down
+    port = _free_port()
+    common = [sys.executable, WORKER, "--num-processes", "2",
+              "--port", str(port), "--out", str(tmp_path), "--mode", "recovery",
+              "--local-devices", "2", *rounds, "--ckpt", ckpt]
+    survivor = subprocess.Popen(common + ["--process-id", "0"],
+                                env=_worker_env(2), stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True, cwd=REPO)
+    crasher = subprocess.Popen(common + ["--process-id", "1",
+                                         "--crash-rank", "1",
+                                         "--crash-after-round", "2"],
+                               env=_worker_env(2), stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True, cwd=REPO)
+    crash_out, _ = crasher.communicate(timeout=300)
+    assert crasher.returncode == 17, crash_out[-3000:]
+    assert "WORKER_CRASH pid=1 round=2" in crash_out
+    # wait for round 2's (atomically-replaced) checkpoint, then tear the
+    # survivor down like a failure detector would (it cannot make progress)
+    ckpt_r2 = f"{ckpt}.r2.zip"
+    deadline = _time.time() + 60
+    while not os.path.exists(ckpt_r2) and _time.time() < deadline:
+        _time.sleep(0.2)
+    assert os.path.exists(ckpt_r2), "no round-2 checkpoint before the crash"
+    survivor.kill()
+    survivor.wait()
+
+    # C: fresh cluster restores the triple and trains rounds 3..5
+    _run_cluster("recovery", num_processes=2, out_dir=out_c,
+                 extra=[*rounds, "--start-round", "3",
+                        "--resume-from", ckpt_r2, "--tag", "resumed"])
+
+    a_params, a_meta = _load(out_a, "recovery", 2)
+    c_params, c_meta = _load(out_c, "recoveryresumed", 2)
+    assert a_meta["process_count"] == c_meta["process_count"] == 2
+    assert set(a_params) == set(c_params)
+    for k in a_params:
+        np.testing.assert_allclose(
+            c_params[k], a_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"param {k}: restarted run diverged from uninterrupted")
