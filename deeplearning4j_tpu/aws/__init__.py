@@ -2,11 +2,12 @@
 S3Uploader/S3 readers + EC2 ClusterSetup, SURVEY.md §2.4)."""
 
 from .s3 import BaseS3DataSetIterator, S3Downloader, S3Uploader
-from .provision import ClusterSetup
+from .provision import ClusterSetup, HostProvisioner
 
 __all__ = [
     "BaseS3DataSetIterator",
     "S3Downloader",
     "S3Uploader",
     "ClusterSetup",
+    "HostProvisioner",
 ]

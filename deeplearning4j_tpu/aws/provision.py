@@ -1,36 +1,97 @@
-"""Cluster provisioning (reference: aws/ec2/provision/ClusterSetup.java spins
-up EC2 workers for distributed training).
+"""Cluster provisioning (reference: deeplearning4j-aws ec2/provision/ —
+ClusterSetup.java sizes+launches EC2 workers, HostProvisioner.java SSHes to
+each host to upload artifacts and run commands, and ClusterSetup
+.provisionWorkers fans provisioning threads over the host list).
 
-The TPU-native equivalent provisions TPU slices; this class shells the
-gcloud CLI when present (no cloud SDKs are baked into this image) and
-otherwise raises with the exact command to run — keeping the capability
-surface documented and scriptable rather than silently absent.
+The TPU-native equivalent provisions TPU slices and wires their hosts into
+one ``jax.distributed`` runtime: ``ClusterSetup`` shells the gcloud CLI
+(``create``/``delete``/``describe``/``list_hosts``), ``HostProvisioner``
+runs per-host ssh/scp, and ``launch_distributed`` is the provision →
+``initialize_multihost`` handoff — every host gets the SAME script with its
+``--process-id`` and host 0 as the coordinator, exactly the argument
+contract of :func:`deeplearning4j_tpu.parallel.mesh.initialize_multihost`.
+
+No cloud SDK is baked into this image, so all subprocess entry points
+resolve their binary from PATH at call time (``gcloud_binary`` /
+``ssh_binary`` attributes) — tests install fakes on PATH and exercise the
+full logic; a missing binary raises with the exact command to run manually.
 """
 
 from __future__ import annotations
 
+import json
 import shutil
 import subprocess
-from typing import List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+
+def _run_cmd(cmd: List[str], binary_hint: str) -> str:
+    if shutil.which(cmd[0]) is None:
+        raise RuntimeError(
+            f"{binary_hint} CLI not available; run manually:\n  " + " ".join(cmd)
+        )
+    out = subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out.stdout
+
+
+class HostProvisioner:
+    """Per-host ssh/scp runner (reference: HostProvisioner.java —
+    runRemoteCommand:101, uploadForDeployment:152, uploadAndRun:92)."""
+
+    def __init__(self, host: str, user: Optional[str] = None, port: int = 22,
+                 ssh_binary: str = "ssh", scp_binary: str = "scp",
+                 extra_ssh_args: Sequence[str] = ()):
+        self.host = host
+        self.user = user
+        self.port = int(port)
+        self.ssh_binary = ssh_binary
+        self.scp_binary = scp_binary
+        self.extra_ssh_args = list(extra_ssh_args)
+
+    @property
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def run_remote_command(self, command: str) -> str:
+        cmd = [self.ssh_binary, "-p", str(self.port), *self.extra_ssh_args,
+               self._target, command]
+        return _run_cmd(cmd, self.ssh_binary)
+
+    def upload_for_deployment(self, local_path: str, remote_path: str) -> str:
+        cmd = [self.scp_binary, "-P", str(self.port), *self.extra_ssh_args,
+               local_path, f"{self._target}:{remote_path}"]
+        return _run_cmd(cmd, self.scp_binary)
+
+    def upload_and_run(self, script: str, root_dir: str = "") -> str:
+        """Upload a script and execute it (HostProvisioner.uploadAndRun:92)."""
+        remote = (root_dir.rstrip("/") + "/" if root_dir else "./") + "run.sh"
+        self.upload_for_deployment(script, remote)
+        return self.run_remote_command(f"chmod +x {remote} && {remote}")
 
 
 class ClusterSetup:
     """reference: ec2/provision/ClusterSetup.java (sizing + launch + wiring).
 
     gcloud-backed: ``create()`` provisions a TPU pod slice whose hosts then
-    join one jax.distributed runtime (parallel/mesh.initialize_multihost).
+    join one jax.distributed runtime (parallel/mesh.initialize_multihost);
+    ``provision_workers`` is the thread fan-out of
+    ClusterSetup.provisionWorkers:94.
     """
 
     def __init__(self, name: str, accelerator_type: str = "v5litepod-8",
-                 zone: str = "us-central1-a", version: str = "tpu-ubuntu2204-base"):
+                 zone: str = "us-central1-a",
+                 version: str = "tpu-ubuntu2204-base",
+                 gcloud_binary: str = "gcloud"):
         self.name = name
         self.accelerator_type = accelerator_type
         self.zone = zone
         self.version = version
+        self.gcloud_binary = gcloud_binary
 
     def _command(self, action: str, extra: Optional[List[str]] = None) -> List[str]:
         cmd = [
-            "gcloud", "compute", "tpus", "tpu-vm", action, self.name,
+            self.gcloud_binary, "compute", "tpus", "tpu-vm", action, self.name,
             f"--zone={self.zone}",
         ]
         if action == "create":
@@ -41,13 +102,7 @@ class ClusterSetup:
         return cmd + (extra or [])
 
     def _run(self, action: str, extra: Optional[List[str]] = None) -> str:
-        cmd = self._command(action, extra)
-        if shutil.which("gcloud") is None:
-            raise RuntimeError(
-                "gcloud CLI not available; run manually:\n  " + " ".join(cmd)
-            )
-        out = subprocess.run(cmd, check=True, capture_output=True, text=True)
-        return out.stdout
+        return _run_cmd(self._command(action, extra), "gcloud")
 
     def create(self) -> str:
         return self._run("create")
@@ -57,3 +112,55 @@ class ClusterSetup:
 
     def describe(self) -> str:
         return self._run("describe")
+
+    def list_hosts(self) -> List[str]:
+        """Worker-host addresses of the slice, coordinator (process 0)
+        first — parsed from ``describe --format=json`` networkEndpoints."""
+        raw = self._run("describe", ["--format=json"])
+        info = json.loads(raw)
+        hosts = [ep.get("ipAddress") for ep in info.get("networkEndpoints", [])
+                 if ep.get("ipAddress")]
+        if not hosts:
+            raise RuntimeError(
+                f"describe returned no networkEndpoints for {self.name}: {raw[:500]}"
+            )
+        return hosts
+
+    def provision_workers(self, hosts: Sequence[str], script: str,
+                          user: Optional[str] = None,
+                          ssh_binary: str = "ssh", scp_binary: str = "scp",
+                          max_workers: int = 16) -> Dict[str, str]:
+        """Upload+run ``script`` on every host concurrently (the reference's
+        provisioning thread per worker, ClusterSetup.provisionWorkers:94-121).
+        Returns {host: output}; raises if any host fails."""
+        def one(host: str) -> str:
+            return HostProvisioner(host, user=user, ssh_binary=ssh_binary,
+                                   scp_binary=scp_binary).upload_and_run(script)
+
+        with ThreadPoolExecutor(max_workers=min(max_workers, len(hosts))) as ex:
+            outs = list(ex.map(one, hosts))
+        return dict(zip(hosts, outs))
+
+    def launch_distributed(self, hosts: Sequence[str], train_command: str,
+                           coordinator_port: int = 8476,
+                           user: Optional[str] = None,
+                           ssh_binary: str = "ssh",
+                           max_workers: int = 16) -> Dict[str, str]:
+        """The provision → initialize_multihost handoff: run
+        ``train_command`` on every host with the cluster wiring appended —
+        ``--coordinator host0:port --num-processes N --process-id i`` —
+        the argument contract of parallel/mesh.initialize_multihost (host 0
+        is the coordinator, as the reference wires the driver first)."""
+        coord = f"{hosts[0]}:{coordinator_port}"
+        n = len(hosts)
+
+        def one(idx_host) -> str:
+            i, host = idx_host
+            cmd = (f"{train_command} --coordinator {coord} "
+                   f"--num-processes {n} --process-id {i}")
+            return HostProvisioner(host, user=user,
+                                   ssh_binary=ssh_binary).run_remote_command(cmd)
+
+        with ThreadPoolExecutor(max_workers=min(max_workers, n)) as ex:
+            outs = list(ex.map(one, enumerate(hosts)))
+        return {h: o for h, o in zip(hosts, outs)}

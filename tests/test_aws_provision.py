@@ -1,0 +1,129 @@
+"""Provisioning-tier logic, executed against fake CLIs on PATH (VERDICT
+round-3 task 7): command construction, describe parsing, per-host ssh/scp
+fan-out, and the provision -> initialize_multihost handoff — everything
+short of the real cloud call (reference: ClusterSetup.java,
+HostProvisioner.java)."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from deeplearning4j_tpu.aws import ClusterSetup, HostProvisioner
+
+DESCRIBE_JSON = {
+    "name": "projects/p/locations/z/nodes/pod1",
+    "networkEndpoints": [
+        {"ipAddress": "10.0.0.1", "port": 8470},
+        {"ipAddress": "10.0.0.2", "port": 8470},
+        {"ipAddress": "10.0.0.3", "port": 8470},
+    ],
+}
+
+
+def _install_fake(bin_dir, name, body):
+    path = os.path.join(bin_dir, name)
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n" + body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.fixture
+def fake_clis(tmp_path, monkeypatch):
+    """gcloud/ssh/scp fakes that append their argv to a log file."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "calls.log"
+    _install_fake(
+        str(bin_dir), "gcloud",
+        f'echo "gcloud $@" >> {log}\n'
+        "case \"$*\" in\n"
+        f"  *--format=json*) cat {tmp_path}/describe.json ;;\n"
+        "  *) echo done ;;\n"
+        "esac\n",
+    )
+    _install_fake(str(bin_dir), "ssh", f'echo "ssh $@" >> {log}\necho ran\n')
+    _install_fake(str(bin_dir), "scp", f'echo "scp $@" >> {log}\necho copied\n')
+    with open(tmp_path / "describe.json", "w") as f:
+        json.dump(DESCRIBE_JSON, f)
+    monkeypatch.setenv("PATH", str(bin_dir) + os.pathsep + os.environ["PATH"])
+    return log
+
+
+def _calls(log):
+    return log.read_text().strip().splitlines() if log.exists() else []
+
+
+def test_command_construction_and_missing_binary():
+    cs = ClusterSetup("pod1", accelerator_type="v5litepod-16",
+                      zone="us-east5-b", gcloud_binary="definitely-not-on-path")
+    cmd = cs._command("create")
+    assert cmd[1:6] == ["compute", "tpus", "tpu-vm", "create", "pod1"]
+    assert "--zone=us-east5-b" in cmd
+    assert "--accelerator-type=v5litepod-16" in cmd
+    # a missing CLI raises WITH the manual command, not silently
+    with pytest.raises(RuntimeError, match="tpu-vm create pod1"):
+        cs.create()
+
+
+def test_create_delete_describe_shell_out(fake_clis):
+    cs = ClusterSetup("pod1")
+    assert cs.create().strip() == "done"
+    assert cs.delete().strip() == "done"
+    cs.describe()
+    calls = _calls(fake_clis)
+    assert any("create pod1" in c for c in calls)
+    assert any("delete pod1 --zone=us-central1-a --quiet" in c for c in calls)
+    assert any("describe pod1" in c for c in calls)
+
+
+def test_list_hosts_parses_network_endpoints(fake_clis):
+    hosts = ClusterSetup("pod1").list_hosts()
+    assert hosts == ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+
+
+def test_host_provisioner_upload_and_run(fake_clis, tmp_path):
+    script = tmp_path / "setup.sh"
+    script.write_text("echo hi\n")
+    hp = HostProvisioner("10.0.0.9", user="ubuntu", port=2222)
+    hp.upload_and_run(str(script), root_dir="/opt/dl4j")
+    calls = _calls(fake_clis)
+    scp = next(c for c in calls if c.startswith("scp"))
+    ssh = next(c for c in calls if c.startswith("ssh"))
+    assert "-P 2222" in scp and f"{script}" in scp
+    assert "ubuntu@10.0.0.9:/opt/dl4j/run.sh" in scp
+    assert "-p 2222" in ssh and "ubuntu@10.0.0.9" in ssh
+    assert "chmod +x /opt/dl4j/run.sh && /opt/dl4j/run.sh" in ssh
+
+
+def test_provision_workers_fans_out_to_every_host(fake_clis, tmp_path):
+    script = tmp_path / "setup.sh"
+    script.write_text("echo hi\n")
+    cs = ClusterSetup("pod1")
+    hosts = cs.list_hosts()
+    outs = cs.provision_workers(hosts, str(script), user="ubuntu")
+    assert set(outs) == set(hosts)
+    assert all(o.strip() == "ran" for o in outs.values())
+    calls = _calls(fake_clis)
+    for h in hosts:  # each host saw one scp upload and one ssh run
+        assert sum(f"ubuntu@{h}:" in c for c in calls if c.startswith("scp")) == 1
+        assert sum(f"ubuntu@{h} " in c for c in calls if c.startswith("ssh")) == 1
+
+
+def test_launch_distributed_handoff(fake_clis):
+    """Every host receives the train command + the initialize_multihost
+    wiring: host 0 as coordinator, its own process id, the global count."""
+    cs = ClusterSetup("pod1")
+    hosts = cs.list_hosts()
+    cs.launch_distributed(hosts, "python train.py --epochs 3",
+                          coordinator_port=9999)
+    ssh_calls = [c for c in _calls(fake_clis) if c.startswith("ssh")]
+    assert len(ssh_calls) == 3
+    for i, h in enumerate(hosts):
+        line = next(c for c in ssh_calls if f" {h} " in c)
+        assert "python train.py --epochs 3" in line
+        assert "--coordinator 10.0.0.1:9999" in line
+        assert "--num-processes 3" in line
+        assert f"--process-id {i}" in line
