@@ -54,21 +54,36 @@ class BatchNormalization(BaseLayer):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        # Stats accumulate in >=f32 via ONE fused pass (two independent
+        # reductions, var = E[x^2] - E[x]^2 — the cuDNN formulation) instead
+        # of jnp.mean followed by the dependent jnp.var, which costs a
+        # second full read of the activation tensor per BN per step — on
+        # TPU the conv activations are the HBM-bandwidth budget.
+        stat_dt = jnp.promote_types(x.dtype, jnp.float32)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            xf = x.astype(stat_dt)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.maximum(jnp.mean(jnp.square(xf), axis=axes)
+                              - jnp.square(mean), 0.0)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(stat_dt), state["var"].astype(stat_dt)
             new_state = state
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        # Fold normalization into per-channel scale/offset computed at stat
+        # precision, then do the per-element work in x's dtype: one mul +
+        # one add per element, and f32 running stats never promote the
+        # whole activation tensor (the bf16 eval path used to upcast here).
+        scale = jax.lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xhat = xhat * params["gamma"] + params["beta"]
+            scale = scale * params["gamma"].astype(stat_dt)
+            offset = params["beta"].astype(stat_dt) - mean * scale
         else:
-            xhat = xhat * self.gamma_init + self.beta_init
+            scale = scale * self.gamma_init
+            offset = self.beta_init - mean * scale
+        xhat = x * scale.astype(x.dtype) + offset.astype(x.dtype)
         return self._activate(xhat), new_state
 
 

@@ -1,0 +1,95 @@
+"""Healthy-tunnel probe plan: run the round-4 chip measurements in priority
+order, each in its own bounded TPU child process, appending every result to
+PROBE_RESULTS.jsonl the moment it lands (a later wedge never loses an
+earlier number).
+
+Priorities (VERDICT round-3 tasks 1-2):
+  1. char-RNN row (BASELINE config #3) — the most interesting unmeasured
+     number; default shapes so the metric key matches the baseline store.
+  2. ResNet-50 b128 after the BN rewrite (one-pass f32 stats + folded
+     scale/offset) — directly comparable to the 2,551 img/s round-3 row.
+  3. ResNet-50 b128 with an xplane trace (BENCH_TRACE_DIR) for the MFU
+     analysis the VERDICT asks to commit.
+  4. Batch sweep 64,128,256 — does the declining curve persist post-BN?
+
+Usage: python scripts/tpu_probe_plan.py [--budget-s 5400]
+Stops early after two consecutive wedges (the tunnel is down, not slow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
+
+STEPS = [
+    ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500),
+    ("resnet50_b128", {}, 1200),
+    ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200),
+    ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800),
+]
+
+
+def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
+    env = dict(os.environ)
+    env.update(env_extra)
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--tpu-child"]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        obj["probe_step"] = name
+        obj["elapsed_s"] = round(time.time() - t0, 1)
+        return obj
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-s", type=float, default=5400.0)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated subset of step names")
+    args = ap.parse_args()
+    chosen = ([s for s in STEPS if s[0] in args.steps.split(",")]
+              if args.steps else STEPS)
+    deadline = time.time() + args.budget_s
+    wedges = 0
+    got = 0
+    for name, env_extra, step_timeout in chosen:
+        remaining = deadline - time.time()
+        if remaining < 120:
+            print(f"PLAN: budget exhausted before {name}")
+            break
+        if wedges >= 2:
+            print("PLAN: two consecutive wedges — tunnel is down, stopping")
+            break
+        result = run_step(name, env_extra, min(step_timeout, remaining))
+        if result is None or result.get("metric") == "bench_skip":
+            wedges += 1
+            print(f"PLAN: {name} produced nothing (wedge {wedges})")
+            continue
+        wedges = 0
+        got += 1
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(result) + "\n")
+        print(f"PLAN: {name} -> {result.get('metric')}="
+              f"{result.get('value')} {result.get('unit', '')}")
+    print(f"PLAN: done, {got} results in {RESULTS}")
+    return 0 if got else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
