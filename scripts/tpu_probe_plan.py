@@ -31,6 +31,11 @@ RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
 STEPS = [
     ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500),
     ("resnet50_b128", {}, 1200),
+    ("charrnn_fused", {"BENCH_MODEL": "charrnn", "DL4J_TPU_PALLAS": "1"}, 1200),
+    # ^ scan-body math is the measured default (ops/__init__.py
+    #   lstm_helper_enabled: 3.3 vs 4.5 ms/step at B=128,H=256 on v5e);
+    #   this step re-checks the fused Pallas cell at the bench shape
+    #   (B=64,H=512) so BASELINE.md can carry both numbers
     ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200),
     ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800),
 ]

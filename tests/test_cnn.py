@@ -149,6 +149,28 @@ class TestGradients:
         )
         assert ok, f"{failures} BN failures, max rel {max_rel:.3g}"
 
+    def test_batchnorm_bf16_stays_bf16(self):
+        """f32 running stats must not promote the activation tensor: the
+        per-channel scale/offset fold keeps eval AND train elementwise work
+        in the compute dtype (a bf16 eval pass used to silently upcast the
+        whole NHWC tensor to f32 — pure HBM waste on TPU)."""
+        import jax.numpy as jnp
+
+        bn = BatchNormalization()
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+        it = IT.convolutional(8, 8, 4)
+        import jax
+
+        params = bn.init_params(jax.random.PRNGKey(0), it)
+        state = bn.init_state(it)  # f32/f64 running stats
+        x = jnp.ones((2, 8, 8, 4), jnp.bfloat16)
+        for train in (False, True):
+            y, new_state = bn.apply(params, x, state, train=train)
+            assert y.dtype == jnp.bfloat16, (train, y.dtype)
+            # running stats keep their high precision
+            assert new_state["mean"].dtype == state["mean"].dtype
+
     def test_lrn(self):
         x, y = image_data(c=6, seed=4)
         net = build(
