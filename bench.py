@@ -174,12 +174,27 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     losses = np.asarray(losses)  # host fetch = sync
     dt = time.perf_counter() - t0
     assert np.all(np.isfinite(losses)), "non-finite losses"
-    return {
+    # per-step FLOPs from the already-compiled scan program (cache hit —
+    # same rules as bench_resnet50: nothing compiles between warmup and the
+    # timed run; cost analysis counts the scan body once = per-step)
+    from deeplearning4j_tpu import profiler
+
+    flops_per_step = profiler.compiled_flops(
+        multi, p, o, s, key, xs, ys, None, None)
+    step_s = dt / steps
+    result = {
         "metric": "char_rnn_train_chars_per_sec",
         "value": round(steps * batch * seq / dt, 1),
         "unit": "chars/sec",
         "timed_steps": steps,
+        "step_ms": round(1000 * step_s, 3),
     }
+    if flops_per_step:
+        if profiler.mfu(flops_per_step, step_s) > 100.0:
+            flops_per_step /= steps
+        result["flops_per_step"] = flops_per_step
+        result["mfu_pct"] = round(profiler.mfu(flops_per_step, step_s), 1)
+    return result
 
 
 def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
