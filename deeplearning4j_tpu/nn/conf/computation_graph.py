@@ -41,6 +41,9 @@ class ComputationGraphConfiguration:
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
+    # per-vertex jax.checkpoint rematerialization (see
+    # MultiLayerConfiguration.remat): HBM for FLOPs at memory-bound batches
+    remat: bool = False
 
     # ------------------------------------------------------------- topo order
     def topological_order(self) -> List[str]:
@@ -108,6 +111,7 @@ class ComputationGraphConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
+            "remat": self.remat,
         }
 
     def to_json(self) -> str:
@@ -127,6 +131,7 @@ class ComputationGraphConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
+            remat=d.get("remat", False),
         )
 
     @staticmethod
@@ -187,6 +192,10 @@ class GraphBuilder:
 
     def dtype(self, dtype: str) -> "GraphBuilder":
         self._conf.dtype = dtype
+        return self
+
+    def remat(self, enabled: bool = True) -> "GraphBuilder":
+        self._conf.remat = enabled
         return self
 
     def tbptt(self, fwd_length: int, back_length: Optional[int] = None) -> "GraphBuilder":

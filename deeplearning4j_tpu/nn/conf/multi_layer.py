@@ -36,6 +36,10 @@ class MultiLayerConfiguration:
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
+    # per-layer jax.checkpoint rematerialization: backward recomputes each
+    # layer's internals from its input instead of storing them — HBM for
+    # FLOPs, for batch sizes that are otherwise memory-bound on TPU
+    remat: bool = False
     # per-layer-index input preprocessors (reference: nn/conf/preprocessor/*);
     # stored as {"idx": {"@type": ...}} in JSON
     preprocessors: Dict[int, object] = field(default_factory=dict)
@@ -70,6 +74,7 @@ class MultiLayerConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
+            "remat": self.remat,
             "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
         }
 
@@ -89,6 +94,7 @@ class MultiLayerConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
+            remat=d.get("remat", False),
             preprocessors={
                 int(k): preprocessor_from_dict(v)
                 for k, v in (d.get("preprocessors") or {}).items()

@@ -156,6 +156,15 @@ class ComputationGraph:
                 acts[name], new_rnn[name] = vertex.apply_seq(
                     params[name], ins, new_rnn[name], train=train, rng=r, masks=vmasks
                 )
+            elif train and conf.remat:
+                # per-vertex jax.checkpoint: keep only vertex-boundary
+                # activations for backward (see MultiLayerConfiguration.remat)
+                def _ck(p_, ins_, st_, r_, m_, _v=vertex):
+                    return _v.apply(p_, ins_, st_, train=True, rng=r_, masks=m_)
+
+                acts[name], new_state[name] = jax.checkpoint(_ck)(
+                    params[name], ins, state[name], r, vmasks
+                )
             else:
                 acts[name], new_state[name] = vertex.apply(
                     params[name], ins, state[name], train=train, rng=r, masks=vmasks

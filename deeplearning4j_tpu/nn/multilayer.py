@@ -199,6 +199,20 @@ class MultiLayerNetwork:
                 x, new_rnn[i] = layers[i].apply_seq(
                     params[i], x, new_rnn[i], mask=features_mask, train=train, rng=rngs[i]
                 )
+            elif train and self.conf.remat:
+                # per-layer rematerialization (jax.checkpoint): keep only
+                # layer-boundary activations for the backward pass and
+                # recompute each layer's internals — HBM for FLOPs, the
+                # standard TPU trade at memory-bound batch sizes
+                layer = layers[i]
+
+                def _ck(p_, x_, st_, rng_, m_, _layer=layer):
+                    return _layer.apply(p_, x_, st_, train=True, rng=rng_,
+                                        mask=m_)
+
+                x, new_state[i] = jax.checkpoint(_ck)(
+                    params[i], x, state[i], rngs[i], features_mask
+                )
             else:
                 x, new_state[i] = layers[i].apply(
                     params[i], x, state[i], train=train, rng=rngs[i], mask=features_mask
