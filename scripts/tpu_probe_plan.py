@@ -38,6 +38,9 @@ STEPS = [
     #   (B=64,H=512) so BASELINE.md can carry both numbers
     ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200),
     ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800),
+    ("sweep_remat", {"BENCH_SWEEP": "256,512", "BENCH_REMAT": "1"}, 1800),
+    # ^ if the declining batch curve is HBM pressure, per-vertex
+    #   jax.checkpoint should flatten it at 256/512
 ]
 
 
