@@ -30,6 +30,11 @@ RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
 
 STEPS = [
     ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500),
+    ("charrnn_small", {"BENCH_MODEL": "charrnn", "BENCH_SEQ": "128",
+                       "BENCH_STEPS": "10"}, 900),
+    # ^ much cheaper nested-scan compile: if this lands where the default
+    #   shape wedged, the tunnel was healthy and the default compile is the
+    #   bottleneck (round-3 lesson) — metric key carries the shape suffix
     ("resnet50_b128", {}, 1200),
     ("charrnn_fused", {"BENCH_MODEL": "charrnn", "DL4J_TPU_PALLAS": "1"}, 1200),
     # ^ scan-body math is the measured default (ops/__init__.py
