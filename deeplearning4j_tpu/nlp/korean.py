@@ -190,6 +190,9 @@ _CONN: Dict[Tuple[str, str], int] = {
     (VPOL, EOMI): -4,   # 입니+다
     (JOSA, JOSA): 1,    # 에서+는 is legal but rarer than one josa
     (JOSA, EOMI): 4, (JOSA, AUX): 4, (NOUN, EOMI): 2, (NOUN, AUX): 2,
+    (JOSA, ADV): 3, (JOSA, NOUN): 2,  # eojeol-INTERNAL word after a josa is
+    #                                    rare; without this, 책이다 parses as
+    #                                    책+이(josa)+다(adv) over the copula
     (NOUN, NOUN): 1,    # compounds allowed, whole-word entries preferred
     (EOMI, EOMI): 2, (EOMI, JOSA): 1,  # 먹었다+고, ending then quotative
     (INTERJ, EOMI): 1, (ADV, JOSA): 1,
@@ -281,34 +284,36 @@ class KoreanSegmenter:
     def _segment_eojeol(self, text: str, offset: int) -> List[Morpheme]:
         n = len(text)
         INF = float("inf")
-        best = [INF] * (n + 1)
-        back: List[Optional[Tuple[int, str, str]]] = [None] * (n + 1)
-        best_pos = [""] * (n + 1)
-        best[0] = 0.0
+        # DP state is (position, POS of the last morpheme): connection costs
+        # are POS-dependent, so one best-path per position is NOT Viterbi —
+        # it drops the globally-optimal copula parse of 책이다 (the josa
+        # path into position 2 is locally cheaper but 이(josa)+다 is worse
+        # than 이(copula)+다 overall).
+        best: List[dict] = [dict() for _ in range(n + 1)]
+        back: List[dict] = [dict() for _ in range(n + 1)]
+        best[0][""] = 0.0
         for i in range(n):
-            if best[i] == INF:
+            if not best[i]:
                 continue
-            prev = best_pos[i]
-            for surf, pos, wcost in self._candidates(text, i):
-                j = i + len(surf)
-                cost = best[i] + wcost + self._conn(text, i, prev, surf, pos)
-                if j == n:
-                    cost += _END_COST.get(pos, 0)
-                if cost < best[j]:
-                    best[j] = cost
-                    back[j] = (i, surf, pos)
-                    best_pos[j] = pos
+            cands = self._candidates(text, i)
+            for prev, base in best[i].items():
+                for surf, pos, wcost in cands:
+                    j = i + len(surf)
+                    cost = base + wcost + self._conn(text, i, prev, surf, pos)
+                    if j == n:
+                        cost += _END_COST.get(pos, 0)
+                    if cost < best[j].get(pos, INF):
+                        best[j][pos] = cost
+                        back[j][pos] = (i, prev, surf)
         out: List[Morpheme] = []
+        if not best[n]:  # unreachable (shouldn't happen): whole run unknown
+            return [Morpheme(text, UNK, offset)]
+        pos = min(best[n], key=best[n].get)
         j = n
         while j > 0:
-            step = back[j]
-            if step is None:  # unreachable (shouldn't happen): emit raw char
-                out.append(Morpheme(text[j - 1], UNK, offset + j - 1))
-                j -= 1
-                continue
-            i, surf, pos = step
+            i, prev, surf = back[j][pos]
             out.append(Morpheme(surf, pos, offset + i))
-            j = i
+            j, pos = i, prev
         out.reverse()
         return out
 

@@ -50,6 +50,7 @@ class SocketRecordSource(RecordSource):
         self._server.settimeout(0.2)
         self.host, self.port = self._server.getsockname()[:2]
         self._readers: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="record-source-accept"
         )
@@ -64,10 +65,26 @@ class SocketRecordSource(RecordSource):
                 continue
             except OSError:  # closed under us during shutdown
                 return
+            self._conns.append(conn)  # close() closes these to unblock recv
             t = threading.Thread(target=self._read_loop, args=(conn,),
                                  daemon=True, name="record-source-reader")
             t.start()
             self._readers.append(t)
+
+    @staticmethod
+    def _shaped(arr, shape) -> "np.ndarray":
+        """Protocol check: a size/shape mismatch is a framing error from a
+        buggy or version-skewed producer — drop the CONNECTION loudly, not
+        the reader thread silently."""
+        expected = 1
+        for d in shape:
+            expected *= int(d)
+        if arr.size != expected:
+            raise ConnectionError(
+                f"record frame mismatch: payload {arr.size} elements, "
+                f"header shape {shape}"
+            )
+        return arr.reshape(shape)
 
     def _read_loop(self, conn: socket.socket) -> None:
         try:
@@ -76,18 +93,20 @@ class SocketRecordSource(RecordSource):
                     header = recv_json_frame(conn)
                     if header is None:  # orderly close from the producer
                         return
-                    feats = recv_array(conn).reshape(header["f"])
+                    feats = self._shaped(recv_array(conn), header["f"])
                     label = None
                     if header.get("l") is not None:
-                        label = recv_array(conn).reshape(header["l"])
+                        label = self._shaped(recv_array(conn), header["l"])
                     while not self._stop.is_set():
                         try:
                             self._q.put((feats, label), timeout=0.2)
                             break
                         except queue.Full:
                             continue
-        except ConnectionError:
-            return  # dropped producer: its records up to the break survive
+        except (ConnectionError, OSError):
+            # dropped/misbehaving producer (or close() closed the socket
+            # under us): records delivered before the break survive
+            return
 
     # -- RecordSource --------------------------------------------------
     def poll(self, timeout: float = 0.1):
@@ -102,6 +121,15 @@ class SocketRecordSource(RecordSource):
             self._server.close()
         except OSError:
             pass
+        for c in self._conns:  # unblocks readers parked in recv: close()
+            try:                # alone does not wake a blocked recv — the
+                c.shutdown(socket.SHUT_RDWR)  # FIN/reset from shutdown does
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         self._accept_thread.join(timeout=5)
         for t in self._readers:
             t.join(timeout=5)

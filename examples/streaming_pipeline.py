@@ -21,15 +21,14 @@ import time
 _PRODUCER_SNIPPET = """
 import sys
 import numpy as np
-from deeplearning4j_tpu.streaming import SocketRecordSink
+from deeplearning4j_tpu.streaming import serve_records
 
 host, port, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 rng = np.random.default_rng(0)
 w = rng.normal(size=(6, 3))
-with SocketRecordSink(host, port) as sink:
-    for _ in range(n):
-        x = rng.normal(size=6).astype(np.float32)
-        sink.put(x, np.eye(3, dtype=np.float32)[(x @ w).argmax()])
+xs = rng.normal(size=(n, 6)).astype(np.float32)
+ys = np.eye(3, dtype=np.float32)[(xs @ w).argmax(-1)]
+serve_records(host, port, list(zip(xs, ys)))
 print("PRODUCER_OK", flush=True)
 """
 

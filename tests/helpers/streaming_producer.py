@@ -6,7 +6,7 @@ import sys
 
 import numpy as np
 
-from deeplearning4j_tpu.streaming import SocketRecordSink
+from deeplearning4j_tpu.streaming import serve_records
 
 
 def main() -> int:
@@ -15,9 +15,7 @@ def main() -> int:
     labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
     feats = (labels @ rng.normal(size=(3, 8))
              + 0.1 * rng.normal(size=(n, 8))).astype(np.float32)
-    with SocketRecordSink(host, port) as sink:
-        for f, l in zip(feats, labels):
-            sink.put(f, l)
+    serve_records(host, port, list(zip(feats, labels)))
     print("PRODUCER_OK")
     return 0
 

@@ -148,6 +148,12 @@ def test_korean_segmenter_morphology():
     # POS labels on the lattice output
     pos = [(mm.surface, mm.pos) for mm in seg.segment("학생입니다")]
     assert pos == [("학생", "noun"), ("입니", "vpol"), ("다", "eomi")]
+    # per-(position, POS) DP: the plain copula 'X이다' must parse as
+    # noun + copula-stem + ending, not noun + josa + adv (a single best-path
+    # per position used to drop the globally-optimal copula parse)
+    for word in ("책이다", "학생이다", "물이다"):
+        tagged = [(mm.surface, mm.pos) for mm in seg.segment(word)]
+        assert tagged[1:] == [("이", "vstem"), ("다", "eomi")], (word, tagged)
     # lexicon extension seam
     seg2 = KoreanSegmenter(extra_entries=[("텐서플로", "noun", 2)])
     assert "텐서플로" in seg2.tokenize("텐서플로를 씁니다")

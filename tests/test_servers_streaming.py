@@ -263,6 +263,57 @@ def test_socket_record_transport_roundtrip():
         source.close()
 
 
+def test_socket_source_close_unblocks_idle_readers():
+    """close() must close accepted connections so readers parked in recv
+    exit promptly — an idle-but-connected producer used to cost a 5s join
+    timeout and leak the reader thread."""
+    from deeplearning4j_tpu.streaming import SocketRecordSink, SocketRecordSource
+
+    source = SocketRecordSource()
+    sink = SocketRecordSink(source.host, source.port)
+    try:
+        deadline = time.time() + 5
+        while not source._readers and time.time() < deadline:
+            time.sleep(0.02)  # wait for the accept
+        assert source._readers
+        t0 = time.time()
+        source.close()
+        assert time.time() - t0 < 2.0, "close() stalled on an idle reader"
+        for t in source._readers:
+            assert not t.is_alive(), "reader thread leaked past close()"
+    finally:
+        sink.close()
+
+
+def test_socket_source_malformed_record_drops_connection():
+    """A header/payload size mismatch is a framing error: the connection is
+    dropped (loudly, as a protocol error) and later well-formed producers
+    still work — the reader thread must not die silently mid-protocol."""
+    import socket as socket_mod
+    import struct
+
+    from deeplearning4j_tpu.streaming import SocketRecordSink, SocketRecordSource
+
+    source = SocketRecordSource()
+    try:
+        bad = socket_mod.create_connection((source.host, source.port), timeout=10)
+        header = b'{"f": [2, 3], "l": null}'
+        bad.sendall(struct.pack(">I", len(header)) + header)
+        payload = np.ones(5, np.float32).tobytes()  # 5 != 2*3
+        bad.sendall(struct.pack(">Q", len(payload)) + payload)
+        assert source.poll(timeout=1.0) is None  # bad record never surfaces
+        bad.close()
+        with SocketRecordSink(source.host, source.port) as sink:
+            sink.put(np.arange(6, dtype=np.float32).reshape(2, 3))
+        deadline = time.time() + 10
+        rec = None
+        while rec is None and time.time() < deadline:
+            rec = source.poll(timeout=0.1)
+        assert rec is not None and rec[0].shape == (2, 3)
+    finally:
+        source.close()
+
+
 def test_socket_streaming_two_process():
     """The distributed half of the streaming capability: a SEPARATE OS
     process publishes records over TCP into this process's online-train and
