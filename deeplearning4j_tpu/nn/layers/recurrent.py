@@ -66,6 +66,25 @@ def _lstm_scan(
     # One big MXU matmul for every timestep's input projection.
     xw = x @ W + b  # [B, T, 4H]
     xw_t = jnp.swapaxes(xw, 0, 1)  # [T, B, 4H] time-major for scan
+    from ... import ops as _ops0  # noqa: PLC0415
+    from ...nn.activations import is_builtin as _is_builtin  # noqa: PLC0415
+
+    if (
+        mask is None and not reverse
+        and act_name is not None and gate_name is not None
+        and _ops0.lstm_sequence_enabled()
+        and _ops0.supported_lstm_activations(act_name.lower(), gate_name.lower())
+        and _is_builtin(act_name) and _is_builtin(gate_name)
+        and _ops0.sequence_fits(x.shape[0], H, xw.dtype.itemsize)
+    ):
+        # whole-loop fusion: h/c carries live in VMEM across the time grid
+        # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence)
+        from ...ops.pallas_kernels import fused_lstm_sequence  # noqa: PLC0415
+
+        ys, h_f, c_f = fused_lstm_sequence(
+            xw_t, h0, c0, RW, pF, pI, pO, act_name.lower(), gate_name.lower()
+        )
+        return jnp.swapaxes(ys, 0, 1), h_f, c_f
     if mask is not None:
         mask_t = jnp.swapaxes(mask.astype(xw.dtype), 0, 1)[..., None]  # [T, B, 1]
     else:

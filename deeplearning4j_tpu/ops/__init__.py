@@ -65,6 +65,21 @@ def lstm_helper_enabled() -> bool:
     return os.environ.get("DL4J_TPU_PALLAS") == "1"
 
 
+def lstm_sequence_enabled() -> bool:
+    """The time-fused whole-sequence kernel (fused_lstm_sequence): grid over
+    T with h/c carried in VMEM scratch — the multi-step fusion the cell
+    docstring anticipates. Opt-in with DL4J_TPU_PALLAS=seq until measured
+    on hardware (probe step charrnn_seqfused); the measured winner becomes
+    the default."""
+    return os.environ.get("DL4J_TPU_PALLAS") == "seq"
+
+
+def sequence_fits(B: int, H: int, itemsize: int) -> bool:
+    from .pallas_kernels import _seq_fits  # noqa: PLC0415
+
+    return _seq_fits(B, H, itemsize)
+
+
 def lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
               act_name: str = "tanh", gate_name: str = "sigmoid"):
     """One LSTM step (h, c). Pallas-fused when available, XLA otherwise."""

@@ -287,3 +287,227 @@ def _lrn_bwd(k, n, alpha, beta, residuals, g):
 
 
 fused_lrn.defvjp(_lrn_fwd, _lrn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# time-fused LSTM sequence — the cuDNN "fused LSTM" analog
+# ---------------------------------------------------------------------------
+#
+# The per-step fused cell above loses to XLA's scan on TPU because its custom
+# VJP spills 7 residual arrays to HBM every step. This kernel fuses the WHOLE
+# time loop instead: grid=(T,) executes sequentially on TPU, h/c live in VMEM
+# scratch across grid steps, RW stays VMEM-resident, and only the 5 residual
+# tensors cuDNN also reserves (gate activations + cell state) stream out —
+# c_{t-1}/h_{t-1} are re-read in the backward via shifted block indices
+# rather than stored twice. Select with DL4J_TPU_PALLAS=seq (measured winner
+# becomes the default).
+
+_SEQ_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _seq_fits(B: int, H: int, itemsize: int) -> bool:
+    resident = H * 4 * H * itemsize + 2 * B * H * 4  # RW + f32-ish carries
+    streamed = 2 * (B * 4 * H + 7 * B * H) * itemsize  # double-buffered blocks
+    return resident + streamed < _SEQ_VMEM_BUDGET_BYTES
+
+
+def _seq_fwd_kernel(act, gate,
+                    zx_ref, h0_ref, c0_ref, rw_ref, pf_ref, pi_ref, po_ref,
+                    y_out, a_out, f_out, o_out, i_out, c_out, hT_out, cT_out,
+                    h_scr, c_scr):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h, c, a, f, o, i, _cact = _cell_math(
+        zx_ref[0], h_scr[:], c_scr[:], rw_ref[:],
+        pf_ref[:], pi_ref[:], po_ref[:], act, gate,
+    )
+    y_out[0], a_out[0], f_out[0], o_out[0], i_out[0], c_out[0] = h, a, f, o, i, c
+    h_scr[:], c_scr[:] = h, c
+    # constant-index outputs: written every step, the last write is h_T/c_T
+    hT_out[:], cT_out[:] = h, c
+
+
+def _seq_bwd_kernel(act, dact, dgate, T,
+                    dy_ref, dhT_ref, dcT_ref,
+                    a_ref, f_ref, o_ref, i_ref, c_ref, cprev_ref, hprev_ref,
+                    rw_ref, pf_ref, pi_ref, po_ref, h0_ref, c0_ref,
+                    dzx_out, dh0_out, dc0_out, drw_out, dpf_out, dpi_out,
+                    dpo_out,
+                    dh_scr, dc_scr, drw_scr, dpf_scr, dpi_scr, dpo_scr):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    k = pl.program_id(0)          # reverse-time grid: time t = T-1-k
+
+    @pl.when(k == 0)
+    def _init():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+        drw_scr[:] = jnp.zeros(drw_scr.shape, drw_scr.dtype)
+        dpf_scr[:] = jnp.zeros(dpf_scr.shape, dpf_scr.dtype)
+        dpi_scr[:] = jnp.zeros(dpi_scr.shape, dpi_scr.dtype)
+        dpo_scr[:] = jnp.zeros(dpo_scr.shape, dpo_scr.dtype)
+
+    a, f, o, i, c = a_ref[0], f_ref[0], o_ref[0], i_ref[0], c_ref[0]
+    first = k == T - 1            # t == 0: previous state is the initial one
+    c_prev = jnp.where(first, c0_ref[:], cprev_ref[0])
+    h_prev = jnp.where(first, h0_ref[:], hprev_ref[0])
+    cact = act(c)                 # recomputed, not stored (VPU-cheap)
+    pF, pI, pO = pf_ref[:], pi_ref[:], po_ref[:]
+
+    dh = dy_ref[0] + dh_scr[:]
+    dc = dc_scr[:]
+    do = dh * cact * dgate(o)
+    dc_tot = dc + dh * o * dact(cact) + do * pO
+    df = dc_tot * c_prev * dgate(f)
+    di = dc_tot * a * dgate(i)
+    da = dc_tot * i * dact(a)
+    dzx = jnp.concatenate([da, df, do, di], axis=-1)
+    dzx_out[0] = dzx
+    dh_scr[:] = jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
+    dc_scr[:] = dc_tot * f + df * pF + di * pI
+    f32 = drw_scr.dtype
+    drw_scr[:] += jnp.dot(h_prev.T, dzx, preferred_element_type=f32)
+    dpf_scr[:] += jnp.sum(df * c_prev, axis=0, dtype=f32)[None]
+    dpi_scr[:] += jnp.sum(di * c_prev, axis=0, dtype=f32)[None]
+    dpo_scr[:] += jnp.sum(do * c, axis=0, dtype=f32)[None]
+    # constant-index outputs: last (t==0) write carries the full sums
+    dt = dzx.dtype
+    dh0_out[:] = dh_scr[:]
+    dc0_out[:] = dc_scr[:]
+    drw_out[:] = drw_scr[:].astype(dt)
+    dpf_out[:] = dpf_scr[0].astype(dt)
+    dpi_out[:] = dpi_scr[0].astype(dt)
+    dpo_out[:] = dpo_scr[0].astype(dt)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def fused_lstm_sequence(zx, h0, c0, RW, pF, pI, pO,
+                        act_name: str = "tanh", gate_name: str = "sigmoid"):
+    """Whole-sequence fused LSTM: ``zx`` [T, B, 4H] (precomputed x@W + b),
+    returns (ys [T, B, H], h_T, c_T). Unmasked, forward-direction."""
+    ys, _a, _f, _o, _i, _c, hT, cT = _seq_fwd_impl(
+        zx, h0, c0, RW, pF, pI, pO, act_name, gate_name)
+    return ys, hT, cT
+
+
+def _seq_fwd_impl(zx, h0, c0, RW, pF, pI, pO, act_name, gate_name):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    act, _ = _ACT[act_name]
+    gate, _ = _ACT[gate_name]
+    T, B, H4 = zx.shape
+    H = H4 // 4
+    dt = zx.dtype
+    step = lambda t: (t, 0, 0)  # noqa: E731
+    const3 = lambda t: (0, 0)   # noqa: E731
+    seq_spec = lambda w: pl.BlockSpec((1, B, w), step)  # noqa: E731
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, H), dt),  # ys
+        *[jax.ShapeDtypeStruct((T, B, H), dt) for _ in range(5)],  # a f o i c
+        jax.ShapeDtypeStruct((B, H), dt),     # hT
+        jax.ShapeDtypeStruct((B, H), dt),     # cT
+    )
+    return pl.pallas_call(
+        functools.partial(_seq_fwd_kernel, act, gate),
+        grid=(T,),
+        in_specs=[
+            seq_spec(H4),
+            pl.BlockSpec((B, H), const3),
+            pl.BlockSpec((B, H), const3),
+            pl.BlockSpec((H, H4), const3),
+            pl.BlockSpec((H,), lambda t: (0,)),
+            pl.BlockSpec((H,), lambda t: (0,)),
+            pl.BlockSpec((H,), lambda t: (0,)),
+        ],
+        out_specs=(
+            seq_spec(H), seq_spec(H), seq_spec(H), seq_spec(H), seq_spec(H),
+            seq_spec(H),
+            pl.BlockSpec((B, H), const3),
+            pl.BlockSpec((B, H), const3),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=_interpret(),
+    )(zx, h0, c0, RW, pF, pI, pO)
+
+
+def _seq_fwd(zx, h0, c0, RW, pF, pI, pO, act_name, gate_name):
+    ys, a, f, o, i, c, hT, cT = _seq_fwd_impl(
+        zx, h0, c0, RW, pF, pI, pO, act_name, gate_name
+    )
+    residuals = (ys, a, f, o, i, c, h0, c0, RW, pF, pI, pO)
+    return (ys, hT, cT), residuals
+
+
+def _seq_bwd(act_name, gate_name, residuals, grads):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    ys, a, f, o, i, c, h0, c0, RW, pF, pI, pO = residuals
+    dys, dhT, dcT = grads
+    act, dact = _ACT[act_name]
+    _, dgate = _ACT[gate_name]
+    T, B, H = ys.shape
+    dt = ys.dtype
+    rev = lambda k: (T - 1 - k, 0, 0)   # noqa: E731
+    # previous-step state: block t-1, clamped at 0 (t==0 substitutes the
+    # initial state inside the kernel)
+    prev = lambda k: (jnp.maximum(T - 2 - k, 0), 0, 0)  # noqa: E731
+    const = lambda k: (0, 0)            # noqa: E731
+    seq = lambda ix: pl.BlockSpec((1, B, H), ix)  # noqa: E731
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, 4 * H), dt),  # dzx
+        jax.ShapeDtypeStruct((B, H), dt),         # dh0
+        jax.ShapeDtypeStruct((B, H), dt),         # dc0
+        jax.ShapeDtypeStruct((H, 4 * H), dt),     # dRW
+        jax.ShapeDtypeStruct((H,), dt),           # dpF
+        jax.ShapeDtypeStruct((H,), dt),           # dpI
+        jax.ShapeDtypeStruct((H,), dt),           # dpO
+    )
+    dzx, dh0, dc0, dRW, dpF, dpI, dpO = pl.pallas_call(
+        functools.partial(_seq_bwd_kernel, act, dact, dgate, T),
+        grid=(T,),
+        in_specs=[
+            seq(rev),                       # dys
+            pl.BlockSpec((B, H), const),    # dhT
+            pl.BlockSpec((B, H), const),    # dcT
+            seq(rev), seq(rev), seq(rev), seq(rev), seq(rev),  # a f o i c
+            seq(prev),                      # c_{t-1} (from c)
+            seq(prev),                      # h_{t-1} (from ys)
+            pl.BlockSpec((H, 4 * H), const),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((B, H), const),    # h0
+            pl.BlockSpec((B, H), const),    # c0
+        ],
+        out_specs=(
+            pl.BlockSpec((1, B, 4 * H), rev),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((H, 4 * H), const),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((H, 4 * H), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32), pltpu.VMEM((1, H), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dys, dhT, dcT, a, f, o, i, c, c, ys, RW, pF, pI, pO, h0, c0)
+    return dzx, dh0, dc0, dRW, dpF, dpI, dpO
+
+
+fused_lstm_sequence.defvjp(_seq_fwd, _seq_bwd)

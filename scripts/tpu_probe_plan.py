@@ -46,13 +46,22 @@ STEPS = [
     ("sweep_remat", {"BENCH_SWEEP": "256,512", "BENCH_REMAT": "1"}, 1800),
     # ^ if the declining batch curve is HBM pressure, per-vertex
     #   jax.checkpoint should flatten it at 256/512
+    ("pallas_smoke", {"PROBE_CMD": "smoke"}, 1500),
+    # ^ compiled-on-TPU numerics for every Pallas kernel incl. the new
+    #   time-fused LSTM sequence (interpret mode can hide lowering bugs)
+    ("charrnn_seqfused", {"BENCH_MODEL": "charrnn",
+                          "DL4J_TPU_PALLAS": "seq"}, 1200),
+    # ^ the whole-loop fused kernel vs the scan default, same shapes
 ]
 
 
 def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
     env = dict(os.environ)
     env.update(env_extra)
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--tpu-child"]
+    if env.pop("PROBE_CMD", None) == "smoke":
+        cmd = [sys.executable, os.path.join(REPO, "scripts", "tpu_smoke.py")]
+    else:
+        cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--tpu-child"]
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, env=env, capture_output=True, text=True,

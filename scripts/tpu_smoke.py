@@ -7,6 +7,8 @@ and checks numerics against the XLA-native reference path:
 
   - flash attention fwd + grads vs the xla attention path (causal + masks)
   - fused LSTM cell fwd + grads vs the pure-jnp cell math
+  - time-fused LSTM sequence (grid over T, VMEM carries) fwd + grads vs
+    autodiff-through-scan
   - fused LRN fwd + grads vs the windowed-sum XLA formula
 
 Exit 0 and a JSON summary line on success; nonzero with the failing check
@@ -126,6 +128,50 @@ def check_fused_lstm(results) -> bool:
     return ok
 
 
+def check_fused_lstm_sequence(results) -> bool:
+    """Whole-loop kernel at the char-RNN bench shape family (scaled down):
+    forward + every-input grads vs autodiff through lax.scan."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(5)
+    T, B, Hd = 32, 16, 128
+    r = lambda *sh, s=0.3: jnp.asarray(rng.normal(size=sh) * s, jnp.float32)  # noqa: E731
+    zx, h0, c0 = r(T, B, 4 * Hd), r(B, Hd), r(B, Hd)
+    RW, pF, pI, pO = r(Hd, 4 * Hd, s=0.1), r(Hd, s=0.1), r(Hd, s=0.1), r(Hd, s=0.1)
+
+    def ref(zx, h0, c0, RW, pF, pI, pO):
+        def step(carry, z):
+            h, c = carry
+            h2, c2, *_ = pk._cell_math(z, h, c, RW, pF, pI, pO,
+                                       jnp.tanh, jax.nn.sigmoid)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), zx)
+        return ys, hT, cT
+
+    args = (zx, h0, c0, RW, pF, pI, pO)
+    ys1, hT1, cT1 = jax.jit(
+        lambda *a: pk.fused_lstm_sequence(*a, "tanh", "sigmoid"))(*args)
+    ys2, hT2, cT2 = ref(*args)
+    ok = _close("lstm_seq_ys", ys1, ys2, 5e-4, results)
+    ok &= _close("lstm_seq_hT", hT1, hT2, 5e-4, results)
+    ok &= _close("lstm_seq_cT", cT1, cT2, 5e-4, results)
+
+    def loss_k(*a):
+        ys, hT, cT = pk.fused_lstm_sequence(*a, "tanh", "sigmoid")
+        return jnp.sum(ys**2) + jnp.sum(hT) + jnp.sum(jnp.tanh(cT))
+
+    def loss_r(*a):
+        ys, hT, cT = ref(*a)
+        return jnp.sum(ys**2) + jnp.sum(hT) + jnp.sum(jnp.tanh(cT))
+
+    g1 = jax.jit(jax.grad(loss_k, argnums=tuple(range(7))))(*args)
+    g2 = jax.grad(loss_r, argnums=tuple(range(7)))(*args)
+    for name, a, b in zip(("dzx", "dh0", "dc0", "dRW", "dpF", "dpI", "dpO"),
+                          g1, g2):
+        ok &= _close(f"lstm_seq_{name}", a, b, 2e-3, results)
+    return ok
+
+
 def check_fused_lrn(results) -> bool:
     from deeplearning4j_tpu.ops import pallas_kernels as pk
 
@@ -161,6 +207,7 @@ def main() -> int:
     for name, fn in (
         ("flash_attention", check_flash_attention),
         ("fused_lstm", check_fused_lstm),
+        ("fused_lstm_sequence", check_fused_lstm_sequence),
         ("fused_lrn", check_fused_lrn),
     ):
         try:
