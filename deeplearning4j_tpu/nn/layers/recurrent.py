@@ -70,7 +70,7 @@ def _lstm_scan(
     from ...nn.activations import is_builtin as _is_builtin  # noqa: PLC0415
 
     if (
-        mask is None and not reverse
+        mask is None
         and act_name is not None and gate_name is not None
         and _ops0.lstm_sequence_enabled()
         and _ops0.supported_lstm_activations(act_name.lower(), gate_name.lower())
@@ -78,12 +78,16 @@ def _lstm_scan(
         and _ops0.sequence_fits(x.shape[0], H, xw.dtype.itemsize)
     ):
         # whole-loop fusion: h/c carries live in VMEM across the time grid
-        # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence)
+        # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence).
+        # A reverse scan is the forward kernel on time-flipped input.
         from ...ops.pallas_kernels import fused_lstm_sequence  # noqa: PLC0415
 
+        zx_seq = jnp.flip(xw_t, 0) if reverse else xw_t
         ys, h_f, c_f = fused_lstm_sequence(
-            xw_t, h0, c0, RW, pF, pI, pO, act_name.lower(), gate_name.lower()
+            zx_seq, h0, c0, RW, pF, pI, pO, act_name.lower(), gate_name.lower()
         )
+        if reverse:
+            ys = jnp.flip(ys, 0)
         return jnp.swapaxes(ys, 0, 1), h_f, c_f
     if mask is not None:
         mask_t = jnp.swapaxes(mask.astype(xw.dtype), 0, 1)[..., None]  # [T, B, 1]

@@ -143,6 +143,44 @@ def test_fused_lstm_sequence_layer_end_to_end(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_fused_lstm_sequence_bidirectional(monkeypatch):
+    """reverse=True rides the forward kernel on time-flipped input; the
+    bidirectional layer must match the scan path under DL4J_TPU_PALLAS=seq."""
+    from deeplearning4j_tpu import (
+        GravesBidirectionalLSTM,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        RnnOutputLayer,
+        UpdaterConfig,
+    )
+
+    def make():
+        conf = MultiLayerConfiguration(
+            layers=[GravesBidirectionalLSTM(n_out=12, activation="tanh"),
+                    RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+            input_type=InputType.recurrent(5),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=8,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 9, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 9))]
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "seq")
+    seq = make()
+    for _ in range(3):
+        seq.fit((x, y))
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "0")
+    ref = make()
+    for _ in range(3):
+        ref.fit((x, y))
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_fused_lstm_cell_under_scan_trains():
     """The fused cell must compose with lax.scan + jit + grad (the real
     training topology)."""
