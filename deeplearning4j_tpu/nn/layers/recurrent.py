@@ -70,8 +70,7 @@ def _lstm_scan(
     from ...nn.activations import is_builtin as _is_builtin  # noqa: PLC0415
 
     if (
-        mask is None
-        and act_name is not None and gate_name is not None
+        act_name is not None and gate_name is not None
         and _ops0.lstm_sequence_enabled()
         and _ops0.supported_lstm_activations(act_name.lower(), gate_name.lower())
         and _is_builtin(act_name) and _is_builtin(gate_name)
@@ -79,13 +78,27 @@ def _lstm_scan(
     ):
         # whole-loop fusion: h/c carries live in VMEM across the time grid
         # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence).
-        # A reverse scan is the forward kernel on time-flipped input.
-        from ...ops.pallas_kernels import fused_lstm_sequence  # noqa: PLC0415
+        # A reverse scan is the forward kernel on time-flipped input; padded
+        # batches go through the masked variant (held h/c, scan semantics).
+        from ...ops.pallas_kernels import (  # noqa: PLC0415
+            fused_lstm_sequence,
+            fused_lstm_sequence_masked,
+        )
 
         zx_seq = jnp.flip(xw_t, 0) if reverse else xw_t
-        ys, h_f, c_f = fused_lstm_sequence(
-            zx_seq, h0, c0, RW, pF, pI, pO, act_name.lower(), gate_name.lower()
-        )
+        if mask is None:
+            ys, h_f, c_f = fused_lstm_sequence(
+                zx_seq, h0, c0, RW, pF, pI, pO,
+                act_name.lower(), gate_name.lower()
+            )
+        else:
+            m_seq = jnp.swapaxes(mask.astype(xw.dtype), 0, 1)[..., None]
+            if reverse:
+                m_seq = jnp.flip(m_seq, 0)
+            ys, h_f, c_f = fused_lstm_sequence_masked(
+                zx_seq, m_seq, h0, c0, RW, pF, pI, pO,
+                act_name.lower(), gate_name.lower()
+            )
         if reverse:
             ys = jnp.flip(ys, 0)
         return jnp.swapaxes(ys, 0, 1), h_f, c_f

@@ -511,3 +511,226 @@ def _seq_bwd(act_name, gate_name, residuals, grads):
 
 
 fused_lstm_sequence.defvjp(_seq_fwd, _seq_bwd)
+
+
+# -- masked variant: padded/bucketed sequences ride the fused loop too ------
+#
+# Masked steps carry h/c through unchanged (h_t = m·h̃ + (1−m)·h_{t-1} — the
+# scan path's semantics exactly). The backward recomputes the pre-mask cell
+# state c̃ = f·c_prev + i·a from the stored gates, so the residual set stays
+# the same five tensors plus the [T, B, 1] mask.
+
+
+def _seq_fwd_kernel_masked(act, gate,
+                           zx_ref, m_ref, h0_ref, c0_ref, rw_ref, pf_ref,
+                           pi_ref, po_ref,
+                           y_out, a_out, f_out, o_out, i_out, c_out,
+                           hT_out, cT_out, h_scr, c_scr):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h_prev, c_prev = h_scr[:], c_scr[:]
+    h_tilde, c_tilde, a, f, o, i, _cact = _cell_math(
+        zx_ref[0], h_prev, c_prev, rw_ref[:],
+        pf_ref[:], pi_ref[:], po_ref[:], act, gate,
+    )
+    m = m_ref[0]
+    h = m * h_tilde + (1.0 - m) * h_prev
+    c = m * c_tilde + (1.0 - m) * c_prev
+    y_out[0], a_out[0], f_out[0], o_out[0], i_out[0], c_out[0] = h, a, f, o, i, c
+    h_scr[:], c_scr[:] = h, c
+    hT_out[:], cT_out[:] = h, c
+
+
+def _seq_bwd_kernel_masked(act, dact, dgate, T,
+                           dy_ref, dhT_ref, dcT_ref, m_ref,
+                           a_ref, f_ref, o_ref, i_ref, c_ref, cprev_ref,
+                           hprev_ref, rw_ref, pf_ref, pi_ref, po_ref,
+                           h0_ref, c0_ref,
+                           dzx_out, dh0_out, dc0_out, drw_out, dpf_out,
+                           dpi_out, dpo_out,
+                           dh_scr, dc_scr, drw_scr, dpf_scr, dpi_scr, dpo_scr):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _init():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+        drw_scr[:] = jnp.zeros(drw_scr.shape, drw_scr.dtype)
+        dpf_scr[:] = jnp.zeros(dpf_scr.shape, dpf_scr.dtype)
+        dpi_scr[:] = jnp.zeros(dpi_scr.shape, dpi_scr.dtype)
+        dpo_scr[:] = jnp.zeros(dpo_scr.shape, dpo_scr.dtype)
+
+    a, f, o, i = a_ref[0], f_ref[0], o_ref[0], i_ref[0]
+    first = k == T - 1
+    c_prev = jnp.where(first, c0_ref[:], cprev_ref[0])
+    h_prev = jnp.where(first, h0_ref[:], hprev_ref[0])
+    m = m_ref[0]
+    c_tilde = f * c_prev + i * a        # pre-mask cell state, recomputed
+    cact = act(c_tilde)
+    pF, pI, pO = pf_ref[:], pi_ref[:], po_ref[:]
+
+    dh_t = dy_ref[0] + dh_scr[:]
+    dc_t = dc_scr[:]
+    dh = m * dh_t                        # gradient into the cell outputs
+    dc = m * dc_t
+    do = dh * cact * dgate(o)
+    dc_tot = dc + dh * o * dact(cact) + do * pO
+    df = dc_tot * c_prev * dgate(f)
+    di = dc_tot * a * dgate(i)
+    da = dc_tot * i * dact(a)
+    dzx = jnp.concatenate([da, df, do, di], axis=-1)
+    dzx_out[0] = dzx
+    # carry-through paths: masked steps pass dh/dc straight to t-1
+    dh_scr[:] = (jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
+                 + (1.0 - m) * dh_t)
+    dc_scr[:] = dc_tot * f + df * pF + di * pI + (1.0 - m) * dc_t
+    f32 = drw_scr.dtype
+    drw_scr[:] += jnp.dot(h_prev.T, dzx, preferred_element_type=f32)
+    dpf_scr[:] += jnp.sum(df * c_prev, axis=0, dtype=f32)[None]
+    dpi_scr[:] += jnp.sum(di * c_prev, axis=0, dtype=f32)[None]
+    dpo_scr[:] += jnp.sum(do * c_tilde, axis=0, dtype=f32)[None]
+    dt = dzx.dtype
+    dh0_out[:] = dh_scr[:]
+    dc0_out[:] = dc_scr[:]
+    drw_out[:] = drw_scr[:].astype(dt)
+    dpf_out[:] = dpf_scr[0].astype(dt)
+    dpi_out[:] = dpi_scr[0].astype(dt)
+    dpo_out[:] = dpo_scr[0].astype(dt)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def fused_lstm_sequence_masked(zx, mask, h0, c0, RW, pF, pI, pO,
+                               act_name: str = "tanh",
+                               gate_name: str = "sigmoid"):
+    """Masked whole-sequence fused LSTM: ``mask`` [T, B, 1]; masked steps
+    hold h/c (scan-path semantics). Returns (ys, h_T, c_T)."""
+    ys, *_rest, hT, cT = _seq_masked_fwd_impl(zx, mask, h0, c0, RW, pF, pI,
+                                              pO, act_name, gate_name)
+    return ys, hT, cT
+
+
+def _seq_masked_fwd_impl(zx, mask, h0, c0, RW, pF, pI, pO, act_name,
+                         gate_name):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    act, _ = _ACT[act_name]
+    gate, _ = _ACT[gate_name]
+    T, B, H4 = zx.shape
+    H = H4 // 4
+    dt = zx.dtype
+    step = lambda t: (t, 0, 0)  # noqa: E731
+    const = lambda t: (0, 0)    # noqa: E731
+    seq_spec = lambda w: pl.BlockSpec((1, B, w), step)  # noqa: E731
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, H), dt),
+        *[jax.ShapeDtypeStruct((T, B, H), dt) for _ in range(5)],
+        jax.ShapeDtypeStruct((B, H), dt),
+        jax.ShapeDtypeStruct((B, H), dt),
+    )
+    return pl.pallas_call(
+        functools.partial(_seq_fwd_kernel_masked, act, gate),
+        grid=(T,),
+        in_specs=[
+            seq_spec(H4),
+            pl.BlockSpec((1, B, 1), step),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((H, H4), const),
+            pl.BlockSpec((H,), lambda t: (0,)),
+            pl.BlockSpec((H,), lambda t: (0,)),
+            pl.BlockSpec((H,), lambda t: (0,)),
+        ],
+        out_specs=(
+            seq_spec(H), seq_spec(H), seq_spec(H), seq_spec(H), seq_spec(H),
+            seq_spec(H),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=_interpret(),
+    )(zx, mask.astype(dt), h0, c0, RW, pF, pI, pO)
+
+
+def _seq_masked_fwd(zx, mask, h0, c0, RW, pF, pI, pO, act_name, gate_name):
+    ys, a, f, o, i, c, hT, cT = _seq_masked_fwd_impl(
+        zx, mask, h0, c0, RW, pF, pI, pO, act_name, gate_name
+    )
+    residuals = (ys, a, f, o, i, c, mask, h0, c0, RW, pF, pI, pO)
+    return (ys, hT, cT), residuals
+
+
+def _seq_masked_bwd(act_name, gate_name, residuals, grads):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    ys, a, f, o, i, c, mask, h0, c0, RW, pF, pI, pO = residuals
+    dys, dhT, dcT = grads
+    act, dact = _ACT[act_name]
+    _, dgate = _ACT[gate_name]
+    T, B, H = ys.shape
+    dt = ys.dtype
+    rev = lambda k: (T - 1 - k, 0, 0)   # noqa: E731
+    prev = lambda k: (jnp.maximum(T - 2 - k, 0), 0, 0)  # noqa: E731
+    const = lambda k: (0, 0)            # noqa: E731
+    seq = lambda ix: pl.BlockSpec((1, B, H), ix)  # noqa: E731
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, 4 * H), dt),
+        jax.ShapeDtypeStruct((B, H), dt),
+        jax.ShapeDtypeStruct((B, H), dt),
+        jax.ShapeDtypeStruct((H, 4 * H), dt),
+        jax.ShapeDtypeStruct((H,), dt),
+        jax.ShapeDtypeStruct((H,), dt),
+        jax.ShapeDtypeStruct((H,), dt),
+    )
+    dzx, dh0, dc0, dRW, dpF, dpI, dpO = pl.pallas_call(
+        functools.partial(_seq_bwd_kernel_masked, act, dact, dgate, T),
+        grid=(T,),
+        in_specs=[
+            seq(rev),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((1, B, 1), rev),
+            seq(rev), seq(rev), seq(rev), seq(rev), seq(rev),
+            seq(prev),
+            seq(prev),
+            pl.BlockSpec((H, 4 * H), const),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, B, 4 * H), rev),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((H, 4 * H), const),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+            pl.BlockSpec((H,), lambda k: (0,)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((H, 4 * H), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32), pltpu.VMEM((1, H), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dys, dhT, dcT, mask.astype(dt), a, f, o, i, c, c, ys,
+      RW, pF, pI, pO, h0, c0)
+    return dzx, None, dh0, dc0, dRW, dpF, dpI, dpO
+
+
+fused_lstm_sequence_masked.defvjp(_seq_masked_fwd, _seq_masked_bwd)

@@ -172,6 +172,50 @@ def check_fused_lstm_sequence(results) -> bool:
     return ok
 
 
+def check_fused_lstm_sequence_masked(results) -> bool:
+    """Masked variant: held h/c on masked steps, grads incl. carry-through."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(6)
+    T, B, Hd = 16, 8, 128
+    r = lambda *sh, s=0.3: jnp.asarray(rng.normal(size=sh) * s, jnp.float32)  # noqa: E731
+    zx, h0, c0 = r(T, B, 4 * Hd), r(B, Hd), r(B, Hd)
+    RW, pF, pI, pO = r(Hd, 4 * Hd, s=0.1), r(Hd, s=0.1), r(Hd, s=0.1), r(Hd, s=0.1)
+    mask = jnp.asarray((rng.random((T, B, 1)) > 0.25).astype(np.float32))
+
+    def ref(zx, h0, c0):
+        def step(carry, inp):
+            z, m = inp
+            h, c = carry
+            h2, c2, *_ = pk._cell_math(z, h, c, RW, pF, pI, pO,
+                                       jnp.tanh, jax.nn.sigmoid)
+            return (m * h2 + (1 - m) * h, m * c2 + (1 - m) * c), \
+                m * h2 + (1 - m) * h
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), (zx, mask))
+        return ys, hT, cT
+
+    ys1, hT1, cT1 = jax.jit(lambda *a: pk.fused_lstm_sequence_masked(
+        a[0], mask, a[1], a[2], RW, pF, pI, pO, "tanh", "sigmoid"))(zx, h0, c0)
+    ys2, hT2, cT2 = ref(zx, h0, c0)
+    ok = _close("lstm_seqm_ys", ys1, ys2, 5e-4, results)
+    ok &= _close("lstm_seqm_hT", hT1, hT2, 5e-4, results)
+
+    def loss_k(zx, h0, c0):
+        ys, hT, cT = pk.fused_lstm_sequence_masked(
+            zx, mask, h0, c0, RW, pF, pI, pO, "tanh", "sigmoid")
+        return jnp.sum(ys**2) + jnp.sum(hT * cT)
+
+    def loss_r(zx, h0, c0):
+        ys, hT, cT = ref(zx, h0, c0)
+        return jnp.sum(ys**2) + jnp.sum(hT * cT)
+
+    g1 = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(zx, h0, c0)
+    g2 = jax.grad(loss_r, argnums=(0, 1, 2))(zx, h0, c0)
+    for name, a, b in zip(("dzx", "dh0", "dc0"), g1, g2):
+        ok &= _close(f"lstm_seqm_{name}", a, b, 2e-3, results)
+    return ok
+
+
 def check_fused_lrn(results) -> bool:
     from deeplearning4j_tpu.ops import pallas_kernels as pk
 
@@ -208,6 +252,7 @@ def main() -> int:
         ("flash_attention", check_flash_attention),
         ("fused_lstm", check_fused_lstm),
         ("fused_lstm_sequence", check_fused_lstm_sequence),
+        ("fused_lstm_sequence_masked", check_fused_lstm_sequence_masked),
         ("fused_lrn", check_fused_lrn),
     ):
         try:

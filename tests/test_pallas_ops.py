@@ -143,6 +143,91 @@ def test_fused_lstm_sequence_layer_end_to_end(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_fused_lstm_sequence_masked_matches_masked_scan():
+    from deeplearning4j_tpu.ops.pallas_kernels import fused_lstm_sequence_masked
+
+    T, B, H = 6, 4, 8
+    rng = np.random.default_rng(2)
+    zx, h0, c0, RW, pF, pI, pO = _seq_inputs(seed=2, T=T, B=B, H=H)
+    mask = jnp.asarray((rng.random((T, B, 1)) > 0.3).astype(np.float32))
+    a_fn, g_fn = _ACT["tanh"][0], _ACT["sigmoid"][0]
+
+    def ref(zx, mask, h0, c0):
+        def step(carry, inp):
+            z, m = inp
+            h, c = carry
+            h2, c2, *_ = _cell_math(z, h, c, RW, pF, pI, pO, a_fn, g_fn)
+            return (m * h2 + (1 - m) * h, m * c2 + (1 - m) * c), \
+                m * h2 + (1 - m) * h
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), (zx, mask))
+        return ys, hT, cT
+
+    ys_k, hT_k, cT_k = fused_lstm_sequence_masked(
+        zx, mask, h0, c0, RW, pF, pI, pO, "tanh", "sigmoid")
+    ys_r, hT_r, cT_r = ref(zx, mask, h0, c0)
+    np.testing.assert_allclose(np.asarray(ys_k), np.asarray(ys_r), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_r), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT_k), np.asarray(cT_r), atol=1e-6)
+
+    def loss_k(zx, h0, c0):
+        ys, hT, cT = fused_lstm_sequence_masked(
+            zx, mask, h0, c0, RW, pF, pI, pO, "tanh", "sigmoid")
+        return jnp.sum(ys * ys) + jnp.sum(hT * cT)
+
+    def loss_r(zx, h0, c0):
+        ys, hT, cT = ref(zx, mask, h0, c0)
+        return jnp.sum(ys * ys) + jnp.sum(hT * cT)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(zx, h0, c0)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(zx, h0, c0)
+    for a, b, name in zip(gk, gr, ["dzx", "dh0", "dc0"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
+                                   err_msg=f"grad {name}")
+
+
+def test_fused_lstm_sequence_masked_layer_end_to_end(monkeypatch):
+    """Padded (bucketed) training rides the masked sequence kernel under
+    DL4J_TPU_PALLAS=seq and matches the masked scan path."""
+    from deeplearning4j_tpu import (
+        GravesLSTM,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        RnnOutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    def make():
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=12, activation="tanh"),
+                    RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+            input_type=InputType.recurrent(5),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=4,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 9, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 9))]
+    fm = np.ones((4, 9), np.float32)
+    fm[1, 6:] = 0.0
+    fm[3, 4:] = 0.0
+    ds = DataSet(x, y, fm, fm)
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "seq")
+    seq = make()
+    for _ in range(3):
+        seq.fit(ds)
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "0")
+    ref = make()
+    for _ in range(3):
+        ref.fit(ds)
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_fused_lstm_sequence_bidirectional(monkeypatch):
     """reverse=True rides the forward kernel on time-flipped input; the
     bidirectional layer must match the scan path under DL4J_TPU_PALLAS=seq."""
