@@ -54,17 +54,26 @@ class BatchNormalization(BaseLayer):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
-        # Stats accumulate in >=f32 via ONE fused pass (two independent
-        # reductions, var = E[x^2] - E[x]^2 — the cuDNN formulation) instead
-        # of jnp.mean followed by the dependent jnp.var, which costs a
-        # second full read of the activation tensor per BN per step — on
-        # TPU the conv activations are the HBM-bandwidth budget.
+        # For LOW-PRECISION inputs (bf16/f16 — the TPU training path), stats
+        # accumulate in f32 via ONE fused pass (two independent reductions,
+        # var = E[x^2] - E[x]^2, the cuDNN formulation) instead of jnp.mean
+        # followed by the dependent jnp.var, which costs a second full read
+        # of the activation tensor per BN per step — on TPU the conv
+        # activations are the HBM-bandwidth budget. The f32 accumulators
+        # carry 16 more mantissa bits than the data, so the formula's
+        # cancellation cannot lose information the input ever had. For
+        # f32/f64 inputs the two-pass variance stays: E[x^2]-E[x]^2 at the
+        # data's own precision cancels catastrophically when |mean| >> std.
         stat_dt = jnp.promote_types(x.dtype, jnp.float32)
+        one_pass = x.dtype in (jnp.bfloat16, jnp.float16)
         if train:
             xf = x.astype(stat_dt)
             mean = jnp.mean(xf, axis=axes)
-            var = jnp.maximum(jnp.mean(jnp.square(xf), axis=axes)
-                              - jnp.square(mean), 0.0)
+            if one_pass:
+                var = jnp.maximum(jnp.mean(jnp.square(xf), axis=axes)
+                                  - jnp.square(mean), 0.0)
+            else:
+                var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,

@@ -171,6 +171,28 @@ class TestGradients:
             # running stats keep their high precision
             assert new_state["mean"].dtype == state["mean"].dtype
 
+    def test_batchnorm_f32_large_mean_variance_accurate(self):
+        """Full-precision inputs keep the two-pass variance: the one-pass
+        E[x^2]-E[x]^2 form cancels catastrophically at |mean| >> std (f32
+        mean 1e4, std 1e-2 would lose var entirely), so it is reserved for
+        bf16/f16 inputs whose f32 accumulators out-precision the data."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+        bn = BatchNormalization()
+        it = IT.feed_forward(4)
+        params = bn.init_params(jax.random.PRNGKey(0), it)
+        state = bn.init_state(it)
+        rng = np.random.default_rng(0)
+        x = (1e4 + 1e-2 * rng.normal(size=(4096, 4))).astype(np.float32)
+        _, new_state = bn.apply(params, jnp.asarray(x), state, train=True)
+        batch_var = (1 - bn.decay) ** -1 * (
+            np.asarray(new_state["var"]) - bn.decay * np.asarray(state["var"])
+        )
+        np.testing.assert_allclose(batch_var, x.var(axis=0), rtol=1e-2)
+
     def test_lrn(self):
         x, y = image_data(c=6, seed=4)
         net = build(
