@@ -105,8 +105,18 @@ def main() -> int:
             continue
         wedges = 0
         got += 1
+        if env_extra.get("DL4J_TPU_PALLAS") == "seq" and "metric" in result:
+            result["metric"] += "_seqfused"  # own key: don't overwrite the
+            #                                  scan default's _latest entry
         with open(RESULTS, "a") as f:
             f.write(json.dumps(result) + "\n")
+        if isinstance(result.get("value"), (int, float)) and result.get("metric"):
+            # record into BENCH_SELF.json so a round-end CPU-fallback bench
+            # line still carries this number in prior_tpu_measurements
+            sys.path.insert(0, REPO)
+            import bench  # noqa: PLC0415
+
+            bench._with_self_baseline(dict(result))
         print(f"PLAN: {name} -> {result.get('metric')}="
               f"{result.get('value')} {result.get('unit', '')}")
     print(f"PLAN: done, {got} results in {RESULTS}")
