@@ -61,6 +61,13 @@ def test_streaming_pipeline_example():
     # (the trailing partial batch may or may not flush before stop())
 
 
+def test_variable_length_sequences_example():
+    """34 distinct lengths -> bucket-bounded compiles AND the model actually
+    learns the frequency task through the masks."""
+    acc = _mod("variable_length_sequences").main(quick=True)
+    assert acc > 0.8
+
+
 def test_streaming_pipeline_example_two_process():
     """The producer runs as a separate OS process over the socket transport."""
     acc = _mod("streaming_pipeline").main(quick=True, two_process=True)
