@@ -266,6 +266,30 @@ def test_fused_lstm_sequence_bidirectional(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_fused_lstm_sequence_inside_fit_on_device(monkeypatch):
+    """The charrnn bench path: the sequence kernel nested inside the
+    one-dispatch lax.scan training loop (stacked 2-layer char-RNN) must
+    match the scan path — this is exactly what the charrnn_seqfused probe
+    step runs on hardware."""
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.models.char_rnn import char_rnn
+
+    def make():
+        conf = char_rnn(vocab_size=12, hidden_size=16, num_layers=2)
+        conf.backprop_type = "standard"
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 12, size=(4, 10))
+    xs = np.eye(12, dtype=np.float32)[idx[None, :, :-1]]
+    ys = np.eye(12, dtype=np.float32)[idx[None, :, 1:]]
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "seq")
+    seq_losses = make().fit_on_device(xs, ys, steps=3)
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "0")
+    ref_losses = make().fit_on_device(xs, ys, steps=3)
+    np.testing.assert_allclose(seq_losses, ref_losses, atol=1e-5)
+
+
 def test_fused_lstm_cell_under_scan_trains():
     """The fused cell must compose with lax.scan + jit + grad (the real
     training topology)."""
