@@ -104,6 +104,44 @@ def test_remat_json_round_trip():
     assert back_g.remat is True
 
 
+def test_remat_composes_with_spmd_wrapper():
+    """jax.checkpoint x GSPMD: remat under the data-parallel wrapper (and a
+    dp x tp mesh) must neither change numerics nor break sharding
+    propagation."""
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    def make(remat):
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=16, activation="tanh"),
+                    OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            input_type=InputType.feed_forward(6),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=5, remat=remat,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    results = []
+    for remat in (False, True):
+        net = make(remat)
+        w = ParallelWrapper(net, mesh=make_mesh(8))
+        for _ in range(3):
+            w.fit(DataSet(x, y))
+        results.append(net.params)
+    _tree_allclose(results[0], results[1])
+    # dp x tp: the model axis shards through the remat'd layers
+    net = make(True)
+    mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+    w = ParallelWrapper(net, mesh=mesh, model_axis="model")
+    w._setup_sync()
+    w._fit_sync(DataSet(x, y))
+    spec = net.params[0]["W"].sharding.spec
+    assert "model" in tuple(s for s in spec if s is not None), spec
+
+
 def test_remat_composes_with_fit_on_device():
     """The scanned one-dispatch loop wraps the same train step, so remat
     must flow through fit_on_device unchanged."""
