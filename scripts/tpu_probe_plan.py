@@ -52,6 +52,10 @@ STEPS = [
     ("charrnn_seqfused", {"BENCH_MODEL": "charrnn",
                           "DL4J_TPU_PALLAS": "seq"}, 1200),
     # ^ the whole-loop fused kernel vs the scan default, same shapes
+    ("charrnn_b128", {"BENCH_MODEL": "charrnn", "BENCH_BATCH": "128"}, 1200),
+    # ^ B=64 fills half the MXU's 128 sublanes on the recurrent gemm; the
+    #   batch-128 row shows the throughput the framework sustains when the
+    #   workload is MXU-shaped (own suffixed metric key)
 ]
 
 
