@@ -1,0 +1,79 @@
+"""CLI front-end for data-parallel training (reference:
+parallelism/main/ParallelWrapperMain.java — the only training CLI the
+reference ships: load a serialized model, build an iterator, train through
+ParallelWrapper, write the trained model back).
+
+TPU-native shape: the model is the checkpoint zip triple
+(utils/serialization), the data is a directory of exported ``.npz`` DataSet
+shards (datasets/export — the Spark-export analog), and the wrapper trains
+over a device mesh with sync all-reduce or periodic averaging. Flag names
+mirror the reference's (--model-path, --workers, --averaging-frequency,
+--report-score, --average-updaters, --model-output-path).
+
+Run:  python -m deeplearning4j_tpu.parallel.main \
+        --model-path model.zip --data-dir shards/ --epochs 2 \
+        --workers 8 --averaging-frequency 1 --model-output-path out.zip
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.parallel.main",
+        description="Train a serialized model data-parallel over the device "
+                    "mesh (ParallelWrapperMain parity).",
+    )
+    ap.add_argument("--model-path", required=True,
+                    help="checkpoint zip triple to load (ModelSerializer format)")
+    ap.add_argument("--data-dir", required=True,
+                    help="directory of exported .npz DataSet shards")
+    ap.add_argument("--model-output-path", required=True,
+                    help="where the trained checkpoint triple is written")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="devices to use (default: all)")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--averaging-frequency", type=int, default=1,
+                    help="1 = sync all-reduce every step (modern default); "
+                         "N>1 = periodic parameter averaging (reference default)")
+    ap.add_argument("--prefetch-size", type=int, default=2)
+    ap.add_argument("--report-score", action="store_true",
+                    help="log the score each iteration (ScoreIterationListener)")
+    ap.add_argument("--no-average-updaters", action="store_true",
+                    help="do not average updater state at averaging rounds")
+    ap.add_argument("--shuffle", action="store_true",
+                    help="shuffle shard order each epoch")
+    ap.add_argument("--seed", type=int, default=0, help="shuffle seed")
+    return ap
+
+
+def run(argv: Optional[Sequence[str]] = None) -> str:
+    args = build_parser().parse_args(argv)
+
+    from ..datasets.export import FileDataSetIterator
+    from ..optimize.listeners import ScoreIterationListener
+    from ..utils.serialization import restore_model, write_model
+    from .wrapper import ParallelWrapper
+
+    net = restore_model(args.model_path)
+    if args.report_score:
+        net.set_listeners(ScoreIterationListener(print_every=1))
+    it = FileDataSetIterator(args.data_dir, shuffle=args.shuffle,
+                             seed=args.seed)
+    wrapper = ParallelWrapper(
+        net,
+        workers=args.workers,
+        averaging_frequency=args.averaging_frequency,
+        average_updaters=not args.no_average_updaters,
+        prefetch_buffer=args.prefetch_size,
+    )
+    wrapper.fit(it, epochs=args.epochs)
+    write_model(net, args.model_output_path)
+    return args.model_output_path
+
+
+if __name__ == "__main__":
+    run()
