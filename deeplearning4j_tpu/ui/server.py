@@ -490,3 +490,44 @@ class UIServer:
         self._httpd.server_close()
         if UIServer._instance is self:
             UIServer._instance = None
+
+
+def main(argv=None) -> "UIServer":
+    """Standalone dashboard (reference: PlayUIServer's CLI with the port
+    arg + remote-stats receiver): serve an existing stats storage, or an
+    in-memory one fed by RemoteStatsStorageRouter POSTs from training
+    processes. Run: ``python -m deeplearning4j_tpu.ui.server --port 9000
+    [--storage stats.db]``."""
+    import argparse
+
+    from .storage import FileStatsStorage, SqliteStatsStorage
+
+    ap = argparse.ArgumentParser(prog="deeplearning4j_tpu.ui.server")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--storage", default=None,
+                    help=".db (sqlite) or .bin (file) stats storage to "
+                         "serve; default: in-memory, fed by the remote "
+                         "receiver (/remote)")
+    ap.add_argument("--block", action="store_true",
+                    help="keep the process alive (CLI usage)")
+    args = ap.parse_args(argv)
+    server = UIServer.get_instance(port=args.port)
+    if args.storage:
+        storage = (SqliteStatsStorage(args.storage)
+                   if args.storage.endswith(".db")
+                   else FileStatsStorage(args.storage))
+    else:
+        storage = InMemoryStatsStorage()
+    server.attach(storage)
+    print(f"dl4j-tpu UI at http://127.0.0.1:{server.port}/train/overview "
+          f"(remote receiver at /remote)", flush=True)
+    if args.block:  # pragma: no cover - interactive path
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            server.stop()
+    return server
+
+
+if __name__ == "__main__":
+    main(None if len(__import__("sys").argv) > 1 else ["--block"])

@@ -399,3 +399,31 @@ class TestWorkerFilter:
             assert 'id="worker"' in html
         finally:
             server.stop()
+
+
+def test_ui_server_cli_main(tmp_path):
+    """Standalone dashboard CLI (PlayUIServer's port-arg role): serve a
+    sqlite stats storage written earlier by a training run."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.server import UIServer, main
+    from deeplearning4j_tpu.ui.storage import SqliteStatsStorage
+
+    db = str(tmp_path / "stats.db")
+    st = SqliteStatsStorage(db)
+    st.put_static_info({"session_id": "cli", "worker_id": "0",
+                        "timestamp": 1.0, "model_class": "MLN"})
+    st.put_update({"session_id": "cli", "worker_id": "0", "timestamp": 2.0,
+                   "iteration": 1, "score": 0.5})
+    UIServer._instance = None  # isolate from other tests' singleton
+    server = main(["--port", "0", "--storage", db])
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        sessions = json.loads(
+            urllib.request.urlopen(f"{base}/api/sessions").read())
+        assert "cli" in sessions
+        page = urllib.request.urlopen(f"{base}/train/overview").read().decode()
+        assert "cli" in page or "overview" in page.lower()
+    finally:
+        server.stop()
