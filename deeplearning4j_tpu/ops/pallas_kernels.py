@@ -306,8 +306,16 @@ _SEQ_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
 def _seq_fits(B: int, H: int, itemsize: int) -> bool:
-    resident = H * 4 * H * itemsize + 2 * B * H * 4  # RW + f32-ish carries
-    streamed = 2 * (B * 4 * H + 7 * B * H) * itemsize  # double-buffered blocks
+    # Model the BACKWARD kernel — its footprint dominates: RW plus the f32
+    # (H, 4H) dRW accumulator are resident, dh/dc carries in scratch, and
+    # per-step it streams dy + 5 residuals + c_prev/h_prev + dzx blocks
+    # (double-buffered). The forward (RW + 2 carries + 7 streamed blocks)
+    # is strictly smaller.
+    resident = (H * 4 * H * itemsize      # RW
+                + H * 4 * H * 4           # f32 dRW accumulator
+                + 2 * B * H * itemsize    # dh/dc carries
+                + 3 * H * 4)              # peephole accumulators
+    streamed = 2 * (8 * B * H + B * 4 * H) * itemsize
     return resident + streamed < _SEQ_VMEM_BUDGET_BYTES
 
 
@@ -391,10 +399,85 @@ def _seq_bwd_kernel(act, dact, dgate, T,
 def fused_lstm_sequence(zx, h0, c0, RW, pF, pI, pO,
                         act_name: str = "tanh", gate_name: str = "sigmoid"):
     """Whole-sequence fused LSTM: ``zx`` [T, B, 4H] (precomputed x@W + b),
-    returns (ys [T, B, H], h_T, c_T). Unmasked, forward-direction."""
-    ys, _a, _f, _o, _i, _c, hT, cT = _seq_fwd_impl(
-        zx, h0, c0, RW, pF, pI, pO, act_name, gate_name)
-    return ys, hT, cT
+    returns (ys [T, B, H], h_T, c_T). Unmasked, forward-direction.
+
+    The primal (inference) path runs a LEAN kernel that emits only
+    ys/hT/cT; the five gate residuals stream to HBM only under jax.grad
+    (the VJP's forward rule) where the backward actually consumes them."""
+    return _seq_lean_impl(zx, None, h0, c0, RW, pF, pI, pO,
+                          act_name, gate_name)
+
+
+def _seq_lean_kernel(act, gate, masked, *refs):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    if masked:
+        (zx_ref, m_ref, h0_ref, c0_ref, rw_ref, pf_ref, pi_ref, po_ref,
+         y_out, hT_out, cT_out, h_scr, c_scr) = refs
+    else:
+        (zx_ref, h0_ref, c0_ref, rw_ref, pf_ref, pi_ref, po_ref,
+         y_out, hT_out, cT_out, h_scr, c_scr) = refs
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h_prev, c_prev = h_scr[:], c_scr[:]
+    h, c, *_ = _cell_math(zx_ref[0], h_prev, c_prev, rw_ref[:],
+                          pf_ref[:], pi_ref[:], po_ref[:], act, gate)
+    if masked:
+        m = m_ref[0]
+        h = m * h + (1.0 - m) * h_prev
+        c = m * c + (1.0 - m) * c_prev
+    y_out[0] = h
+    h_scr[:], c_scr[:] = h, c
+    hT_out[:], cT_out[:] = h, c
+
+
+def _seq_lean_impl(zx, mask, h0, c0, RW, pF, pI, pO, act_name, gate_name):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    act, _ = _ACT[act_name]
+    gate, _ = _ACT[gate_name]
+    T, B, H4 = zx.shape
+    H = H4 // 4
+    dt = zx.dtype
+    step = lambda t: (t, 0, 0)  # noqa: E731
+    const = lambda t: (0, 0)    # noqa: E731
+    in_specs = [pl.BlockSpec((1, B, H4), step)]
+    args = [zx]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, B, 1), step))
+        args.append(mask.astype(dt))
+    in_specs += [
+        pl.BlockSpec((B, H), const),
+        pl.BlockSpec((B, H), const),
+        pl.BlockSpec((H, H4), const),
+        pl.BlockSpec((H,), lambda t: (0,)),
+        pl.BlockSpec((H,), lambda t: (0,)),
+        pl.BlockSpec((H,), lambda t: (0,)),
+    ]
+    args += [h0, c0, RW, pF, pI, pO]
+    return pl.pallas_call(
+        functools.partial(_seq_lean_kernel, act, gate, mask is not None),
+        grid=(T,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, B, H), step),
+            pl.BlockSpec((B, H), const),
+            pl.BlockSpec((B, H), const),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ),
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=_interpret(),
+    )(*args)
 
 
 def _seq_fwd_impl(zx, h0, c0, RW, pF, pI, pO, act_name, gate_name):
@@ -612,10 +695,10 @@ def fused_lstm_sequence_masked(zx, mask, h0, c0, RW, pF, pI, pO,
                                act_name: str = "tanh",
                                gate_name: str = "sigmoid"):
     """Masked whole-sequence fused LSTM: ``mask`` [T, B, 1]; masked steps
-    hold h/c (scan-path semantics). Returns (ys, h_T, c_T)."""
-    ys, *_rest, hT, cT = _seq_masked_fwd_impl(zx, mask, h0, c0, RW, pF, pI,
-                                              pO, act_name, gate_name)
-    return ys, hT, cT
+    hold h/c (scan-path semantics). Returns (ys, h_T, c_T). The primal runs
+    the lean (no-residual) kernel; see fused_lstm_sequence."""
+    return _seq_lean_impl(zx, mask, h0, c0, RW, pF, pI, pO,
+                          act_name, gate_name)
 
 
 def _seq_masked_fwd_impl(zx, mask, h0, c0, RW, pF, pI, pO, act_name,

@@ -492,12 +492,14 @@ class UIServer:
             UIServer._instance = None
 
 
-def main(argv=None) -> "UIServer":
+def main(argv=None, block_default: bool = False) -> "UIServer":
     """Standalone dashboard (reference: PlayUIServer's CLI with the port
     arg + remote-stats receiver): serve an existing stats storage, or an
     in-memory one fed by RemoteStatsStorageRouter POSTs from training
     processes. Run: ``python -m deeplearning4j_tpu.ui.server --port 9000
-    [--storage stats.db]``."""
+    [--storage stats.db]`` — the module entry blocks by default (the HTTP
+    thread is a daemon, so returning would kill the dashboard); tests call
+    main() directly and get the server object back."""
     import argparse
 
     from .storage import FileStatsStorage, SqliteStatsStorage
@@ -508,8 +510,9 @@ def main(argv=None) -> "UIServer":
                     help=".db (sqlite) or .bin (file) stats storage to "
                          "serve; default: in-memory, fed by the remote "
                          "receiver (/remote)")
-    ap.add_argument("--block", action="store_true",
-                    help="keep the process alive (CLI usage)")
+    ap.add_argument("--block", action=argparse.BooleanOptionalAction,
+                    default=block_default,
+                    help="keep the process alive (CLI default)")
     args = ap.parse_args(argv)
     server = UIServer.get_instance(port=args.port)
     if args.storage:
@@ -530,4 +533,4 @@ def main(argv=None) -> "UIServer":
 
 
 if __name__ == "__main__":
-    main(None if len(__import__("sys").argv) > 1 else ["--block"])
+    main(block_default=True)

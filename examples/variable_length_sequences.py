@@ -75,8 +75,6 @@ def main(quick: bool = False) -> float:
     # held through the padded tail
     test = make_corpus(60, rng)
     correct = 0
-    stream_programs_before = (net._rnn_step_fn._cache_size()
-                              if net._rnn_step_fn else 0)
     for feats, labels in test:
         net.rnn_clear_previous_state()
         xp, mask, t = pad_to_bucket(feats[None, ...], bounds)

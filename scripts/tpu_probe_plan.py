@@ -28,34 +28,41 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
 
+# (name, env, timeout_s, store_suffix) — store_suffix: None = do NOT
+# record into BENCH_SELF.json (non-comparable variant: best-of-sweep), "" =
+# record under the bench's own metric key, "_x" = record under a suffixed
+# key so variants never contaminate the canonical rows' _latest/anchor.
 STEPS = [
-    ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500),
+    ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500, ""),
     ("charrnn_small", {"BENCH_MODEL": "charrnn", "BENCH_SEQ": "128",
-                       "BENCH_STEPS": "10"}, 900),
+                       "BENCH_STEPS": "10"}, 900, ""),
     # ^ much cheaper nested-scan compile: if this lands where the default
     #   shape wedged, the tunnel was healthy and the default compile is the
-    #   bottleneck (round-3 lesson) — metric key carries the shape suffix
-    ("resnet50_b128", {}, 1200),
-    ("charrnn_fused", {"BENCH_MODEL": "charrnn", "DL4J_TPU_PALLAS": "1"}, 1200),
+    #   bottleneck (round-3 lesson) — bench suffixes the shape itself
+    ("resnet50_b128", {}, 1200, ""),
+    ("charrnn_fused", {"BENCH_MODEL": "charrnn",
+                       "DL4J_TPU_PALLAS": "1"}, 1200, "_fusedcell"),
     # ^ scan-body math is the measured default (ops/__init__.py
     #   lstm_helper_enabled: 3.3 vs 4.5 ms/step at B=128,H=256 on v5e);
     #   this step re-checks the fused Pallas cell at the bench shape
     #   (B=64,H=512) so BASELINE.md can carry both numbers
-    ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200),
-    ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800),
-    ("sweep_remat", {"BENCH_SWEEP": "256,512", "BENCH_REMAT": "1"}, 1800),
-    # ^ if the declining batch curve is HBM pressure, per-vertex
-    #   jax.checkpoint should flatten it at 256/512
-    ("pallas_smoke", {"PROBE_CMD": "smoke"}, 1500),
+    ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200, ""),
+    # ^ the timed region runs BEFORE the trace capture, so the value is a
+    #   clean measurement of the canonical workload
+    ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800, None),
+    ("sweep_remat", {"BENCH_SWEEP": "256,512", "BENCH_REMAT": "1"}, 1800, None),
+    # ^ best-of-batch values: in PROBE_RESULTS.jsonl only, never the store
+    ("pallas_smoke", {"PROBE_CMD": "smoke"}, 1500, None),
     # ^ compiled-on-TPU numerics for every Pallas kernel incl. the new
     #   time-fused LSTM sequence (interpret mode can hide lowering bugs)
     ("charrnn_seqfused", {"BENCH_MODEL": "charrnn",
-                          "DL4J_TPU_PALLAS": "seq"}, 1200),
+                          "DL4J_TPU_PALLAS": "seq"}, 1200, "_seqfused"),
     # ^ the whole-loop fused kernel vs the scan default, same shapes
-    ("charrnn_b128", {"BENCH_MODEL": "charrnn", "BENCH_BATCH": "128"}, 1200),
+    ("charrnn_b128", {"BENCH_MODEL": "charrnn",
+                      "BENCH_BATCH": "128"}, 1200, ""),
     # ^ B=64 fills half the MXU's 128 sublanes on the recurrent gemm; the
     #   batch-128 row shows the throughput the framework sustains when the
-    #   workload is MXU-shaped (own suffixed metric key)
+    #   workload is MXU-shaped (bench suffixes the shape key itself)
 ]
 
 
@@ -94,7 +101,7 @@ def main() -> int:
     deadline = time.time() + args.budget_s
     wedges = 0
     got = 0
-    for name, env_extra, step_timeout in chosen:
+    for name, env_extra, step_timeout, store_suffix in chosen:
         remaining = deadline - time.time()
         if remaining < 120:
             print(f"PLAN: budget exhausted before {name}")
@@ -108,13 +115,22 @@ def main() -> int:
             print(f"PLAN: {name} produced nothing (wedge {wedges})")
             continue
         wedges = 0
+        if result.get("ok") is False:
+            # the smoke run REACHED the chip but a kernel's compiled
+            # numerics diverged — loud, and not a "result"
+            with open(RESULTS, "a") as f:
+                f.write(json.dumps(result) + "\n")
+            print(f"PLAN: {name} FAILED NUMERICS: "
+                  f"{[k for k, v in result.get('checks', {}).items() if not v.get('ok')]}")
+            continue
         got += 1
-        if env_extra.get("DL4J_TPU_PALLAS") == "seq" and "metric" in result:
-            result["metric"] += "_seqfused"  # own key: don't overwrite the
-            #                                  scan default's _latest entry
+        if store_suffix and "metric" in result:
+            result["metric"] += store_suffix
         with open(RESULTS, "a") as f:
             f.write(json.dumps(result) + "\n")
-        if isinstance(result.get("value"), (int, float)) and result.get("metric"):
+        if (store_suffix is not None
+                and isinstance(result.get("value"), (int, float))
+                and result.get("metric")):
             # record into BENCH_SELF.json so a round-end CPU-fallback bench
             # line still carries this number in prior_tpu_measurements
             sys.path.insert(0, REPO)
