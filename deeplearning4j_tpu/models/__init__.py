@@ -7,7 +7,8 @@ configs users built with MultiLayerConfiguration/ComputationGraphConfiguration.
 from .alexnet import alexnet_conf
 from .googlenet import googlenet_conf
 from .lenet import lenet_mnist_conf
-from .resnet import resnet_conf, resnet18_conf, resnet34_conf, resnet50_conf
+from .resnet import (resnet_conf, resnet18_conf, resnet34_conf,
+                     resnet50_conf, resnet101_conf, resnet152_conf)
 from .char_rnn import char_rnn
 from .dbn import dbn_conf
 from ..modelimport.trained_models import vgg16_configuration
@@ -22,5 +23,7 @@ __all__ = [
     "resnet18_conf",
     "resnet34_conf",
     "resnet50_conf",
+    "resnet101_conf",
+    "resnet152_conf",
     "vgg16_configuration",
 ]

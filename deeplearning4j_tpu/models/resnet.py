@@ -147,3 +147,11 @@ def resnet18_conf(**kw) -> ComputationGraphConfiguration:
 
 def resnet34_conf(**kw) -> ComputationGraphConfiguration:
     return resnet_conf([3, 4, 6, 3], bottleneck=False, **kw)
+
+
+def resnet101_conf(**kw) -> ComputationGraphConfiguration:
+    return resnet_conf([3, 4, 23, 3], bottleneck=True, **kw)
+
+
+def resnet152_conf(**kw) -> ComputationGraphConfiguration:
+    return resnet_conf([3, 8, 36, 3], bottleneck=True, **kw)

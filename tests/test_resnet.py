@@ -49,3 +49,15 @@ class TestTinyResNetTrains:
         out = net.output(x)
         assert out.shape == (8, 4)
         assert np.allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_resnet_depth_variants_build():
+    """101/152 are the same builder at [3,4,23,3]/[3,8,36,3]; shape
+    inference over the full graph is the build-time proof."""
+    from deeplearning4j_tpu.models import resnet101_conf, resnet152_conf
+
+    for conf, n_blocks in ((resnet101_conf(), 3 + 4 + 23 + 3),
+                           (resnet152_conf(), 3 + 8 + 36 + 3)):
+        adds = [v for v in conf.vertices if v.endswith("_add")]
+        assert len(adds) == n_blocks
+        assert conf.output_types()[0].size == 1000
