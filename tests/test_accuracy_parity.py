@@ -82,6 +82,36 @@ class TestRealDataAccuracy:
         ev = net.evaluate(IrisDataSetIterator(batch=150, shuffle=False))
         assert ev.accuracy() >= 0.95, ev.stats()
 
+    def test_char_rnn_bits_per_char_pinned(self):
+        """Stacked GravesLSTM char model (BASELINE config #3 family) on real
+        English text via TBPTT: <= 1.8 bits/char after 60 epochs (measured
+        1.36; random over the 29-char vocab is 4.86 — BASELINE.md row
+        'char-rnn-pangrams')."""
+        from deeplearning4j_tpu.datasets.iterators import DataSet
+        from deeplearning4j_tpu.models.char_rnn import char_rnn
+
+        text = (
+            "the quick brown fox jumps over the lazy dog. "
+            "pack my box with five dozen liquor jugs. "
+            "how vexingly quick daft zebras jump! "
+        ) * 8
+        vocab = sorted(set(text))
+        stoi = {c: i for i, c in enumerate(vocab)}
+        ids = np.array([stoi[c] for c in text])
+        conf = char_rnn(vocab_size=len(vocab), hidden_size=96, num_layers=2,
+                        tbptt_length=32, learning_rate=3e-3, seed=5)
+        net = MultiLayerNetwork(conf).init()
+        t, b = 64, 8
+        n = (len(ids) - 1) // t
+        eye = np.eye(len(vocab), dtype=np.float32)
+        xs = np.stack([eye[ids[i * t:(i + 1) * t]] for i in range(n)])
+        ys = np.stack([eye[ids[i * t + 1:(i + 1) * t + 1]] for i in range(n)])
+        for _ in range(60):
+            for s in range(0, n - b + 1, b):
+                net.fit(DataSet(xs[s:s + b], ys[s:s + b]))
+        bpc = float(net.score(DataSet(xs[:b], ys[:b]))) / np.log(2)
+        assert bpc <= 1.8, bpc
+
     def test_digits_corpus_is_real(self):
         x, y = load_digits_dataset()
         assert x.shape == (1797, 64)
