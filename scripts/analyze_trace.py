@@ -61,7 +61,11 @@ def analyze(trace_dir: str):
         plane_names.append(plane.name)
         for line in plane.lines:
             for event in line.events:
-                if event.name.startswith("$"):  # host python trace markers
+                # host python trace markers + XLA:CPU executor machinery
+                # (the /host:CPU fallback plane mixes them in; TPU device
+                # planes carry only real ops)
+                if (event.name.startswith("$")
+                        or event.name.startswith("ThunkExecutor")):
                     continue
                 op_time[event.name] += event.duration_ns
 
