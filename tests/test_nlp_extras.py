@@ -159,6 +159,35 @@ def test_korean_segmenter_morphology():
     assert "텐서플로" in seg2.tokenize("텐서플로를 씁니다")
 
 
+def test_segmenters_partition_exactly():
+    """Property: the lattice PARTITIONS the text — concatenating the output
+    surfaces reproduces the input minus whitespace, over random mixed-script
+    strings (no character lost or duplicated by the per-POS DP)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.japanese import JapaneseSegmenter
+    from deeplearning4j_tpu.nlp.korean import KoreanSegmenter
+
+    rng = np.random.default_rng(0)
+    ko, ja = KoreanSegmenter(), JapaneseSegmenter()
+    for _ in range(40):
+        chars = []
+        for _ in range(int(rng.integers(1, 30))):
+            r = rng.random()
+            if r < 0.6:
+                chars.append(chr(0xAC00 + int(rng.integers(0, 11172))))
+            elif r < 0.75:
+                chars.append(chr(0x3040 + int(rng.integers(1, 0x5F))))
+            elif r < 0.85:
+                chars.append(" ")
+            else:
+                chars.append(chr(ord("a") + int(rng.integers(0, 26))))
+        text = "".join(chars)
+        for seg in (ko, ja):
+            toks = seg.tokenize(text, keep_symbols=True)
+            assert "".join(toks) == text.replace(" ", ""), (text, toks)
+
+
 def test_korean_tokenizer_josa_splitting():
     """Legacy opt-in josa splitting (dictionary-free suffix strip)."""
     tf = KoreanTokenizerFactory(split_josa=True)
