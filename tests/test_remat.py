@@ -142,6 +142,36 @@ def test_remat_composes_with_spmd_wrapper():
     assert "model" in tuple(s for s in spec if s is not None), spec
 
 
+def test_remat_composes_with_seq_fused_lstm(monkeypatch):
+    """jax.checkpoint around a layer whose apply runs a custom_vjp Pallas
+    kernel (DL4J_TPU_PALLAS=seq): the recomputed forward re-enters the
+    kernel and the numbers still match the plain scan path."""
+    from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+
+    def make(remat):
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=12, activation="tanh"),
+                    RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+            input_type=InputType.recurrent(5),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=4, remat=remat,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 9, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 9))]
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "seq")
+    a = make(True)
+    for _ in range(3):
+        a.fit((x, y))
+    monkeypatch.setenv("DL4J_TPU_PALLAS", "0")
+    b = make(False)
+    for _ in range(3):
+        b.fit((x, y))
+    _tree_allclose(a.params, b.params, atol=2e-5)
+
+
 def test_remat_composes_with_fit_on_device():
     """The scanned one-dispatch loop wraps the same train step, so remat
     must flow through fit_on_device unchanged."""
