@@ -133,6 +133,9 @@ class ClusterSetup:
         """Upload+run ``script`` on every host concurrently (the reference's
         provisioning thread per worker, ClusterSetup.provisionWorkers:94-121).
         Returns {host: output}; raises if any host fails."""
+        if not hosts:
+            raise ValueError("no hosts to provision")
+
         def one(host: str) -> str:
             return HostProvisioner(host, user=user, ssh_binary=ssh_binary,
                                    scp_binary=scp_binary).upload_and_run(script)
@@ -151,6 +154,8 @@ class ClusterSetup:
         ``--coordinator host0:port --num-processes N --process-id i`` —
         the argument contract of parallel/mesh.initialize_multihost (host 0
         is the coordinator, as the reference wires the driver first)."""
+        if not hosts:
+            raise ValueError("no hosts to launch on")
         coord = f"{hosts[0]}:{coordinator_port}"
         n = len(hosts)
 

@@ -633,6 +633,12 @@ class ComputationGraph:
         ):
             features_masks = [features_masks]
         if isinstance(features_masks, (list, tuple)):
+            if len(features_masks) != len(self.conf.network_inputs):
+                raise ValueError(
+                    f"features_masks has {len(features_masks)} entries but the "
+                    f"graph has {len(self.conf.network_inputs)} inputs "
+                    f"({self.conf.network_inputs})"
+                )
             features_masks = dict(zip(self.conf.network_inputs, features_masks))
         if features_masks is not None:
             features_masks = {k: None if m is None else jnp.asarray(m)

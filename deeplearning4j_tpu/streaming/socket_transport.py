@@ -51,6 +51,7 @@ class SocketRecordSource(RecordSource):
         self.host, self.port = self._server.getsockname()[:2]
         self._readers: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
+        self._registry_lock = threading.Lock()  # guards the two lists above
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="record-source-accept"
         )
@@ -65,11 +66,12 @@ class SocketRecordSource(RecordSource):
                 continue
             except OSError:  # closed under us during shutdown
                 return
-            self._conns.append(conn)  # close() closes these to unblock recv
             t = threading.Thread(target=self._read_loop, args=(conn,),
                                  daemon=True, name="record-source-reader")
+            with self._registry_lock:
+                self._conns.append(conn)  # close() closes these to unblock recv
+                self._readers.append(t)
             t.start()
-            self._readers.append(t)
 
     @staticmethod
     def _shaped(arr, shape) -> "np.ndarray":
@@ -107,6 +109,15 @@ class SocketRecordSource(RecordSource):
             # dropped/misbehaving producer (or close() closed the socket
             # under us): records delivered before the break survive
             return
+        finally:
+            # a long-lived source with churning producers must not
+            # accumulate dead sockets/threads without bound
+            with self._registry_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+                me = threading.current_thread()
+                if me in self._readers:
+                    self._readers.remove(me)
 
     # -- RecordSource --------------------------------------------------
     def poll(self, timeout: float = 0.1):
@@ -121,7 +132,9 @@ class SocketRecordSource(RecordSource):
             self._server.close()
         except OSError:
             pass
-        for c in self._conns:  # unblocks readers parked in recv: close()
+        with self._registry_lock:
+            conns, readers = list(self._conns), list(self._readers)
+        for c in conns:         # unblocks readers parked in recv: close()
             try:                # alone does not wake a blocked recv — the
                 c.shutdown(socket.SHUT_RDWR)  # FIN/reset from shutdown does
             except OSError:
@@ -131,7 +144,7 @@ class SocketRecordSource(RecordSource):
             except OSError:
                 pass
         self._accept_thread.join(timeout=5)
-        for t in self._readers:
+        for t in readers:
             t.join(timeout=5)
 
 
