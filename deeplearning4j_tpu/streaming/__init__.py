@@ -1,5 +1,10 @@
 """Streaming tier (reference: dl4j-streaming Kafka+Camel pipelines)."""
 
+from .embedded_kafka import (
+    EmbeddedKafkaBroker,
+    EmbeddedKafkaConsumer,
+    EmbeddedKafkaProducer,
+)
 from .pipeline import (
     KafkaSource,
     Route,
@@ -12,6 +17,9 @@ from .pipeline import (
 from .socket_transport import SocketRecordSink, SocketRecordSource, serve_records
 
 __all__ = [
+    "EmbeddedKafkaBroker",
+    "EmbeddedKafkaConsumer",
+    "EmbeddedKafkaProducer",
     "KafkaSource",
     "Route",
     "QueueSource",
