@@ -33,9 +33,17 @@ import jax.numpy as jnp
 # gate/activation catalog usable inside kernels, with value-derivatives
 # (derivative expressed in terms of the *activated* value, so the backward
 # kernel needs no pre-activation residuals)
+def _sigmoid(x):
+    """sigmoid(x) = (tanh(x/2)+1)/2, exactly. jax.nn.sigmoid (lax.logistic)
+    trips a Mosaic bf16 lowering bug inside Pallas TPU kernels ('vector.
+    broadcast' f32 scalar into a bf16 vector, verification error); the tanh
+    form lowers cleanly at every dtype and is mathematically identical."""
+    return 0.5 * (jnp.tanh(0.5 * x) + 1.0)
+
+
 _ACT = {
     "tanh": (jnp.tanh, lambda y: 1.0 - y * y),
-    "sigmoid": (jax.nn.sigmoid, lambda y: y * (1.0 - y)),
+    "sigmoid": (_sigmoid, lambda y: y * (1.0 - y)),
     "hardsigmoid": (
         lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
         lambda y: jnp.where((y > 0.0) & (y < 1.0), 0.2, 0.0),
@@ -61,7 +69,10 @@ def _interpret() -> bool:
 def _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate):
     """Shared gate math (column order [a, f, o, i] — LSTMHelpers parity)."""
     H = c_prev.shape[-1]
-    z = zx + jnp.dot(h_prev, RW, preferred_element_type=zx.dtype)
+    # Mosaic requires a 32-bit matmul accumulator (bf16 acc is rejected at
+    # verification); accumulate f32 and cast back to the compute dtype
+    z = zx + jnp.dot(h_prev, RW,
+                     preferred_element_type=jnp.float32).astype(zx.dtype)
     a = act(z[..., :H])
     f = gate(z[..., H : 2 * H] + c_prev * pF)
     i = gate(z[..., 3 * H :] + c_prev * pI)
@@ -100,8 +111,12 @@ def _bwd_kernel(dact, dgate, a_ref, f_ref, o_ref, i_ref, cact_ref, cprev_ref,
     dzx = jnp.concatenate([da, df, do, di], axis=-1)
     dcprev_out[:] = dc_tot * f + df * pF + di * pI
     dzx_out[:] = dzx
-    dhprev_out[:] = jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
-    drw_out[:] = jnp.dot(hprev_ref[:].T, dzx, preferred_element_type=dzx.dtype)
+    dhprev_out[:] = jnp.dot(
+        dzx, rw_ref[:].T, preferred_element_type=jnp.float32
+    ).astype(dzx.dtype)
+    drw_out[:] = jnp.dot(
+        hprev_ref[:].T, dzx, preferred_element_type=jnp.float32
+    ).astype(dzx.dtype)
     dpf_out[:] = jnp.sum(df * c_prev, axis=0)
     dpi_out[:] = jnp.sum(di * c_prev, axis=0)
     dpo_out[:] = jnp.sum(do * c, axis=0)
@@ -378,7 +393,9 @@ def _seq_bwd_kernel(act, dact, dgate, T,
     da = dc_tot * i * dact(a)
     dzx = jnp.concatenate([da, df, do, di], axis=-1)
     dzx_out[0] = dzx
-    dh_scr[:] = jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
+    dh_scr[:] = jnp.dot(
+        dzx, rw_ref[:].T, preferred_element_type=jnp.float32
+    ).astype(dzx.dtype)
     dc_scr[:] = dc_tot * f + df * pF + di * pI
     f32 = drw_scr.dtype
     drw_scr[:] += jnp.dot(h_prev.T, dzx, preferred_element_type=f32)
@@ -633,7 +650,7 @@ def _seq_fwd_kernel_masked(act, gate,
 
 def _seq_bwd_kernel_masked(act, dact, dgate, T,
                            dy_ref, dhT_ref, dcT_ref, m_ref,
-                           a_ref, f_ref, o_ref, i_ref, c_ref, cprev_ref,
+                           a_ref, f_ref, o_ref, i_ref, cprev_ref,
                            hprev_ref, rw_ref, pf_ref, pi_ref, po_ref,
                            h0_ref, c0_ref,
                            dzx_out, dh0_out, dc0_out, drw_out, dpf_out,
@@ -673,7 +690,8 @@ def _seq_bwd_kernel_masked(act, dact, dgate, T,
     dzx = jnp.concatenate([da, df, do, di], axis=-1)
     dzx_out[0] = dzx
     # carry-through paths: masked steps pass dh/dc straight to t-1
-    dh_scr[:] = (jnp.dot(dzx, rw_ref[:].T, preferred_element_type=dzx.dtype)
+    dh_scr[:] = (jnp.dot(dzx, rw_ref[:].T,
+                         preferred_element_type=jnp.float32).astype(dzx.dtype)
                  + (1.0 - m) * dh_t)
     dc_scr[:] = dc_tot * f + df * pF + di * pI + (1.0 - m) * dc_t
     f32 = drw_scr.dtype
@@ -784,7 +802,9 @@ def _seq_masked_bwd(act_name, gate_name, residuals, grads):
             pl.BlockSpec((B, H), const),
             pl.BlockSpec((B, H), const),
             pl.BlockSpec((1, B, 1), rev),
-            seq(rev), seq(rev), seq(rev), seq(rev), seq(rev),
+            seq(rev), seq(rev), seq(rev), seq(rev),
+            # the kernel recomputes c_tilde from the gates, so only the
+            # prev-indexed c stream is read (one T×B×H HBM stream saved)
             seq(prev),
             seq(prev),
             pl.BlockSpec((H, 4 * H), const),
@@ -811,7 +831,7 @@ def _seq_masked_bwd(act_name, gate_name, residuals, grads):
             pltpu.VMEM((1, H), jnp.float32),
         ],
         interpret=_interpret(),
-    )(dys, dhT, dcT, mask.astype(dt), a, f, o, i, c, c, ys,
+    )(dys, dhT, dcT, mask.astype(dt), a, f, o, i, c, ys,
       RW, pF, pI, pO, h0, c0)
     return dzx, None, dh0, dc0, dRW, dpF, dpI, dpO
 

@@ -26,6 +26,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from . import i18n
 from .storage import StatsStorage, InMemoryStatsStorage
 
 _STYLE = """
@@ -40,12 +41,16 @@ select{font-size:13px;margin:0 8px 8px 0}
 """
 
 _NAV = """<nav>
-<a href="/train/overview" id="nav-overview">Overview</a>
-<a href="/train/model" id="nav-model">Model</a>
-<a href="/train/system" id="nav-system">System</a>
-<a href="/train/flow" id="nav-flow">Flow</a>
-<a href="/train/activations" id="nav-activations">Activations</a>
-<a href="/train/tsne" id="nav-tsne">t-SNE</a>
+<a href="/train/overview" id="nav-overview">@@train.nav.overview@@</a>
+<a href="/train/model" id="nav-model">@@train.nav.model@@</a>
+<a href="/train/system" id="nav-system">@@train.nav.system@@</a>
+<a href="/train/flow" id="nav-flow">@@train.nav.flow@@</a>
+<a href="/train/activations" id="nav-activations">@@train.nav.activations@@</a>
+<a href="/train/tsne" id="nav-tsne">@@train.nav.tsne@@</a>
+<span style="float:right">@@train.nav.language@@:
+<a href="/setlang/en">en</a> <a href="/setlang/ja">ja</a>
+<a href="/setlang/ko">ko</a> <a href="/setlang/de">de</a>
+<a href="/setlang/ru">ru</a> <a href="/setlang/zh">zh</a></span>
 </nav>
 <script>
 const here = location.pathname.split('/').pop();
@@ -109,11 +114,11 @@ def _page(title: str, body: str) -> str:
             f"<h1>deeplearning4j_tpu — {title}</h1>{_NAV}{body}</body></html>")
 
 
-_OVERVIEW = _page("Training overview", """
-<div class="card"><h3>Score vs iteration</h3><svg id="score" width="800" height="240"></svg></div>
-<div class="card"><h3>Iteration time (ms)</h3><svg id="itertime" width="800" height="160"></svg></div>
-<div class="card"><h3>Sessions</h3><table id="sessions"><tr><th>session</th><th>workers</th><th>updates</th><th>last score</th></tr></table></div>
-<div class="card"><h3>Model</h3><pre id="model"></pre></div>
+_OVERVIEW = _page("@@train.overview.title@@", """
+<div class="card"><h3>@@train.overview.chart.score@@</h3><svg id="score" width="800" height="240"></svg></div>
+<div class="card"><h3>@@train.overview.chart.itertime@@</h3><svg id="itertime" width="800" height="160"></svg></div>
+<div class="card"><h3>@@train.overview.sessions@@</h3><table id="sessions"><tr><th>session</th><th>workers</th><th>updates</th><th>last score</th></tr></table></div>
+<div class="card"><h3>@@train.overview.model@@</h3><pre id="model"></pre></div>
 <script>
 async function refresh(){
   const sessions = await getJSON('/api/sessions');
@@ -136,7 +141,7 @@ async function refresh(){
 refresh(); setInterval(refresh, 3000);
 </script>""")
 
-_MODEL = _page("Model", """
+_MODEL = _page("@@train.model.title@@", """
 <div class="card">
 <label>Layer/parameter: <select id="layer"></select></label>
 <label>Kind: <select id="kind">
@@ -146,9 +151,9 @@ _MODEL = _page("Model", """
 </select></label>
 <label>Worker: <select id="worker"></select></label>
 </div>
-<div class="card"><h3>Mean magnitude vs iteration</h3><svg id="mm" width="800" height="220"></svg></div>
-<div class="card"><h3>Latest histogram</h3><svg id="hist" width="420" height="180"></svg></div>
-<div class="card"><h3>All layers — latest histograms</h3><div class="hrow" id="allhist"></div></div>
+<div class="card"><h3>@@train.model.meanmag@@</h3><svg id="mm" width="800" height="220"></svg></div>
+<div class="card"><h3>@@train.model.histogram@@</h3><svg id="hist" width="420" height="180"></svg></div>
+<div class="card"><h3>@@train.model.allhist@@</h3><div class="hrow" id="allhist"></div></div>
 <script>
 let session=null;
 async function refresh(){
@@ -183,7 +188,7 @@ document.getElementById('layer').addEventListener('change', refresh);
 refresh(); setInterval(refresh, 5000);
 </script>""")
 
-_SYSTEM = _page("System", """
+_SYSTEM = _page("@@train.system.title@@", """
 <div class="card"><h3>Host memory (RSS, MB)</h3><svg id="mem" width="800" height="180"></svg></div>
 <div class="card"><h3>Device memory in use (MB)</h3><svg id="devmem" width="800" height="180"></svg></div>
 <div class="card"><h3>Iteration time (ms)</h3><svg id="itertime" width="800" height="180"></svg></div>
@@ -221,7 +226,7 @@ async function refresh(){
 refresh(); setInterval(refresh, 3000);
 </script>""")
 
-_FLOW = _page("Flow", """
+_FLOW = _page("@@train.flow.title@@", """
 <div class="card"><h3>Network graph</h3><svg id="flow" width="900" height="600"></svg></div>
 <script>
 async function refresh(){
@@ -269,7 +274,7 @@ async function refresh(){
 refresh(); setInterval(refresh, 5000);
 </script>""")
 
-_ACTIVATIONS = _page("Conv activations", """
+_ACTIVATIONS = _page("@@train.activations.title@@", """
 <div class="card"><h3>First conv layer — feature maps (one input example)</h3>
 <div id="meta" style="font-size:13px;color:#555"></div>
 <div class="hrow" id="grids"></div></div>
@@ -296,7 +301,7 @@ async function refresh(){
 refresh(); setInterval(refresh, 4000);
 </script>""")
 
-_TSNE = _page("t-SNE", """
+_TSNE = _page("@@train.tsne.title@@", """
 <div class="card"><h3>t-SNE embedding</h3><svg id="scatter" width="820" height="620"></svg></div>
 <script>
 const COLORS = ['#36c','#c63','#693','#936','#369','#c36','#663','#339','#933','#396'];
@@ -374,7 +379,21 @@ class _Handler(BaseHTTPRequestHandler):
         storages: List[StatsStorage] = self.server.storages  # type: ignore
         path = self.path.split("?")[0].rstrip("/") or "/"
         if path in _PAGES:
-            return self._send(200, _PAGES[path].encode(), "text/html")
+            # ?lang=xx overrides per request; /setlang/xx sets the default
+            # (reference: DefaultI18N + the Play setlang route)
+            lang = self._query().get("lang") or None
+            page = i18n.get_instance().render(_PAGES[path], lang)
+            return self._send(200, page.encode(), "text/html")
+        if path.startswith("/setlang/"):
+            prov = i18n.get_instance()
+            code = path.rsplit("/", 1)[1]
+            if code not in prov.languages():  # unknown code: reject loudly
+                return self._send(404, b'{"error": "unknown language"}')
+            prov.set_default_language(code)
+            self.send_response(302)
+            self.send_header("Location", "/train/overview")
+            self.end_headers()
+            return None
         q = self._query()
         sess = q.get("session", "")
         if path == "/api/sessions":
@@ -440,6 +459,13 @@ class _Handler(BaseHTTPRequestHandler):
             for st in storages:
                 out.extend(st.get_static_info(sess))
             return self._send(200, json.dumps(out).encode())
+        if path == "/api/i18n":
+            prov = i18n.get_instance()
+            return self._send(200, json.dumps({
+                "default_language": prov.get_default_language(),
+                "languages": list(prov.languages()),
+                "messages": prov.catalog(q.get("lang") or None),
+            }).encode())
         return self._send(404, b'{"error": "not found"}')
 
     def do_POST(self):

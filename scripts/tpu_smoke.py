@@ -216,6 +216,87 @@ def check_fused_lstm_sequence_masked(results) -> bool:
     return ok
 
 
+def check_fused_lstm_bf16(results) -> bool:
+    """bf16 compute path at the char-RNN bench shape (B=64, H=512).
+
+    Regression check for a real escape: the kernels' recurrent matmuls used
+    ``preferred_element_type=<input dtype>``, which under bf16 asked Mosaic
+    for a bf16 accumulator — rejected at verification ('Expected matmul acc
+    to be 32-bit') so ``DL4J_TPU_PALLAS=1`` crashed on hardware while the
+    f32-only smoke stayed green. Kernels must accumulate f32 and cast.
+    """
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(7)
+    B, Hd = 64, 512
+    bf = jnp.bfloat16
+    zx = jnp.asarray(rng.normal(size=(B, 4 * Hd)) * 0.3, bf)
+    h = jnp.asarray(rng.normal(size=(B, Hd)) * 0.3, bf)
+    c = jnp.asarray(rng.normal(size=(B, Hd)) * 0.3, bf)
+    RW = jnp.asarray(rng.normal(size=(Hd, 4 * Hd)) * 0.05, bf)
+    pF = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, bf)
+    pI = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, bf)
+    pO = jnp.asarray(rng.normal(size=(Hd,)) * 0.1, bf)
+
+    def ref_f32(zx, h, c):
+        z = zx.astype(jnp.float32) + h.astype(jnp.float32) @ RW.astype(jnp.float32)
+        a, f, o, i = jnp.split(z, 4, axis=1)
+        cf = c.astype(jnp.float32)
+        a = jnp.tanh(a)
+        f = jax.nn.sigmoid(f + cf * pF.astype(jnp.float32))
+        i = jax.nn.sigmoid(i + cf * pI.astype(jnp.float32))
+        c_new = f * cf + i * a
+        o = jax.nn.sigmoid(o + c_new * pO.astype(jnp.float32))
+        return o * jnp.tanh(c_new), c_new
+
+    h1, c1 = jax.jit(lambda zx, h, c: pk.fused_lstm_cell(
+        zx, h, c, RW, pF, pI, pO))(zx, h, c)
+    h2, c2 = ref_f32(zx, h, c)
+    # bf16 arithmetic alone contributes ~1e-2 relative error vs f32
+    ok = _close("lstm_bf16_h", h1, h2, 5e-2, results, rtol=5e-2)
+    ok &= _close("lstm_bf16_c", c1, c2, 5e-2, results, rtol=5e-2)
+
+    g1 = jax.jit(jax.grad(lambda zx, h, c: jnp.sum(
+        pk.fused_lstm_cell(zx, h, c, RW, pF, pI, pO)[0].astype(jnp.float32) ** 2
+    ), argnums=(0, 1, 2)))(zx, h, c)
+    g2 = jax.grad(lambda zx, h, c: jnp.sum(
+        ref_f32(zx, h, c)[0] ** 2), argnums=(0, 1, 2))(zx, h, c)
+    for name, a, b in zip(("dzx", "dh", "dc"), g1, g2):
+        ok &= _close(f"lstm_bf16_{name}", a, b, 8e-2, results, rtol=8e-2)
+
+    # whole-sequence kernel, bf16, fwd + a parameter grad (dRW exercises the
+    # f32 scratch accumulator path)
+    T, Bs, Hs = 16, 32, 256
+    zxs = jnp.asarray(rng.normal(size=(T, Bs, 4 * Hs)) * 0.3, bf)
+    h0 = jnp.asarray(rng.normal(size=(Bs, Hs)) * 0.3, bf)
+    c0 = jnp.asarray(rng.normal(size=(Bs, Hs)) * 0.3, bf)
+    RWs = jnp.asarray(rng.normal(size=(Hs, 4 * Hs)) * 0.05, bf)
+    pFs = jnp.asarray(rng.normal(size=(Hs,)) * 0.1, bf)
+    pIs = jnp.asarray(rng.normal(size=(Hs,)) * 0.1, bf)
+    pOs = jnp.asarray(rng.normal(size=(Hs,)) * 0.1, bf)
+
+    def ref_seq(zxs, h0, c0, RWs):
+        def step(carry, z):
+            h, c = carry
+            h2, c2, *_ = pk._cell_math(z, h, c, RWs, pFs, pIs, pOs,
+                                       jnp.tanh, jax.nn.sigmoid)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), zxs)
+        return ys, hT, cT
+
+    ys1, hT1, cT1 = jax.jit(lambda *a: pk.fused_lstm_sequence(
+        *a, pFs, pIs, pOs, "tanh", "sigmoid"))(zxs, h0, c0, RWs)
+    ys2, hT2, cT2 = ref_seq(zxs, h0, c0, RWs)
+    ok &= _close("lstm_seq_bf16_ys", ys1, ys2, 5e-2, results, rtol=5e-2)
+    g1 = jax.jit(jax.grad(lambda *a: jnp.sum(pk.fused_lstm_sequence(
+        *a, pFs, pIs, pOs, "tanh", "sigmoid")[0].astype(jnp.float32) ** 2),
+        argnums=3))(zxs, h0, c0, RWs)
+    g2 = jax.grad(lambda *a: jnp.sum(
+        ref_seq(*a)[0].astype(jnp.float32) ** 2), argnums=3)(zxs, h0, c0, RWs)
+    ok &= _close("lstm_seq_bf16_dRW", g1, g2, 1e-1, results, rtol=1e-1)
+    return ok
+
+
 def check_fused_lrn(results) -> bool:
     from deeplearning4j_tpu.ops import pallas_kernels as pk
 
@@ -253,6 +334,7 @@ def main() -> int:
         ("fused_lstm", check_fused_lstm),
         ("fused_lstm_sequence", check_fused_lstm_sequence),
         ("fused_lstm_sequence_masked", check_fused_lstm_sequence_masked),
+        ("fused_lstm_bf16", check_fused_lstm_bf16),
         ("fused_lrn", check_fused_lrn),
     ):
         try:

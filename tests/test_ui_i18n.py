@@ -1,0 +1,89 @@
+"""UI internationalization (reference: deeplearning4j-play I18N.java /
+DefaultI18N.java / I18NProvider.java + the dl4j_i18n properties resources
+and the Play setlang route)."""
+
+import json
+import urllib.request
+
+from deeplearning4j_tpu.ui.i18n import I18N, get_instance
+from deeplearning4j_tpu.ui.server import UIServer
+
+
+class TestI18N:
+    def test_lookup_and_language_fallback(self):
+        i = I18N()
+        assert i.get_message("train.nav.overview") == "Overview"
+        assert i.get_message("train.nav.overview", "ja") == "概要"
+        assert i.get_message("train.nav.overview", "ko") == "개요"
+        # key missing from ko falls back to the default language...
+        assert i.get_message("train.overview.chart.itertime", "ko") \
+            == "Iteration time (ms)"
+        # ...and a key missing everywhere falls back to the key itself
+        assert i.get_message("no.such.key", "ja") == "no.such.key"
+
+    def test_default_language_switch(self):
+        i = I18N()
+        assert i.get_default_language() == "en"
+        i.set_default_language("de")
+        assert i.get_message("train.nav.overview") == "Übersicht"
+        # explicit language still wins over the default
+        assert i.get_message("train.nav.overview", "ru") == "Общая информация"
+
+    def test_render_substitutes_tokens(self):
+        i = I18N()
+        html = i.render("<h1>@@train.overview.title@@</h1>"
+                        "<a>@@train.nav.model@@</a>", "zh")
+        assert html == "<h1>训练概述</h1><a>模型</a>"
+        # unbalanced token renders literally rather than corrupting the page
+        assert i.render("a @@oops") == "a @@oops"
+
+    def test_properties_loader(self, tmp_path):
+        p = tmp_path / "train.custom.fr"
+        p.write_text("# comment\ntrain.nav.overview=Aperçu\n"
+                     "train.pagetitle = Interface d'entraînement\n",
+                     encoding="utf-8")
+        i = I18N()
+        assert i.load_properties(str(p), "fr") == 2
+        assert i.get_message("train.nav.overview", "fr") == "Aperçu"
+        assert "fr" in i.languages()
+
+    def test_provider_singleton(self):
+        assert get_instance() is get_instance()
+
+
+class TestServerI18N:
+    def test_pages_render_in_requested_language_and_setlang(self):
+        server = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            en = urllib.request.urlopen(f"{base}/train/overview").read().decode()
+            assert "Score vs iteration" in en and "@@" not in en
+            ja = urllib.request.urlopen(
+                f"{base}/train/overview?lang=ja").read().decode()
+            assert "スコア対反復" in ja and "@@" not in ja
+
+            # /setlang/<code> switches the default (302 back to overview)
+            req = urllib.request.Request(f"{base}/setlang/ja")
+            page = urllib.request.urlopen(req).read().decode()
+            assert "スコア対反復" in page
+            api = json.loads(urllib.request.urlopen(
+                f"{base}/api/i18n").read())
+            assert api["default_language"] == "ja"
+            assert "ja" in api["languages"]
+            assert api["messages"]["train.nav.overview"] == "概要"
+        finally:
+            get_instance().set_default_language("en")
+            server.stop()
+
+    def test_every_page_renders_token_free(self):
+        server = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            for page in ("overview", "model", "system", "flow",
+                         "activations", "tsne"):
+                for lang in ("en", "ja", "ko", "de", "ru", "zh"):
+                    html = urllib.request.urlopen(
+                        f"{base}/train/{page}?lang={lang}").read().decode()
+                    assert "@@" not in html, (page, lang)
+        finally:
+            server.stop()
