@@ -84,6 +84,9 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
         if os.environ.get("BENCH_REMAT") == "1":
             conf.remat = True  # per-vertex jax.checkpoint: HBM for FLOPs —
             #                    the lever for the memory-bound batch sizes
+        if os.environ.get("BENCH_PARAMS_BF16") == "1":
+            conf.params_dtype = "bfloat16"  # carry bf16 weights in the scan
+            #   (the round-5 trace's weight-copy-bound lever); own metric key
         net = ComputationGraph(conf).init()
         multi = net._build_multi_step(steps, 1)
 
@@ -124,6 +127,8 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     metric = "resnet50_imagenet_train_images_per_sec_per_chip"
     if conf.remat:
         metric += "_remat"  # different program: own key in the baseline store
+    if conf.params_dtype == "bfloat16":
+        metric += "_bf16params"
     result = {
         "metric": metric,
         "value": round(steps * batch / dt, 1),
@@ -443,12 +448,24 @@ def _tpu_child_main() -> int:
         result = bench_word2vec()
     elif sizes:
         results = []
+        errors = {}
         for bs in sizes:
-            r = bench_resnet50(batch=bs)
+            try:
+                r = bench_resnet50(batch=bs)
+            except Exception as e:  # noqa: BLE001 - one OOM batch must not
+                #                     void the batches that DID measure
+                errors[str(bs)] = f"{type(e).__name__}: {e}"[:300]
+                continue
             r["batch"] = bs
             results.append(r)
+        if not results:
+            print(json.dumps({"metric": "bench_error", "value": 0.0,
+                              "unit": "error", "errors": errors}))
+            return 1
         result = max(results, key=lambda r: r["value"])
         result["sweep"] = {str(r["batch"]): r["value"] for r in results}
+        if errors:
+            result["sweep_errors"] = errors
     else:
         try:
             batch = int(os.environ.get("BENCH_BATCH", "128"))

@@ -78,7 +78,8 @@ MNIST_SHA256 = {
 
 
 def fetch_mnist(root: Optional[str] = None, base_url: Optional[str] = None,
-                checksums: Optional[dict] = None) -> str:
+                checksums: Optional[dict] = None,
+                timeout_s: float = 60.0) -> str:
     """Download + checksum-verify the four MNIST IDX archives into ``root``
     (reference: base/MnistFetcher.java:39 — downloadAndUntar with pinned
     digests). Env-gated by nature: on a no-egress machine the urlopen fails
@@ -103,7 +104,7 @@ def fetch_mnist(root: Optional[str] = None, base_url: Optional[str] = None,
             if hashlib.sha256(open(dest, "rb").read()).hexdigest() == want:
                 continue
             os.remove(dest)  # stale/corrupt cache entry
-        with urllib.request.urlopen(f"{base}/{name}", timeout=60) as r:
+        with urllib.request.urlopen(f"{base}/{name}", timeout=timeout_s) as r:
             data = r.read()
         got = hashlib.sha256(data).hexdigest()
         if got != want:

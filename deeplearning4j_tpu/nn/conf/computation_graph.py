@@ -44,6 +44,10 @@ class ComputationGraphConfiguration:
     # per-vertex jax.checkpoint rematerialization (see
     # MultiLayerConfiguration.remat): HBM for FLOPs at memory-bound batches
     remat: bool = False
+    # "bfloat16" carries params in the compute dtype (see
+    # MultiLayerConfiguration.params_dtype — the weight-copy-bound lever
+    # from the round-5 ResNet trace); None = f32 master + per-step cast
+    params_dtype: Optional[str] = None
 
     # ------------------------------------------------------------- topo order
     def topological_order(self) -> List[str]:
@@ -112,6 +116,7 @@ class ComputationGraphConfiguration:
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "remat": self.remat,
+            "params_dtype": self.params_dtype,
         }
 
     def to_json(self) -> str:
@@ -132,6 +137,7 @@ class ComputationGraphConfiguration:
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             remat=d.get("remat", False),
+            params_dtype=d.get("params_dtype"),
         )
 
     @staticmethod
@@ -196,6 +202,10 @@ class GraphBuilder:
 
     def remat(self, enabled: bool = True) -> "GraphBuilder":
         self._conf.remat = enabled
+        return self
+
+    def params_dtype(self, dtype: Optional[str]) -> "GraphBuilder":
+        self._conf.params_dtype = dtype
         return self
 
     def tbptt(self, fwd_length: int, back_length: Optional[int] = None) -> "GraphBuilder":

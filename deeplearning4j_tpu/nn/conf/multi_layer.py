@@ -40,6 +40,14 @@ class MultiLayerConfiguration:
     # layer's internals from its input instead of storing them — HBM for
     # FLOPs, for batch sizes that are otherwise memory-bound on TPU
     remat: bool = False
+    # "bfloat16" carries the parameters themselves in the compute dtype
+    # (the round-5 ResNet-50 trace shows the TensorCore stalling on f32
+    # master-weight copies ~80% of its sync windows: carrying bf16 halves
+    # that traffic). Default None = f32 master params + per-step bf16 cast
+    # — the safe mixed-precision convention; bf16 params update in bf16,
+    # which loses tiny-update precision, so this is a perf lever to A/B,
+    # not a silent default.
+    params_dtype: Optional[str] = None
     # per-layer-index input preprocessors (reference: nn/conf/preprocessor/*);
     # stored as {"idx": {"@type": ...}} in JSON
     preprocessors: Dict[int, object] = field(default_factory=dict)
@@ -75,6 +83,7 @@ class MultiLayerConfiguration:
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "remat": self.remat,
+            "params_dtype": self.params_dtype,
             "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
         }
 
@@ -95,6 +104,7 @@ class MultiLayerConfiguration:
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             remat=d.get("remat", False),
+            params_dtype=d.get("params_dtype"),
             preprocessors={
                 int(k): preprocessor_from_dict(v)
                 for k, v in (d.get("preprocessors") or {}).items()

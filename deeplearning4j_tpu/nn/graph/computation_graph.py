@@ -21,7 +21,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..multilayer import _cast_input, _cast_params, _format_summary_table
+from ..multilayer import (
+    _carry_params_dtype,
+    _cast_input,
+    _cast_params,
+    _format_summary_table,
+)
 from .vertices import LayerVertex
 
 
@@ -62,6 +67,7 @@ class ComputationGraph:
                 name: self.conf.vertices[name].init_params(k, *vit[name])
                 for name, k in zip(self._topo, keys)
             }
+        params = _carry_params_dtype(self.conf, params)
         self.params = params
         self.state = {
             name: self.conf.vertices[name].init_state(*vit[name]) for name in self._topo

@@ -41,6 +41,24 @@ def _cast_params(conf_dtype: str, params):
     return params
 
 
+def _carry_params_dtype(conf, params):
+    """Apply conf.params_dtype to freshly-initialized params (the round-5
+    weight-copy lever): "bfloat16" carries params in the compute dtype;
+    None/"float32" keeps the f32 master convention. Shared by
+    MultiLayerNetwork.init and ComputationGraph.init."""
+    pd = getattr(conf, "params_dtype", None)
+    if pd in (None, "float32"):
+        return params
+    if pd != "bfloat16":
+        raise ValueError(
+            f"params_dtype={pd!r} is not supported (use None, 'float32', "
+            "or 'bfloat16')"
+        )
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+
+
 def _cast_input(conf_dtype: str, params, x):
     """Align one input array with the compute dtype of (already-cast) params."""
     if conf_dtype == "bfloat16":
@@ -118,6 +136,7 @@ class MultiLayerNetwork:
                 layer.init_params(k, it)
                 for layer, k, it in zip(self.conf.layers, keys, input_types)
             )
+        params = _carry_params_dtype(self.conf, params)
         self.params = params
         self.state = tuple(
             layer.init_state(it) for layer, it in zip(self.conf.layers, input_types)

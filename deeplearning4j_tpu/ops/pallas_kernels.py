@@ -33,17 +33,22 @@ import jax.numpy as jnp
 # gate/activation catalog usable inside kernels, with value-derivatives
 # (derivative expressed in terms of the *activated* value, so the backward
 # kernel needs no pre-activation residuals)
-def _sigmoid(x):
-    """sigmoid(x) = (tanh(x/2)+1)/2, exactly. jax.nn.sigmoid (lax.logistic)
-    trips a Mosaic bf16 lowering bug inside Pallas TPU kernels ('vector.
-    broadcast' f32 scalar into a bf16 vector, verification error); the tanh
-    form lowers cleanly at every dtype and is mathematically identical."""
+def _sigmoid_kernel(x):
+    """sigmoid(x) = (tanh(x/2)+1)/2 — used ONLY inside Pallas kernel bodies.
+
+    jax.nn.sigmoid (lax.logistic) trips a Mosaic bf16 lowering bug inside
+    Pallas TPU kernels ('vector.broadcast' f32 scalar into a bf16 vector,
+    verification error); the tanh form lowers cleanly at every dtype and is
+    mathematically identical. The XLA scan path keeps lax.logistic: the
+    tanh form underflows to exactly 0/1 for saturated gates where
+    lax.logistic preserves tiny values — a relative-precision loss the
+    float64 finite-difference gradchecks can resolve."""
     return 0.5 * (jnp.tanh(0.5 * x) + 1.0)
 
 
 _ACT = {
     "tanh": (jnp.tanh, lambda y: 1.0 - y * y),
-    "sigmoid": (_sigmoid, lambda y: y * (1.0 - y)),
+    "sigmoid": (jax.nn.sigmoid, lambda y: y * (1.0 - y)),
     "hardsigmoid": (
         lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
         lambda y: jnp.where((y > 0.0) & (y < 1.0), 0.2, 0.0),
@@ -51,6 +56,17 @@ _ACT = {
     "relu": (jax.nn.relu, lambda y: (y > 0.0).astype(y.dtype)),
     "identity": (lambda x: x, lambda y: jnp.ones_like(y)),
 }
+
+# kernel-side table: identical except for the Mosaic-safe sigmoid
+_ACT_KERNEL = dict(_ACT)
+_ACT_KERNEL["sigmoid"] = (_sigmoid_kernel, _ACT["sigmoid"][1])
+
+
+def _acc_dtype(dt):
+    """Matmul accumulator dtype: ≥f32 always (Mosaic rejects a bf16 acc —
+    'Expected matmul acc to be 32-bit'), but never BELOW the input dtype
+    (f32 accumulation under the float64 gradcheck suites would truncate)."""
+    return jnp.float32 if jnp.dtype(dt).itemsize < 4 else dt
 
 
 def supported_lstm_activations(act: str, gate: str) -> bool:
@@ -72,7 +88,7 @@ def _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate):
     # Mosaic requires a 32-bit matmul accumulator (bf16 acc is rejected at
     # verification); accumulate f32 and cast back to the compute dtype
     z = zx + jnp.dot(h_prev, RW,
-                     preferred_element_type=jnp.float32).astype(zx.dtype)
+                     preferred_element_type=_acc_dtype(zx.dtype)).astype(zx.dtype)
     a = act(z[..., :H])
     f = gate(z[..., H : 2 * H] + c_prev * pF)
     i = gate(z[..., 3 * H :] + c_prev * pI)
@@ -112,10 +128,10 @@ def _bwd_kernel(dact, dgate, a_ref, f_ref, o_ref, i_ref, cact_ref, cprev_ref,
     dcprev_out[:] = dc_tot * f + df * pF + di * pI
     dzx_out[:] = dzx
     dhprev_out[:] = jnp.dot(
-        dzx, rw_ref[:].T, preferred_element_type=jnp.float32
+        dzx, rw_ref[:].T, preferred_element_type=_acc_dtype(dzx.dtype)
     ).astype(dzx.dtype)
     drw_out[:] = jnp.dot(
-        hprev_ref[:].T, dzx, preferred_element_type=jnp.float32
+        hprev_ref[:].T, dzx, preferred_element_type=_acc_dtype(dzx.dtype)
     ).astype(dzx.dtype)
     dpf_out[:] = jnp.sum(df * c_prev, axis=0)
     dpi_out[:] = jnp.sum(di * c_prev, axis=0)
@@ -139,8 +155,8 @@ def fused_lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
 def _cell_fwd_impl(zx, h_prev, c_prev, RW, pF, pI, pO, act_name, gate_name):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
-    act, _ = _ACT[act_name]
-    gate, _ = _ACT[gate_name]
+    act, _ = _ACT_KERNEL[act_name]
+    gate, _ = _ACT_KERNEL[gate_name]
     B, H = c_prev.shape
     dt = zx.dtype
     shapes = [jax.ShapeDtypeStruct((B, H), dt)] * 7
@@ -165,8 +181,8 @@ def _cell_bwd(act_name, gate_name, residuals, grads):
 
     a, f, o, i, cact, c_prev, c, h_prev, RW, pF, pI, pO = residuals
     dh, dc = grads
-    _, dact = _ACT[act_name]
-    _, dgate = _ACT[gate_name]
+    _, dact = _ACT_KERNEL[act_name]
+    _, dgate = _ACT_KERNEL[gate_name]
     B, H = c_prev.shape
     dt = dh.dtype
     out_shape = (
@@ -394,7 +410,7 @@ def _seq_bwd_kernel(act, dact, dgate, T,
     dzx = jnp.concatenate([da, df, do, di], axis=-1)
     dzx_out[0] = dzx
     dh_scr[:] = jnp.dot(
-        dzx, rw_ref[:].T, preferred_element_type=jnp.float32
+        dzx, rw_ref[:].T, preferred_element_type=_acc_dtype(dzx.dtype)
     ).astype(dzx.dtype)
     dc_scr[:] = dc_tot * f + df * pF + di * pI
     f32 = drw_scr.dtype
@@ -457,8 +473,8 @@ def _seq_lean_impl(zx, mask, h0, c0, RW, pF, pI, pO, act_name, gate_name):
     from jax.experimental import pallas as pl  # noqa: PLC0415
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
-    act, _ = _ACT[act_name]
-    gate, _ = _ACT[gate_name]
+    act, _ = _ACT_KERNEL[act_name]
+    gate, _ = _ACT_KERNEL[gate_name]
     T, B, H4 = zx.shape
     H = H4 // 4
     dt = zx.dtype
@@ -501,8 +517,8 @@ def _seq_fwd_impl(zx, h0, c0, RW, pF, pI, pO, act_name, gate_name):
     from jax.experimental import pallas as pl  # noqa: PLC0415
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
-    act, _ = _ACT[act_name]
-    gate, _ = _ACT[gate_name]
+    act, _ = _ACT_KERNEL[act_name]
+    gate, _ = _ACT_KERNEL[gate_name]
     T, B, H4 = zx.shape
     H = H4 // 4
     dt = zx.dtype
@@ -553,8 +569,8 @@ def _seq_bwd(act_name, gate_name, residuals, grads):
 
     ys, a, f, o, i, c, h0, c0, RW, pF, pI, pO = residuals
     dys, dhT, dcT = grads
-    act, dact = _ACT[act_name]
-    _, dgate = _ACT[gate_name]
+    act, dact = _ACT_KERNEL[act_name]
+    _, dgate = _ACT_KERNEL[gate_name]
     T, B, H = ys.shape
     dt = ys.dtype
     rev = lambda k: (T - 1 - k, 0, 0)   # noqa: E731
@@ -691,7 +707,8 @@ def _seq_bwd_kernel_masked(act, dact, dgate, T,
     dzx_out[0] = dzx
     # carry-through paths: masked steps pass dh/dc straight to t-1
     dh_scr[:] = (jnp.dot(dzx, rw_ref[:].T,
-                         preferred_element_type=jnp.float32).astype(dzx.dtype)
+                         preferred_element_type=_acc_dtype(dzx.dtype)
+                         ).astype(dzx.dtype)
                  + (1.0 - m) * dh_t)
     dc_scr[:] = dc_tot * f + df * pF + di * pI + (1.0 - m) * dc_t
     f32 = drw_scr.dtype
@@ -724,8 +741,8 @@ def _seq_masked_fwd_impl(zx, mask, h0, c0, RW, pF, pI, pO, act_name,
     from jax.experimental import pallas as pl  # noqa: PLC0415
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
-    act, _ = _ACT[act_name]
-    gate, _ = _ACT[gate_name]
+    act, _ = _ACT_KERNEL[act_name]
+    gate, _ = _ACT_KERNEL[gate_name]
     T, B, H4 = zx.shape
     H = H4 // 4
     dt = zx.dtype
@@ -777,8 +794,8 @@ def _seq_masked_bwd(act_name, gate_name, residuals, grads):
 
     ys, a, f, o, i, c, mask, h0, c0, RW, pF, pI, pO = residuals
     dys, dhT, dcT = grads
-    act, dact = _ACT[act_name]
-    _, dgate = _ACT[gate_name]
+    act, dact = _ACT_KERNEL[act_name]
+    _, dgate = _ACT_KERNEL[gate_name]
     T, B, H = ys.shape
     dt = ys.dtype
     rev = lambda k: (T - 1 - k, 0, 0)   # noqa: E731

@@ -54,16 +54,34 @@ def analyze(trace_dir: str):
     files = find_xplane_files(trace_dir)
     if not files:
         raise SystemExit(f"no .xplane.pb under {trace_dir}")
-    op_time = defaultdict(float)   # ns
+    op_time = defaultdict(float)      # ns, synchronous op executions
+    async_time = defaultdict(float)   # ns, async spans (overlap compute)
     plane_names = []
 
     def eat(plane) -> None:
         plane_names.append(plane.name)
-        for line in plane.lines:
+        # TPU device planes carry several lines: "XLA Ops" holds the real
+        # per-op execution windows; "Async XLA Ops" holds copy-start/done
+        # style spans that OVERLAP compute (summing them into the op total
+        # double-counts and drowns the compute signal — the round-5 trace
+        # read 63% "copy" before this split); "Steps"/"XLA Modules" are
+        # umbrella events spanning the whole program.
+        lines = {line.name: line for line in plane.lines}
+        if "XLA Ops" in lines:
+            for event in lines["XLA Ops"].events:
+                # control-flow umbrellas span their whole body; the body ops
+                # are separately present on this line
+                root = event.name.split(" =")[0]
+                if re.match(r"%?(while|conditional|call)\b", root.lstrip("%")):
+                    continue
+                op_time[event.name] += event.duration_ns
+            if "Async XLA Ops" in lines:
+                for event in lines["Async XLA Ops"].events:
+                    async_time[event.name] += event.duration_ns
+            return
+        for line in plane.lines:  # CPU fallback plane: flat lines
             for event in line.events:
                 # host python trace markers + XLA:CPU executor machinery
-                # (the /host:CPU fallback plane mixes them in; TPU device
-                # planes carry only real ops)
                 if (event.name.startswith("$")
                         or event.name.startswith("ThunkExecutor")):
                     continue
@@ -95,10 +113,19 @@ def analyze(trace_dir: str):
         n: t for n, t in op_time.items()
         if re.search(r"f32|float32", n) and not re.search(r"reduce|convert", n)
     }
+    async_total = sum(async_time.values())
+    async_buckets = defaultdict(float)
+    for name, t in async_time.items():
+        async_buckets[bucket_of(name)] += t
     return {
         "trace_dir": trace_dir,
         "planes": sorted(set(plane_names)),
         "total_device_ns": total,
+        "async_span_ns": async_total,
+        "async_buckets_pct_of_op_total": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(async_buckets.items(), key=lambda kv: -kv[1])
+        },
         "buckets_pct": {
             k: round(100.0 * v / total, 2)
             for k, v in sorted(buckets.items(), key=lambda kv: -kv[1])
@@ -123,9 +150,14 @@ def main() -> int:
     report = analyze(args.trace_dir)
     print(f"device planes: {report['planes']}")
     print(f"total device time: {report['total_device_ns'] / 1e6:.2f} ms")
-    print("\nbuckets:")
+    print("\nbuckets (XLA Ops — synchronous execution windows):")
     for k, pct in report["buckets_pct"].items():
         print(f"  {k:>16}: {pct:6.2f}%")
+    if report.get("async_span_ns"):
+        print(f"\nasync spans (overlap compute; {report['async_span_ns'] / 1e6:.2f} ms"
+              " total, as % of op total):")
+        for k, pct in report["async_buckets_pct_of_op_total"].items():
+            print(f"  {k:>16}: {pct:6.2f}%")
     print(f"\ntop {args.top} ops:")
     for op in report["top_ops"][: args.top]:
         print(f"  {op['pct']:6.2f}%  {op['name']}")

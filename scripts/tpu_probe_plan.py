@@ -34,36 +34,44 @@ RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
 # key so variants never contaminate the canonical rows' _latest/anchor.
 STEPS = [
     ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500, ""),
+    # ^ since round 5 the TPU default dispatch is the whole-loop fused
+    #   sequence kernel (measured 1.97x the scan), so this IS the seq row
     ("charrnn_small", {"BENCH_MODEL": "charrnn", "BENCH_SEQ": "128",
                        "BENCH_STEPS": "10"}, 900, ""),
     # ^ much cheaper nested-scan compile: if this lands where the default
     #   shape wedged, the tunnel was healthy and the default compile is the
     #   bottleneck (round-3 lesson) — bench suffixes the shape itself
     ("resnet50_b128", {}, 1200, ""),
-    ("charrnn_fused", {"BENCH_MODEL": "charrnn",
-                       "DL4J_TPU_PALLAS": "1"}, 1200, "_fusedcell"),
-    # ^ scan-body math is the measured default (ops/__init__.py
-    #   lstm_helper_enabled: 3.3 vs 4.5 ms/step at B=128,H=256 on v5e);
-    #   this step re-checks the fused Pallas cell at the bench shape
-    #   (B=64,H=512) so BASELINE.md can carry both numbers
+    ("charrnn_scan", {"BENCH_MODEL": "charrnn",
+                      "DL4J_TPU_PALLAS": "0"}, 1200, "_scan"),
+    # ^ keeps the lax.scan path measured now that seq-fused is the default
+    #   (round-5: scan 1,489,072 vs seq-fused 2,926,168 chars/sec)
     ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200, ""),
     # ^ the timed region runs BEFORE the trace capture, so the value is a
     #   clean measurement of the canonical workload
+    ("word2vec", {"BENCH_MODEL": "word2vec"}, 1200, "_tpu"),
+    # ^ embedding-engine row (host example-gen + per-batch dispatch: over
+    #   the tunnel this measures RPC pipelining too — round-5: 38.2k
+    #   words/s TPU vs 45.6k CPU)
     ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800, None),
-    ("sweep_remat", {"BENCH_SWEEP": "256,512", "BENCH_REMAT": "1"}, 1800, None),
-    # ^ best-of-batch values: in PROBE_RESULTS.jsonl only, never the store
+    ("resnet50_bf16params", {"BENCH_PARAMS_BF16": "1"}, 1200, ""),
+    # ^ bf16 weight carry (round-5 trace lever; measured neutral at b128 —
+    #   re-check whenever the step program changes materially)
     ("pallas_smoke", {"PROBE_CMD": "smoke"}, 1500, None),
-    # ^ compiled-on-TPU numerics for every Pallas kernel incl. the new
-    #   time-fused LSTM sequence (interpret mode can hide lowering bugs)
-    ("charrnn_seqfused", {"BENCH_MODEL": "charrnn",
-                          "DL4J_TPU_PALLAS": "seq"}, 1200, "_seqfused"),
-    # ^ the whole-loop fused kernel vs the scan default, same shapes
+    # ^ compiled-on-TPU numerics for every Pallas kernel incl. the fused
+    #   sequence + bf16 checks (interpret mode hid two real Mosaic bugs)
+    ("charrnn_fused", {"BENCH_MODEL": "charrnn",
+                       "DL4J_TPU_PALLAS": "1"}, 1200, "_fusedcell"),
+    # ^ per-step fused cell, kept measured (round-5: 1,464,552 — neutral
+    #   vs scan at the bench shape)
     ("charrnn_b128", {"BENCH_MODEL": "charrnn",
                       "BENCH_BATCH": "128"}, 1200, ""),
     # ^ B=64 fills half the MXU's 128 sublanes on the recurrent gemm; the
     #   batch-128 row shows the throughput the framework sustains when the
     #   workload is MXU-shaped (bench suffixes the shape key itself)
 ]
+# NOT queued: BENCH_REMAT sweeps — measured strictly worse on ResNet-50
+# (b256 2,737→1,797, b512 OOM where plain fits; see BASELINE.md round 5).
 
 
 def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:

@@ -1,0 +1,105 @@
+"""conf.params_dtype="bfloat16": carry parameters in the compute dtype
+(the round-5 weight-copy-bound lever; BASELINE.md trace analysis). The
+default (None) keeps f32 master params with a per-step bf16 compute cast."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+
+
+def _data(n=64, n_in=8, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = np.eye(k, dtype=np.float32)[rng.integers(0, k, n)]
+    feats = (labels @ rng.normal(size=(k, n_in)) * 2
+             + rng.normal(scale=0.2, size=(n, n_in))).astype(np.float32)
+    return feats, labels
+
+
+def _conf(params_dtype):
+    return MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        seed=1, dtype="bfloat16", params_dtype=params_dtype,
+    )
+
+
+def test_bf16_params_train_and_leaf_dtypes():
+    feats, labels = _data()
+    net = MultiLayerNetwork(_conf("bfloat16")).init()
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    s0 = float(net.score(DataSet(feats, labels)))
+    for _ in range(15):
+        net.fit(DataSet(feats, labels))
+    assert float(net.score(DataSet(feats, labels))) < s0
+    # params stayed bf16 through the optimizer updates
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+
+
+def test_default_keeps_wide_master():
+    # master params stay at full width (f32; f64 under the suite's x64 mode)
+    net = MultiLayerNetwork(_conf(None)).init()
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype in (jnp.float32, jnp.float64)
+            assert leaf.dtype != jnp.bfloat16
+
+
+def test_unknown_params_dtype_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="params_dtype"):
+        MultiLayerNetwork(_conf("bf16")).init()  # typo must be loud
+
+
+def test_params_dtype_json_round_trip():
+    conf = _conf("bfloat16")
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.params_dtype == "bfloat16"
+    assert MultiLayerConfiguration.from_json(
+        _conf(None).to_json()).params_dtype is None
+
+
+def test_graph_params_dtype():
+    from deeplearning4j_tpu.nn.conf.computation_graph import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+    conf = (ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(8))
+            .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .updater(UpdaterConfig(updater="sgd", learning_rate=0.1))
+            .dtype("bfloat16").params_dtype("bfloat16")
+            .build())
+    g = ComputationGraph(conf).init()
+    for leaf in jax.tree_util.tree_leaves(g.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    feats, labels = _data()
+    from deeplearning4j_tpu.datasets.iterators import DataSet as DS
+    s0 = float(g.score(DS(feats, labels)))
+    for _ in range(15):
+        g.fit(DS(feats, labels))
+    assert float(g.score(DS(feats, labels))) < s0
+    back = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert back.params_dtype == "bfloat16"
