@@ -215,6 +215,12 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
             flops_per_step /= steps  # backend hides cost analysis: heuristic
         result["flops_per_step"] = flops_per_step
         result["mfu_pct"] = round(profiler.mfu(flops_per_step, step_s), 1)
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
+        with profiler.trace(trace_dir):
+            p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)
+            np.asarray(losses)
+        result["trace_dir"] = trace_dir
     return result
 
 

@@ -63,9 +63,14 @@ def _lstm_scan(
     pF, pI, pO = params[p + "pF"], params[p + "pI"], params[p + "pO"]
     H = RW.shape[0]
 
-    # One big MXU matmul for every timestep's input projection.
-    xw = x @ W + b  # [B, T, 4H]
-    xw_t = jnp.swapaxes(xw, 0, 1)  # [T, B, 4H] time-major for scan
+    # One big MXU matmul for every timestep's input projection, computed
+    # DIRECTLY time-major: transposing x first moves [T,B,n_in] bytes where
+    # transposing the projection would move [T,B,4H] — on the round-5
+    # char-RNN trace the two materialized [256,64,2048] projection
+    # transposes (fwd + VJP) were ~48% of the step's synchronous device
+    # windows, dwarfing the recurrent kernel itself.
+    x_t = jnp.swapaxes(x, 0, 1)  # [T, B, n_in]
+    xw_t = x_t @ W + b  # [T, B, 4H] time-major for scan/kernel
     from ... import ops as _ops0  # noqa: PLC0415
     from ...nn.activations import is_builtin as _is_builtin  # noqa: PLC0415
 
@@ -74,7 +79,7 @@ def _lstm_scan(
         and _ops0.lstm_sequence_enabled()
         and _ops0.supported_lstm_activations(act_name.lower(), gate_name.lower())
         and _is_builtin(act_name) and _is_builtin(gate_name)
-        and _ops0.sequence_fits(x.shape[0], H, xw.dtype.itemsize)
+        and _ops0.sequence_fits(x.shape[0], H, xw_t.dtype.itemsize)
     ):
         # whole-loop fusion: h/c carries live in VMEM across the time grid
         # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence).
@@ -92,7 +97,7 @@ def _lstm_scan(
                 act_name.lower(), gate_name.lower()
             )
         else:
-            m_seq = jnp.swapaxes(mask.astype(xw.dtype), 0, 1)[..., None]
+            m_seq = jnp.swapaxes(mask.astype(xw_t.dtype), 0, 1)[..., None]
             if reverse:
                 m_seq = jnp.flip(m_seq, 0)
             ys, h_f, c_f = fused_lstm_sequence_masked(
@@ -103,9 +108,9 @@ def _lstm_scan(
             ys = jnp.flip(ys, 0)
         return jnp.swapaxes(ys, 0, 1), h_f, c_f
     if mask is not None:
-        mask_t = jnp.swapaxes(mask.astype(xw.dtype), 0, 1)[..., None]  # [T, B, 1]
+        mask_t = jnp.swapaxes(mask.astype(xw_t.dtype), 0, 1)[..., None]  # [T, B, 1]
     else:
-        mask_t = jnp.ones((xw_t.shape[0], 1, 1), xw.dtype)
+        mask_t = jnp.ones((xw_t.shape[0], 1, 1), xw_t.dtype)
 
     # Recurrent cell: the pallas helper tier fuses the h@RW matmul + gate
     # chain in VMEM when the activation pair is in its catalog AND neither

@@ -35,13 +35,22 @@ def try_mnist(timeout_s: float) -> str:
 
     root = os.environ.get("MNIST_DIR", os.path.expanduser("~/.dl4j-tpu/mnist"))
     existed = os.path.isdir(root)
+    before = set(os.listdir(root)) if existed else set()
     try:
         # explicit per-request timeout: fetch_mnist's urlopen calls ignore
         # the socket default
         return f"fetched:{fetch_mnist(timeout_s=timeout_s)}"
     except Exception as e:  # noqa: BLE001 - opportunistic by design
-        if not existed and os.path.isdir(root) and not os.listdir(root):
-            os.rmdir(root)  # don't leave an empty dir confusing gated tests
+        # a PARTIAL download must not survive: the gated tests check for
+        # the archives, and a half-set would corrupt their skip logic
+        if os.path.isdir(root):
+            for name in set(os.listdir(root)) - before:
+                try:
+                    os.remove(os.path.join(root, name))
+                except OSError:
+                    pass
+            if not existed and not os.listdir(root):
+                os.rmdir(root)
         return f"unreachable ({type(e).__name__})"
 
 
@@ -62,10 +71,20 @@ def try_vgg16(timeout_s: float) -> str:
                 if not chunk:
                     break
                 f.write(chunk)
-        # sanity: a real Keras HDF5 archive starts with the HDF5 signature
+        # sanity: HDF5 signature + the same size floor the cache check
+        # applies (the real archive is ~528 MB); optionally a pinned digest
         with open(tmp, "rb") as f:
             if f.read(8) != b"\x89HDF\r\n\x1a\n":
                 raise ValueError("downloaded file is not HDF5")
+        if os.path.getsize(tmp) <= (1 << 20):
+            raise ValueError("downloaded file is implausibly small")
+        want = os.environ.get("DL4J_TPU_VGG16_SHA256")
+        if want:
+            import hashlib
+
+            got = hashlib.sha256(open(tmp, "rb").read()).hexdigest()
+            if got != want.lower():
+                raise ValueError(f"checksum mismatch (got {got[:16]}…)")
         os.replace(tmp, dest)
         return f"fetched:{dest}"
     except Exception as e:  # noqa: BLE001

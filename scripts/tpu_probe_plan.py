@@ -35,7 +35,8 @@ RESULTS = os.path.join(REPO, "PROBE_RESULTS.jsonl")
 STEPS = [
     ("charrnn", {"BENCH_MODEL": "charrnn"}, 1500, ""),
     # ^ since round 5 the TPU default dispatch is the whole-loop fused
-    #   sequence kernel (measured 1.97x the scan), so this IS the seq row
+    #   sequence kernel (measured 2.1x the scan at the median of 8
+    #   children; BASELINE.md round 5), so this IS the seq row
     ("charrnn_small", {"BENCH_MODEL": "charrnn", "BENCH_SEQ": "128",
                        "BENCH_STEPS": "10"}, 900, ""),
     # ^ much cheaper nested-scan compile: if this lands where the default

@@ -121,18 +121,24 @@ class TestRealDataAccuracy:
 
     @staticmethod
     def _mnist_present() -> bool:
-        """Both train archives present, in either layout load_mnist accepts
-        (.gz pairs from fetch_mnist, or hand-copied decompressed IDX).
-        Checking files rather than the directory: a failed opportunistic
-        fetch (scripts/fetch_gated_assets.py) or a partial download must
-        not un-skip the test onto synthetic fallback data."""
+        """ALL FOUR splits present (the test loads train AND t10k), in any
+        layout load_mnist accepts: .gz archives from fetch_mnist, or
+        hand-copied decompressed IDX in dash ("train-images-idx3-ubyte") or
+        dot ("train-images.idx3-ubyte") naming. Checking files rather than
+        the directory: a failed or PARTIAL opportunistic fetch
+        (scripts/fetch_gated_assets.py) must not un-skip the test onto
+        synthetic fallback data for either split."""
         root = os.environ.get("MNIST_DIR",
                               os.path.expanduser("~/.dl4j-tpu/mnist"))
-        return any(
-            os.path.exists(os.path.join(root, "train-images-idx3-ubyte" + ext))
-            and os.path.exists(os.path.join(root, "train-labels-idx1-ubyte" + ext))
-            for ext in (".gz", "")
-        )
+
+        def found(split, kind, code):
+            names = (f"{split}-{kind}-{code}-ubyte.gz",
+                     f"{split}-{kind}-{code}-ubyte",
+                     f"{split}-{kind}.{code}-ubyte")
+            return any(os.path.exists(os.path.join(root, n)) for n in names)
+
+        return all(found(s, k, c) for s in ("train", "t10k")
+                   for k, c in (("images", "idx3"), ("labels", "idx1")))
 
     @pytest.mark.skipif(
         not _mnist_present.__func__(),

@@ -31,13 +31,66 @@ def test_graceful_when_unreachable(tmp_path):
 
 def test_vgg16_fetch_from_local_mirror(tmp_path):
     src = tmp_path / "weights.h5"
-    src.write_bytes(b"\x89HDF\r\n\x1a\n" + b"\0" * 64)
+    # must clear the plausibility floor (> 1 MiB) as well as the signature
+    src.write_bytes(b"\x89HDF\r\n\x1a\n" + b"\0" * (1 << 21))
     out = _run({"DL4J_TPU_MNIST_URL": f"file://{tmp_path}/no-mirror",
                 "DL4J_TPU_VGG16_URL": f"file://{src}",
                 "MNIST_DIR": str(tmp_path / "mnist")}, tmp_path)
     dest = tmp_path / ".dl4j-tpu" / "vgg16_weights.h5"
     assert out["vgg16"] == f"fetched:{dest}"
     assert dest.read_bytes().startswith(b"\x89HDF")
+
+
+def test_vgg16_rejects_truncated_archive(tmp_path):
+    src = tmp_path / "weights.h5"
+    src.write_bytes(b"\x89HDF\r\n\x1a\n" + b"\0" * 64)  # valid sig, tiny
+    out = _run({"DL4J_TPU_MNIST_URL": f"file://{tmp_path}/no-mirror",
+                "DL4J_TPU_VGG16_URL": f"file://{src}",
+                "MNIST_DIR": str(tmp_path / "mnist")}, tmp_path)
+    assert out["vgg16"].startswith("unreachable (ValueError")
+
+
+def test_vgg16_checksum_enforced_when_pinned(tmp_path):
+    src = tmp_path / "weights.h5"
+    src.write_bytes(b"\x89HDF\r\n\x1a\n" + b"\0" * (1 << 21))
+    out = _run({"DL4J_TPU_MNIST_URL": f"file://{tmp_path}/no-mirror",
+                "DL4J_TPU_VGG16_URL": f"file://{src}",
+                "DL4J_TPU_VGG16_SHA256": "0" * 64,
+                "MNIST_DIR": str(tmp_path / "mnist")}, tmp_path)
+    assert out["vgg16"].startswith("unreachable (ValueError")
+    assert not (tmp_path / ".dl4j-tpu" / "vgg16_weights.h5").exists()
+
+
+def test_mnist_partial_fetch_leaves_no_new_archives(tmp_path, monkeypatch):
+    """A fetch that dies partway must remove the files IT wrote (a half-set
+    would un-skip the gated true-MNIST test onto synthetic data), while
+    leaving pre-existing user files alone."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("fga", SCRIPT)
+    fga = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fga)
+
+    mnist_dir = tmp_path / "mnist"
+    mnist_dir.mkdir()
+    (mnist_dir / "user-note.txt").write_text("keep me")
+    monkeypatch.setenv("MNIST_DIR", str(mnist_dir))
+
+    def half_fetch(timeout_s):
+        # first archive lands, then the connection dies
+        (mnist_dir / "train-images-idx3-ubyte.gz").write_bytes(b"partial")
+        raise OSError("connection reset")
+
+    monkeypatch.setattr(fga, "fetch_mnist", half_fetch, raising=False)
+    # try_mnist imports fetch_mnist at call time from the datasets module;
+    # patch it there (the import inside the function resolves the module)
+    import deeplearning4j_tpu.datasets.fetchers as fetchers
+
+    monkeypatch.setattr(fetchers, "fetch_mnist", half_fetch)
+    out = fga.try_mnist(timeout_s=2)
+    assert out.startswith("unreachable (OSError")
+    assert not (mnist_dir / "train-images-idx3-ubyte.gz").exists()
+    assert (mnist_dir / "user-note.txt").exists()  # pre-existing untouched
 
 
 def test_vgg16_rejects_non_hdf5(tmp_path):
