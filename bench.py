@@ -307,6 +307,68 @@ def bench_word2vec(layer_size: int = 128, negative: int = 5,
     }
 
 
+def bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
+                    dim: int = 64, steps: int = 20) -> dict:
+    """Long-context attention throughput: the flash kernel vs the XLA
+    attention path, fwd+bwd, causal, bf16, one-dispatch scan (same
+    methodology as the other rows). The long-context tier (SURVEY §5.7) is
+    a first-class subsystem; this gives it a measured number the way
+    word2vec got one for the embedding tier. tokens/sec counts query
+    positions processed per second (batch*seq per iteration)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+    from deeplearning4j_tpu.parallel.ring_attention import attention as attention_xla
+
+    rng = np.random.default_rng(0)
+    shape = (batch, heads, seq, dim)
+    mk = lambda: jax.device_put(  # noqa: E731
+        jnp.asarray(rng.normal(size=shape) * 0.3, jnp.bfloat16))
+    q0, k0, v0 = mk(), mk(), mk()
+
+    def timed(fn_name, attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        def body(carry, _):
+            q, k, v = carry
+            dq, dk, dv = g(q, k, v)
+            # chain iterations through the grads so the scan can't elide
+            # or reorder the N attention steps
+            lr = jnp.bfloat16(1e-6)
+            return (q - lr * dq.astype(q.dtype), k - lr * dk.astype(k.dtype),
+                    v - lr * dv.astype(v.dtype)), None
+
+        run = jax.jit(lambda q, k, v: jax.lax.scan(
+            body, (q, k, v), None, length=steps)[0])
+        out = run(q0, k0, v0)  # compile + warmup
+        np.asarray(out[0])
+        t0 = time.perf_counter()
+        out = run(q0, k0, v0)
+        res = np.asarray(out[0])  # host fetch = sync
+        dt = time.perf_counter() - t0
+        assert np.all(np.isfinite(res.astype(np.float32))), fn_name
+        return dt
+
+    dt_flash = timed("flash", lambda q, k, v: flash_attention(
+        q, k, v, causal=True))
+    dt_xla = timed("xla", lambda q, k, v: attention_xla(q, k, v, causal=True))
+    tokens = steps * batch * seq
+    return {
+        "metric": "flash_attention_train_tokens_per_sec",
+        "value": round(tokens / dt_flash, 1),
+        "unit": "tokens/sec",
+        "xla_tokens_per_sec": round(tokens / dt_xla, 1),
+        "flash_vs_xla": round(dt_xla / dt_flash, 2),
+        "shape": {"batch": batch, "heads": heads, "seq": seq, "dim": dim},
+        "timed_steps": steps,
+        "step_ms": round(1000 * dt_flash / steps, 3),
+    }
+
+
 def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
     import jax
 
@@ -431,16 +493,16 @@ def _tpu_child_main() -> int:
                  if s.strip()]
     except ValueError:
         sizes = []
+    def _ienv(name, default):
+        try:
+            return int(os.environ.get(name, default))
+        except ValueError:
+            return default
+
     if os.environ.get("BENCH_MODEL") == "charrnn":
         # env-tunable shape: the nested scan (outer steps x inner seq) is the
         # most compile-expensive program in the harness; smaller settings let
         # a flaky-tunnel window still produce a (labeled) measurement
-        def _ienv(name, default):
-            try:
-                return int(os.environ.get(name, default))
-            except ValueError:
-                return default
-
         cfg = {"batch": _ienv("BENCH_BATCH", 64),
                "seq": _ienv("BENCH_SEQ", 256),
                "steps": _ienv("BENCH_STEPS", 30)}
@@ -452,6 +514,10 @@ def _tpu_child_main() -> int:
             result["metric"] += f"_b{cfg['batch']}xs{cfg['seq']}xn{cfg['steps']}"
     elif os.environ.get("BENCH_MODEL") == "word2vec":
         result = bench_word2vec()
+    elif os.environ.get("BENCH_MODEL") == "attention":
+        result = bench_attention(seq=_ienv("BENCH_SEQ", 4096))
+        if result["shape"]["seq"] != 4096:
+            result["metric"] += f"_s{result['shape']['seq']}"
     elif sizes:
         results = []
         errors = {}

@@ -23,6 +23,7 @@ the ``KafkaSource(consumer_factory=...)`` seam.
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from collections import namedtuple
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -192,25 +193,30 @@ class EmbeddedKafkaConsumer:
         """Fetch up to ``max_records`` across assigned partitions.
 
         Partitions are drained fairly (rotating start), each batch keyed by
-        TopicPartition exactly as kafka-python returns it. An empty dict
-        means no records before the (virtual) timeout — the embedded broker
-        never blocks, so the timeout is honoured trivially.
+        TopicPartition exactly as kafka-python returns it. Like the real
+        client, an empty topic BLOCKS up to ``timeout_ms`` before returning
+        {} — without that, a pipeline polling in a loop busy-spins at 100%
+        CPU whenever the topic is drained.
         """
         self._check_open()
-        out: Dict[TopicPartition, List[ConsumerRecord]] = {}
-        remaining = int(max_records)
-        n = len(self._assignment)
-        for i in range(n):
-            if remaining <= 0:
-                break
-            tp = self._assignment[(self._rr + i) % n]
-            recs = self._broker.fetch(tp, self._positions[tp], remaining)
-            if recs:
-                out[tp] = recs
-                self._positions[tp] += len(recs)
-                remaining -= len(recs)
-        self._rr += 1
-        return out
+        deadline = time.monotonic() + max(0, timeout_ms) / 1000.0
+        while True:
+            out: Dict[TopicPartition, List[ConsumerRecord]] = {}
+            remaining = int(max_records)
+            n = len(self._assignment)
+            for i in range(n):
+                if remaining <= 0:
+                    break
+                tp = self._assignment[(self._rr + i) % n]
+                recs = self._broker.fetch(tp, self._positions[tp], remaining)
+                if recs:
+                    out[tp] = recs
+                    self._positions[tp] += len(recs)
+                    remaining -= len(recs)
+            self._rr += 1
+            if out or time.monotonic() >= deadline:
+                return out
+            time.sleep(min(0.005, max(0.0005, timeout_ms / 1000.0 / 4)))
 
     def close(self) -> None:
         self.closed = True

@@ -9,7 +9,12 @@ modes:
 - ``--two-process``: the producer runs as a SEPARATE OS process publishing
   records over TCP (SocketRecordSink -> SocketRecordSource), which is the
   reference's Kafka-between-JVMs topology with the broker replaced by the
-  framework's own length-prefixed socket transport.
+  framework's own length-prefixed socket transport;
+- ``--kafka``: records flow through a partitioned, offset-addressed
+  embedded broker via the kafka-python-shaped consumer surface
+  (EmbeddedKafkaBroker/Producer/Consumer + KafkaSource) — the
+  BaseKafkaPipeline topology itself; swap the consumer_factory for
+  kafka-python and the same code talks to a real cluster.
 """
 
 import argparse
@@ -33,7 +38,8 @@ print("PRODUCER_OK", flush=True)
 """
 
 
-def main(quick: bool = False, two_process: bool = False) -> float:
+def main(quick: bool = False, two_process: bool = False,
+         kafka: bool = False) -> float:
     import numpy as np
 
     from deeplearning4j_tpu import (
@@ -45,6 +51,10 @@ def main(quick: bool = False, two_process: bool = False) -> float:
         UpdaterConfig,
     )
     from deeplearning4j_tpu.streaming import (
+        EmbeddedKafkaBroker,
+        EmbeddedKafkaConsumer,
+        EmbeddedKafkaProducer,
+        KafkaSource,
         QueueSource,
         ServeRoute,
         SocketRecordSource,
@@ -66,7 +76,23 @@ def main(quick: bool = False, two_process: bool = False) -> float:
     served = []
     batch = 32
     n = 600 if quick else 3000
-    source = SocketRecordSource() if two_process else QueueSource()
+    broker = prod = None
+    if kafka:
+        broker = EmbeddedKafkaBroker(num_partitions=2)
+        prod = EmbeddedKafkaProducer(broker)
+
+        def _deser(raw):
+            fs, ls = raw.decode().split("|")
+            return (np.array([float(v) for v in fs.split(",")], np.float32),
+                    np.array([float(v) for v in ls.split(",")], np.float32))
+
+        # the class itself is the factory — swap in kafka.KafkaConsumer
+        # (and drop broker=) to talk to a real cluster
+        source = KafkaSource("records", _deser,
+                             consumer_factory=EmbeddedKafkaConsumer,
+                             broker=broker)
+    else:
+        source = SocketRecordSource() if two_process else QueueSource()
     pipeline = StreamingPipeline(
         source,
         routes=[TrainRoute(net), ServeRoute(net, lambda x, p: served.append(p))],
@@ -85,6 +111,16 @@ def main(quick: bool = False, two_process: bool = False) -> float:
         )
         out, _ = proc.communicate(timeout=300)
         assert proc.returncode == 0 and "PRODUCER_OK" in out, out[-2000:]
+    elif kafka:
+        # publish NDArray messages to the partitioned topic (the
+        # NDArrayPublisher role); the consumer replays from earliest
+        for _ in range(n):
+            pipeline.raise_if_failed()
+            x = rng.normal(size=6).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[(x @ w).argmax()]
+            payload = (",".join(map(repr, x.tolist())) + "|"
+                       + ",".join(map(repr, y.tolist()))).encode()
+            prod.send("records", payload)
     else:
         # producer thread-in-process: stream labeled records in
         for _ in range(n):
@@ -101,7 +137,8 @@ def main(quick: bool = False, two_process: bool = False) -> float:
     # the online-trained model now classifies the stream's concept
     xt = rng.normal(size=(300, 6)).astype(np.float32)
     acc = float((np.asarray(net.output(xt)).argmax(-1) == (xt @ w).argmax(-1)).mean())
-    mode = "two-process socket" if two_process else "in-process"
+    mode = ("embedded kafka" if kafka
+            else "two-process socket" if two_process else "in-process")
     print(f"[{mode}] streamed {n} records -> {net.iteration} online steps, "
           f"served {len(served)} prediction batches, accuracy={acc:.3f}")
     return acc
@@ -111,5 +148,6 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--two-process", action="store_true")
+    ap.add_argument("--kafka", action="store_true")
     args = ap.parse_args()
-    main(args.quick, args.two_process)
+    main(args.quick, args.two_process, args.kafka)

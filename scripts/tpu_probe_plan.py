@@ -54,6 +54,9 @@ STEPS = [
     # ^ embedding-engine row (host example-gen + per-batch dispatch: over
     #   the tunnel this measures RPC pipelining too — round-5: 38.2k
     #   words/s TPU vs 45.6k CPU)
+    ("attention", {"BENCH_MODEL": "attention"}, 1500, ""),
+    # ^ long-context tier's measured number: flash kernel vs XLA attention,
+    #   causal bf16 fwd+bwd at B=4 H=8 T=4096 D=64 (SURVEY §5.7)
     ("sweep", {"BENCH_SWEEP": "64,128,256"}, 1800, None),
     ("resnet50_bf16params", {"BENCH_PARAMS_BF16": "1"}, 1200, ""),
     # ^ bf16 weight carry (round-5 trace lever; measured neutral at b128 —

@@ -1,0 +1,32 @@
+"""bench.py model modes produce well-formed metric rows at tiny shapes
+(the TPU child runs the real shapes; these pin the contract offline)."""
+
+import numpy as np
+
+import bench
+
+
+def test_attention_mode_row():
+    r = bench.bench_attention(batch=1, heads=2, seq=128, dim=32, steps=2)
+    assert r["metric"] == "flash_attention_train_tokens_per_sec"
+    assert r["value"] > 0 and r["xla_tokens_per_sec"] > 0
+    assert r["unit"] == "tokens/sec"
+    assert r["shape"]["seq"] == 128 and r["timed_steps"] == 2
+
+
+def test_word2vec_mode_row():
+    r = bench.bench_word2vec(layer_size=32, negative=3, batch_size=256)
+    assert r["metric"] == "word2vec_skipgram_neg_words_per_sec"
+    assert r["value"] > 0 and r["pairs_per_sec"] > r["value"]
+    assert r["vocab_size"] > 100  # a real corpus, not a toy
+    assert np.isfinite(r["value"])
+
+
+def test_real_text_corpus_is_real_english():
+    sents = bench._real_text_sequences(min_words=5000)
+    words = [w for s in sents for w in s]
+    assert len(words) >= 5000
+    # natural-language signal: high type/token ratio and common stopwords
+    # (the tokenizer keeps 2+ letter words, so no single-letter "a")
+    assert {"the", "of", "to", "and"} <= set(words)
+    assert len(set(words)) > 400

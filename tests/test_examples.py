@@ -74,6 +74,13 @@ def test_streaming_pipeline_example_two_process():
     assert acc > 0.6
 
 
+def test_streaming_pipeline_example_kafka():
+    """Records flow through the embedded partitioned broker via the
+    kafka-python-shaped surface (the BaseKafkaPipeline topology)."""
+    acc = _mod("streaming_pipeline").main(quick=True, kafka=True)
+    assert acc > 0.6
+
+
 def test_early_stopping_example():
     result = _mod("early_stopping").main(quick=True)
     assert result.best_model is not None
