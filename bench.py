@@ -180,11 +180,18 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     p, o, s = net.params, net.opt_state, net.state
     p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)  # warmup
     assert np.all(np.isfinite(np.asarray(losses))), "non-finite warmup losses"
-    t0 = time.perf_counter()
-    p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)
-    losses = np.asarray(losses)  # host fetch = sync
-    dt = time.perf_counter() - t0
-    assert np.all(np.isfinite(losses)), "non-finite losses"
+    # median of 3 timed scans: at ~5ms/step this row showed real
+    # run-to-run variance on the tunnel chip (3.1-4.2M chars/sec band,
+    # round 5), and the repeats are nearly free on an already-compiled
+    # program — resnet's 5s scans reproduce to ±0.2% and stay single-run
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)
+        losses = np.asarray(losses)  # host fetch = sync
+        times.append(time.perf_counter() - t0)
+        assert np.all(np.isfinite(losses)), "non-finite losses"
+    dt = sorted(times)[1]
     # per-step FLOPs from the already-compiled scan program (cache hit —
     # same rules as bench_resnet50: nothing compiles between warmup and the
     # timed run; cost analysis counts the scan body once = per-step)
@@ -199,6 +206,7 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
         "unit": "chars/sec",
         "timed_steps": steps,
         "step_ms": round(1000 * step_s, 3),
+        "run_step_ms": [round(1000 * t / steps, 3) for t in times],
     }
     if flops_per_step:
         # Deterministic whole-program-vs-per-body disambiguation: a >100%

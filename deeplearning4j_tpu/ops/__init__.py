@@ -71,12 +71,16 @@ def lstm_sequence_enabled() -> bool:
     docstring anticipates.
 
     DEFAULT ON for TPU (measured, v5e char-RNN bench B=64 H=512 T=256:
-    2,926,168 chars/sec seq-fused vs 1,489,072 scan — 1.97x; probe steps
+    3.10M chars/sec median seq-fused vs 1,489,072 scan — 2.1x; probe steps
     charrnn/charrnn_seqfused, round 5). ``DL4J_TPU_PALLAS=seq`` still
     forces it on off-TPU (interpret mode, tests); "0"/"1" select the scan
     or per-step-cell paths instead; unset means TPU-auto like
-    helpers_enabled. Shapes the VMEM guard rejects fall back to the scan
-    path at call sites (sequence_fits)."""
+    helpers_enabled. ``set_helpers_enabled(False)`` disables it like every
+    other Pallas helper — the programmatic kill-switch covers the
+    default-on kernel too. Shapes the VMEM guard rejects fall back to the
+    scan path at call sites (sequence_fits)."""
+    if _FORCED is not None:
+        return _FORCED
     env = os.environ.get("DL4J_TPU_PALLAS")
     if env == "seq":
         return True

@@ -375,7 +375,7 @@ def _seq_fwd_kernel(act, gate,
 
 def _seq_bwd_kernel(act, dact, dgate, T,
                     dy_ref, dhT_ref, dcT_ref,
-                    a_ref, f_ref, o_ref, i_ref, c_ref, cprev_ref, hprev_ref,
+                    a_ref, f_ref, o_ref, i_ref, cprev_ref, hprev_ref,
                     rw_ref, pf_ref, pi_ref, po_ref, h0_ref, c0_ref,
                     dzx_out, dh0_out, dc0_out, drw_out, dpf_out, dpi_out,
                     dpo_out,
@@ -393,11 +393,14 @@ def _seq_bwd_kernel(act, dact, dgate, T,
         dpi_scr[:] = jnp.zeros(dpi_scr.shape, dpi_scr.dtype)
         dpo_scr[:] = jnp.zeros(dpo_scr.shape, dpo_scr.dtype)
 
-    a, f, o, i, c = a_ref[0], f_ref[0], o_ref[0], i_ref[0], c_ref[0]
+    a, f, o, i = a_ref[0], f_ref[0], o_ref[0], i_ref[0]
     first = k == T - 1            # t == 0: previous state is the initial one
     c_prev = jnp.where(first, c0_ref[:], cprev_ref[0])
     h_prev = jnp.where(first, h0_ref[:], hprev_ref[0])
-    cact = act(c)                 # recomputed, not stored (VPU-cheap)
+    # c_t recomputed from the gates (VPU-cheap) — only the prev-indexed c
+    # stream is read, saving a T×B×H HBM stream (same as the masked kernel)
+    c = f * c_prev + i * a
+    cact = act(c)                 # recomputed, not stored
     pF, pI, pO = pf_ref[:], pi_ref[:], po_ref[:]
 
     dh = dy_ref[0] + dh_scr[:]
@@ -595,7 +598,7 @@ def _seq_bwd(act_name, gate_name, residuals, grads):
             seq(rev),                       # dys
             pl.BlockSpec((B, H), const),    # dhT
             pl.BlockSpec((B, H), const),    # dcT
-            seq(rev), seq(rev), seq(rev), seq(rev), seq(rev),  # a f o i c
+            seq(rev), seq(rev), seq(rev), seq(rev),  # a f o i
             seq(prev),                      # c_{t-1} (from c)
             seq(prev),                      # h_{t-1} (from ys)
             pl.BlockSpec((H, 4 * H), const),
@@ -622,7 +625,7 @@ def _seq_bwd(act_name, gate_name, residuals, grads):
             pltpu.VMEM((1, H), jnp.float32),
         ],
         interpret=_interpret(),
-    )(dys, dhT, dcT, a, f, o, i, c, c, ys, RW, pF, pI, pO, h0, c0)
+    )(dys, dhT, dcT, a, f, o, i, c, ys, RW, pF, pI, pO, h0, c0)
     return dzx, dh0, dc0, dRW, dpF, dpI, dpO
 
 

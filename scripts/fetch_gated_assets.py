@@ -31,12 +31,14 @@ VGG16_URL = (
 
 
 def try_mnist(timeout_s: float) -> str:
-    from deeplearning4j_tpu.datasets.fetchers import fetch_mnist
-
     root = os.environ.get("MNIST_DIR", os.path.expanduser("~/.dl4j-tpu/mnist"))
     existed = os.path.isdir(root)
     before = set(os.listdir(root)) if existed else set()
     try:
+        # import inside the guard: even a broken package install must not
+        # break the one-JSON-line / exit-0 contract
+        from deeplearning4j_tpu.datasets.fetchers import fetch_mnist
+
         # explicit per-request timeout: fetch_mnist's urlopen calls ignore
         # the socket default
         return f"fetched:{fetch_mnist(timeout_s=timeout_s)}"
@@ -64,12 +66,16 @@ def try_vgg16(timeout_s: float) -> str:
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     tmp = dest + ".part"
     try:
+        import hashlib
+
+        hasher = hashlib.sha256()  # hash the stream: no second full read
         with urllib.request.urlopen(url, timeout=timeout_s) as r, \
                 open(tmp, "wb") as f:
             while True:
                 chunk = r.read(1 << 20)
                 if not chunk:
                     break
+                hasher.update(chunk)
                 f.write(chunk)
         # sanity: HDF5 signature + the same size floor the cache check
         # applies (the real archive is ~528 MB); optionally a pinned digest
@@ -79,12 +85,9 @@ def try_vgg16(timeout_s: float) -> str:
         if os.path.getsize(tmp) <= (1 << 20):
             raise ValueError("downloaded file is implausibly small")
         want = os.environ.get("DL4J_TPU_VGG16_SHA256")
-        if want:
-            import hashlib
-
-            got = hashlib.sha256(open(tmp, "rb").read()).hexdigest()
-            if got != want.lower():
-                raise ValueError(f"checksum mismatch (got {got[:16]}…)")
+        if want and hasher.hexdigest() != want.lower():
+            raise ValueError(
+                f"checksum mismatch (got {hasher.hexdigest()[:16]}…)")
         os.replace(tmp, dest)
         return f"fetched:{dest}"
     except Exception as e:  # noqa: BLE001
