@@ -23,10 +23,21 @@ import jax
 import numpy as np
 
 
+def _storable(leaf) -> np.ndarray:
+    """np.savez cannot round-trip ml_dtypes types (their numpy dtype kind
+    is 'V'; bf16 loads back as raw void with no cast available) — widen
+    them to f32, which is lossless; _load_leaves casts back to the model's
+    leaf dtype. Native numpy dtypes (incl. float16) round-trip as-is."""
+    a = np.asarray(leaf)
+    if a.dtype.kind == "V":
+        return a.astype(np.float32)
+    return a
+
+
 def _save_leaves(zf: zipfile.ZipFile, name: str, tree: Any) -> None:
     leaves = jax.tree_util.tree_leaves(tree)
     buf = io.BytesIO()
-    np.savez(buf, **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    np.savez(buf, **{f"leaf_{i}": _storable(l) for i, l in enumerate(leaves)})
     zf.writestr(name, buf.getvalue())
 
 

@@ -1,0 +1,113 @@
+"""Property tests for the embedded Kafka analog: randomized
+produce/consume interleavings must preserve the broker contract
+(reference semantics the real broker guarantees and the reference's
+pipeline relies on — EmbeddedKafkaCluster.java stands in for these in the
+reference's own tests):
+
+1. exactly-once delivery per consumer: every produced message is polled
+   exactly once across a consumer's lifetime, regardless of interleaving;
+2. per-partition order: offsets within a TopicPartition arrive strictly
+   ascending, and keyed messages (same key -> same partition) arrive in
+   publish order;
+3. independent consumers each see the full log (no destructive reads);
+4. seek() replays deterministically.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.embedded_kafka import (
+    EmbeddedKafkaBroker,
+    EmbeddedKafkaConsumer,
+    EmbeddedKafkaProducer,
+)
+
+
+def _drain(consumer, max_records=7):
+    """Poll until two consecutive empties; returns records in arrival order."""
+    out, empties = [], 0
+    while empties < 2:
+        batch = consumer.poll(timeout_ms=1, max_records=max_records)
+        if not batch:
+            empties += 1
+            continue
+        empties = 0
+        for recs in batch.values():
+            out.extend(recs)
+    return out
+
+
+def test_random_interleavings_exactly_once_and_ordered():
+    for seed in range(20):
+        rng = np.random.default_rng(seed)
+        parts = int(rng.integers(1, 5))
+        broker = EmbeddedKafkaBroker(num_partitions=parts)
+        prod = EmbeddedKafkaProducer(broker)
+        cons = EmbeddedKafkaConsumer("t", broker=broker)
+        n_msgs = int(rng.integers(1, 120))
+        keys = [None, b"alpha", b"beta", b"gamma"]
+        sent = []
+        got = []
+        i = 0
+        # random interleaving of sends and polls
+        while i < n_msgs or len(got) < n_msgs:
+            if i < n_msgs and (rng.random() < 0.6 or len(got) >= i):
+                key = keys[int(rng.integers(0, len(keys)))]
+                rec = prod.send("t", str(i).encode(), key=key)
+                sent.append((i, key, rec.partition))
+                i += 1
+            else:
+                for recs in cons.poll(
+                        timeout_ms=1,
+                        max_records=int(rng.integers(1, 9))).values():
+                    got.extend(recs)
+        got.extend(_drain(cons))
+
+        # 1. exactly-once: every message delivered exactly once
+        assert sorted(int(r.value) for r in got) == list(range(n_msgs)), seed
+        # 2a. per-partition offsets strictly ascending in arrival order
+        seen = {}
+        for r in got:
+            tp = (r.topic, r.partition)
+            assert r.offset > seen.get(tp, -1), (seed, tp)
+            seen[tp] = r.offset
+        # 2b. keyed messages stay on one partition, in publish order
+        for key in keys[1:]:
+            published = [i_ for i_, k, _ in sent if k == key]
+            partitions = {p for i_, k, p in sent if k == key}
+            assert len(partitions) <= 1, (seed, key)
+            arrived = [int(r.value) for r in got
+                       if int(r.value) in set(published)]
+            assert arrived == published, (seed, key)
+
+
+def test_independent_consumers_both_see_full_log():
+    broker = EmbeddedKafkaBroker(num_partitions=3)
+    prod = EmbeddedKafkaProducer(broker)
+    for i in range(50):
+        prod.send("t", str(i).encode())
+    a = EmbeddedKafkaConsumer("t", broker=broker, group_id="a")
+    b = EmbeddedKafkaConsumer("t", broker=broker, group_id="b")
+    va = sorted(int(r.value) for r in _drain(a))
+    vb = sorted(int(r.value) for r in _drain(b))
+    assert va == vb == list(range(50))
+
+
+def test_seek_replay_is_deterministic():
+    rng = np.random.default_rng(7)
+    broker = EmbeddedKafkaBroker(num_partitions=2)
+    prod = EmbeddedKafkaProducer(broker)
+    for i in range(40):
+        prod.send("t", str(i).encode())
+    cons = EmbeddedKafkaConsumer("t", broker=broker)
+    first = [(r.partition, r.offset, r.value) for r in _drain(cons)]
+    for _ in range(3):
+        cons.seek_to_beginning()
+        replay = [(r.partition, r.offset, r.value) for r in _drain(cons)]
+        assert sorted(replay) == sorted(first)
+    # mid-stream seek: skip the first k of one partition only
+    tp = cons.assignment()[0]
+    cons.seek_to_beginning()
+    cons.seek(tp, 5)
+    partial = [r for r in _drain(cons) if r.partition == tp.partition]
+    assert [r.offset for r in partial] == list(
+        range(5, broker.end_offset(tp)))

@@ -75,6 +75,58 @@ def test_params_dtype_json_round_trip():
         _conf(None).to_json()).params_dtype is None
 
 
+def test_bf16_params_compose_with_spmd_wrapper():
+    """bf16 param carry x GSPMD: the data-parallel wrapper trains with
+    bf16-resident params (and the dp x tp mesh still shards them)."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    feats, labels = _data(n=64)
+    net = MultiLayerNetwork(_conf("bfloat16")).init()
+    w = ParallelWrapper(net, mesh=make_mesh(8))
+    s0 = float(net.score(DataSet(feats, labels)))
+    for _ in range(5):
+        w.fit(DataSet(feats, labels))
+    assert float(net.score(DataSet(feats, labels))) < s0
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+
+    net = MultiLayerNetwork(_conf("bfloat16")).init()
+    mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+    w = ParallelWrapper(net, mesh=mesh, model_axis="model")
+    w._setup_sync()
+    w._fit_sync(DataSet(feats, labels))
+    spec = net.params[0]["W"].sharding.spec
+    assert "model" in tuple(s for s in spec if s is not None), spec
+    assert net.params[0]["W"].dtype == jnp.bfloat16
+
+
+def test_bf16_params_survive_serialization():
+    import os
+    import tempfile
+
+    from deeplearning4j_tpu.utils.serialization import (
+        restore_model,
+        write_model,
+    )
+
+    feats, labels = _data()
+    net = MultiLayerNetwork(_conf("bfloat16")).init()
+    for _ in range(3):
+        net.fit(DataSet(feats, labels))
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.zip")
+        write_model(net, path)
+        back = restore_model(path)
+    assert back.conf.params_dtype == "bfloat16"
+    for leaf in jax.tree_util.tree_leaves(back.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(net.output(feats[:8]), np.float32),
+        np.asarray(back.output(feats[:8]), np.float32))
+
+
 def test_graph_params_dtype():
     from deeplearning4j_tpu.nn.conf.computation_graph import (
         ComputationGraphConfiguration,
