@@ -166,6 +166,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     conf = char_rnn(vocab_size=vocab, hidden_size=512, num_layers=2,
                     dtype="bfloat16")
     conf.backprop_type = "standard"  # time the full-sequence jitted step
+    if os.environ.get("BENCH_PARAMS_BF16") == "1":
+        conf.params_dtype = "bfloat16"  # bf16 weight carry (own metric key)
     net = MultiLayerNetwork(conf).init()
     multi = net._build_multi_step(steps, 1)
     rng = np.random.default_rng(0)
@@ -201,7 +203,9 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
         multi, p, o, s, key, xs, ys, None, None)
     step_s = dt / steps
     result = {
-        "metric": "char_rnn_train_chars_per_sec",
+        "metric": ("char_rnn_train_chars_per_sec"
+                   + ("_bf16params" if conf.params_dtype == "bfloat16"
+                      else "")),
         "value": round(steps * batch * seq / dt, 1),
         "unit": "chars/sec",
         "timed_steps": steps,

@@ -127,6 +127,38 @@ def test_bf16_params_survive_serialization():
         np.asarray(back.output(feats[:8]), np.float32))
 
 
+def test_bf16_params_ride_the_seq_fused_kernel(monkeypatch):
+    """bf16 param carry x the fused sequence kernel: an LSTM with
+    bf16-resident weights dispatches the Pallas path (interpret on CPU) at
+    bf16 end to end and matches the scan path."""
+    from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+
+    def make():
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=12),
+                    RnnOutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent")],
+            input_type=InputType.recurrent(5),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.05),
+            seed=6, dtype="bfloat16", params_dtype="bfloat16",
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 7, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=(4, 7))]
+    outs = {}
+    for mode in ("0", "seq"):
+        monkeypatch.setenv("DL4J_TPU_PALLAS", mode)
+        net = make()
+        assert net.params[0]["RW"].dtype == jnp.bfloat16
+        for _ in range(3):
+            net.fit(DataSet(x, y))
+        outs[mode] = np.asarray(net.output(x), np.float32)
+    # bf16 arithmetic differs slightly between the two implementations
+    np.testing.assert_allclose(outs["0"], outs["seq"], atol=2e-2)
+
+
 def test_graph_params_dtype():
     from deeplearning4j_tpu.nn.conf.computation_graph import (
         ComputationGraphConfiguration,
