@@ -73,6 +73,24 @@ STEPS = [
     # ^ B=64 fills half the MXU's 128 sublanes on the recurrent gemm; the
     #   batch-128 row shows the throughput the framework sustains when the
     #   workload is MXU-shaped (bench suffixes the shape key itself)
+    ("charrnn_bf16params", {"BENCH_MODEL": "charrnn",
+                            "BENCH_PARAMS_BF16": "1"}, 1500, ""),
+    # ^ bf16 weight carry on the recurrent path (bench suffixes the key);
+    #   same 1500s budget as the canonical step — identical program shape,
+    #   same known-slow nested-scan compile
+    ("resnet50_b256_bf16params", {"BENCH_BATCH": "256",
+                                  "BENCH_PARAMS_BF16": "1"}, 1500, "_b256"),
+    # ^ the b256 point where weight traffic has less room to hide (the
+    #   resnet bench does not self-suffix batch, hence the explicit key)
+    ("resnet50_lhs_flag", {"XLA_FLAGS": (
+        "--xla_tpu_enable_latency_hiding_scheduler=true")}, 1200, None),
+    # ^ LAST deliberately: the round-5 step anatomy
+    #   (docs/resnet50_step_analysis.md) shows 35 of 44 ms/step in
+    #   compiler-inserted S(1) copy windows, so the scheduler flag is the
+    #   top untried lever — but the flag may not exist in this XLA build,
+    #   and an invalid-flag crash must not block the canonical rows.
+    #   PROBE_RESULTS-only (None): a flag variant never touches the
+    #   canonical metric anchors.
 ]
 # NOT queued: BENCH_REMAT sweeps — measured strictly worse on ResNet-50
 # (b256 2,737→1,797, b512 OOM where plain fits; see BASELINE.md round 5).
@@ -80,6 +98,12 @@ STEPS = [
 
 def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
     env = dict(os.environ)
+    if "XLA_FLAGS" in env_extra and env.get("XLA_FLAGS"):
+        # append, don't replace: dropping inherited flags would make a
+        # flag-A/B run differ from the canonical row in more than one way
+        env_extra = dict(env_extra)
+        env_extra["XLA_FLAGS"] = (env["XLA_FLAGS"] + " "
+                                  + env_extra["XLA_FLAGS"])
     env.update(env_extra)
     if env.pop("PROBE_CMD", None) == "smoke":
         cmd = [sys.executable, os.path.join(REPO, "scripts", "tpu_smoke.py")]
