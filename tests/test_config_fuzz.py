@@ -166,7 +166,12 @@ def test_random_graph_invariants(case):
         .set_input_types(InputType.feed_forward(f_in))
         .seed(int(rng.integers(0, 10_000)))
         .updater(UpdaterConfig(updater="adam", learning_rate=1e-3))
+        .remat(bool(rng.integers(0, 2)))  # round-5 fields in the grammar
     )
+    if rng.integers(0, 4) == 0:  # independent draws: the safe default
+        b.dtype("bfloat16")      # (bf16 compute, wide master) and the
+    if rng.integers(0, 4) == 0:  # carry combos all get graph-tier fuzz
+        b.params_dtype("bfloat16")
     tip = "in"
     n_blocks = int(rng.integers(1, 4))
     for i in range(n_blocks):
@@ -231,6 +236,14 @@ def test_random_config_invariants(case, tmp_path):
         input_type=it,
         updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
         seed=int(rng.integers(0, 10_000)),
+        # round-5 fields join the fuzz grammar: remat and the bf16 param
+        # carry must compose with every random family — including the
+        # unusual-but-legal params_dtype=bf16 + dtype=f32 combo
+        # (compressed storage, f32 compute) — and survive the JSON +
+        # checkpoint round-trips below
+        remat=bool(rng.integers(0, 2)),
+        dtype="bfloat16" if rng.integers(0, 4) == 0 else "float32",
+        params_dtype=("bfloat16" if rng.integers(0, 4) == 0 else None),
     )
     try:
         conf.layer_input_types()  # shape inference over the whole stack
