@@ -1,18 +1,16 @@
-"""Healthy-tunnel probe plan: run the round-4 chip measurements in priority
-order, each in its own bounded TPU child process, appending every result to
-PROBE_RESULTS.jsonl the moment it lands (a later wedge never loses an
-earlier number).
+"""Healthy-tunnel probe plan: every chip measurement the project tracks,
+in priority order, each in its own bounded TPU child process, appending
+every result to PROBE_RESULTS.jsonl the moment it lands (a later wedge
+never loses an earlier number).
 
-Priorities (VERDICT round-3 tasks 1-2):
-  1. char-RNN row (BASELINE config #3) — the most interesting unmeasured
-     number; default shapes so the metric key matches the baseline store.
-  2. ResNet-50 b128 after the BN rewrite (one-pass f32 stats + folded
-     scale/offset) — directly comparable to the 2,551 img/s round-3 row.
-  3. ResNet-50 b128 with an xplane trace (BENCH_TRACE_DIR) for the MFU
-     analysis the VERDICT asks to commit.
-  4. Batch sweep 64,128,256 — does the declining curve persist post-BN?
+Round-5 state: the round-3/4 backlog is fully measured (see BASELINE.md
+"Round-5 session outcome"); the steps now serve as the standing
+re-measurement suite plus the queued round-5 tail — the attention row,
+the bf16-params variants (b256 + charrnn), an on-chip re-smoke of the
+leaner unmasked seq backward, and the latency-hiding-scheduler flag A/B
+(docs/resnet50_step_analysis.md names it the top untried lever).
 
-Usage: python scripts/tpu_probe_plan.py [--budget-s 5400]
+Usage: python scripts/tpu_probe_plan.py [--budget-s 5400] [--steps a,b]
 Stops early after two consecutive wedges (the tunnel is down, not slow).
 """
 
@@ -46,7 +44,7 @@ STEPS = [
     ("charrnn_scan", {"BENCH_MODEL": "charrnn",
                       "DL4J_TPU_PALLAS": "0"}, 1200, "_scan"),
     # ^ keeps the lax.scan path measured now that seq-fused is the default
-    #   (round-5: scan 1,489,072 vs seq-fused 2,926,168 chars/sec)
+    #   (round-5: scan 1,489,072 vs seq-fused 3.10M median chars/sec)
     ("resnet50_trace", {"BENCH_TRACE_DIR": "/tmp/dl4j_tpu_trace"}, 1200, ""),
     # ^ the timed region runs BEFORE the trace capture, so the value is a
     #   clean measurement of the canonical workload
