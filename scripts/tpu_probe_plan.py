@@ -94,7 +94,29 @@ STEPS = [
 # (b256 2,737→1,797, b512 OOM where plain fits; see BASELINE.md round 5).
 
 
+_CURRENT_CHILD: "subprocess.Popen | None" = None
+
+
+def _forward_term(signum, frame):
+    """A TERM'd plan must not orphan its chip child (one-TPU-process rule).
+
+    TERM first, then escalate: the bench child installs a Python SIGTERM
+    handler (clean PJRT teardown), but Python handlers cannot run while
+    the child is blocked inside a C call — the tunnel-wedge state — so a
+    bounded wait then SIGKILL mirrors the bench parent's own escalation."""
+    child = _CURRENT_CHILD
+    if child is not None and child.poll() is None:
+        child.terminate()
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+    sys.exit(143)
+
+
 def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
+    global _CURRENT_CHILD
     env = dict(os.environ)
     if "XLA_FLAGS" in env_extra and env.get("XLA_FLAGS"):
         # append, don't replace: dropping inherited flags would make a
@@ -108,12 +130,22 @@ def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
     else:
         cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--tpu-child"]
     t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True, cwd=REPO)
+    _CURRENT_CHILD = proc
     try:
-        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                              timeout=timeout_s, cwd=REPO)
+        stdout, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        proc.terminate()  # TERM first: a bare KILL mid-claim wedges the
+        try:              # tunnel (BASELINE.md methodology)
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+    finally:
+        _CURRENT_CHILD = None
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
             obj = json.loads(line)
         except (ValueError, TypeError):
@@ -125,13 +157,22 @@ def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
 
 
 def main() -> int:
+    import signal
+
+    signal.signal(signal.SIGTERM, _forward_term)
+    signal.signal(signal.SIGINT, _forward_term)
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget-s", type=float, default=5400.0)
     ap.add_argument("--steps", default=None,
                     help="comma-separated subset of step names")
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated step names to exclude (e.g. a "
+                         "canary already measured by the caller)")
     args = ap.parse_args()
     chosen = ([s for s in STEPS if s[0] in args.steps.split(",")]
               if args.steps else STEPS)
+    if args.skip:
+        chosen = [s for s in chosen if s[0] not in args.skip.split(",")]
     deadline = time.time() + args.budget_s
     wedges = 0
     got = 0
