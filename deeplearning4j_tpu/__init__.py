@@ -86,8 +86,10 @@ from .nn.transferlearning import (
     FineTuneConfiguration,
 )
 from .optimize.listeners import (
+    ComposableIterationListener,
     IterationListener,
     TrainingListener,
+    ParamAndGradientIterationListener,
     ScoreIterationListener,
     CollectScoresIterationListener,
     PerformanceListener,
@@ -163,6 +165,8 @@ __all__ = [
     "FineTuneConfiguration",
     "IterationListener",
     "TrainingListener",
+    "ComposableIterationListener",
+    "ParamAndGradientIterationListener",
     "ScoreIterationListener",
     "CollectScoresIterationListener",
     "PerformanceListener",

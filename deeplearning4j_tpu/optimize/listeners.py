@@ -125,3 +125,156 @@ class PerformanceListener(TrainingListener):
             logger.info("perf: %s", rec)
         self._last_iter = iteration
         self._accum = 0.0
+
+
+class ComposableIterationListener(TrainingListener):
+    """Forward every callback to a group of listeners as one attachment
+    (reference: optimize/listeners/ComposableIterationListener.java).
+
+    Capability flags aggregate conservatively: staged fit stays available
+    only if EVERY child supports it, and gradient instrumentation turns on
+    if ANY child needs it (at frequency 1, since children may disagree on
+    cadence)."""
+
+    def __init__(self, *listeners):
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = tuple(listeners[0])
+        self.listeners: List[TrainingListener] = list(listeners)
+
+    @property
+    def supports_staged(self) -> bool:  # type: ignore[override]
+        return all(getattr(l, "supports_staged", False) for l in self.listeners)
+
+    @property
+    def needs_gradients(self) -> bool:
+        return any(getattr(l, "needs_gradients", False) for l in self.listeners)
+
+    @property
+    def needs_input(self) -> bool:
+        return any(getattr(l, "needs_input", False) for l in self.listeners)
+
+    @property
+    def frequency(self) -> int:
+        """gcd of the instrumentation-needing children's frequencies: the
+        composite fires the instrumented step on a superset of every
+        child's cadence WITHOUT forcing it every iteration (a child at
+        frequency=50 keeps the donated fast path 49 of 50 steps)."""
+        import math
+
+        freqs = [max(1, int(getattr(l, "frequency", 1)))
+                 for l in self.listeners
+                 if getattr(l, "needs_gradients", False)
+                 or getattr(l, "needs_input", False)]
+        return math.gcd(*freqs) if freqs else 1
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)
+
+    def on_epoch_start(self, model, epoch):
+        for l in self.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_start(model, epoch)
+
+    def on_epoch_end(self, model, epoch):
+        for l in self.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_end(model, epoch)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Text/file dump of per-parameter and per-gradient statistics —
+    "much of the same information as the UI histogram listener, but in a
+    text-based format (for example, when learning on a system accessed via
+    SSH)" (reference: optimize/listeners/
+    ParamAndGradientIterationListener.java: mean / min / max / meanAbs per
+    parameter tensor and its gradient, tab-delimited, header row,
+    optionally appended to a file).
+
+    Reads ``model.params`` and ``model._last_grads`` — the instrumented
+    step populates the latter when ``needs_gradients`` listeners are
+    attached, on exactly the iterations this listener's frequency selects
+    (same machinery as the UI StatsListener)."""
+
+    supports_staged = False   # reads per-iteration model state
+    needs_gradients = True
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = True,
+                 print_mean_abs_value: bool = True,
+                 output_to_file: bool = False, file: Optional[str] = None,
+                 delimiter: str = "\t"):
+        self.frequency = max(1, iterations)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs_value = print_mean_abs_value
+        self.output_to_file = output_to_file
+        self.file = file
+        self.delimiter = delimiter
+        self._header_written = False
+        self.lines: List[str] = []  # also kept in memory (test/REPL use)
+
+    @staticmethod
+    def _leaf_names(tree) -> List[str]:
+        import jax
+
+        return [jax.tree_util.keystr(p)
+                for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+    def _stats(self, arr) -> List[str]:
+        import numpy as np
+
+        a = np.asarray(arr, dtype=np.float64)
+        out = []
+        if self.print_mean:
+            out.append(repr(float(a.mean())))
+        if self.print_min_max:
+            out.extend((repr(float(a.min())), repr(float(a.max()))))
+        if self.print_mean_abs_value:
+            out.append(repr(float(np.abs(a).mean())))
+        return out
+
+    def _emit(self, line: str) -> None:
+        if not (self.output_to_file and self.file):
+            self.lines.append(line)  # in-memory only when not file-backed
+        if self.output_to_file and self.file:
+            try:
+                with open(self.file, "a") as f:
+                    f.write(line + "\n")
+            except OSError as e:  # reference logs and keeps training
+                logger.warning("ParamAndGradientIterationListener: %s", e)
+        else:
+            logger.info("%s", line)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency:
+            return
+        import jax
+
+        params = getattr(model, "params", None)
+        grads = getattr(model, "_last_grads", None)
+        names = self._leaf_names(params)
+        if self.print_header and not self._header_written:
+            cols = ["iteration", "score"]
+            stat_names = ([ "mean"] if self.print_mean else []) + \
+                (["min", "max"] if self.print_min_max else []) + \
+                (["meanAbs"] if self.print_mean_abs_value else [])
+            for n in names:
+                cols.extend(f"param{n}.{s}" for s in stat_names)
+                cols.extend(f"grad{n}.{s}" for s in stat_names)
+            self._emit(self.delimiter.join(cols))
+            self._header_written = True
+        fields = [str(iteration), repr(float(score))]
+        g_leaves = (jax.tree_util.tree_leaves(grads)
+                    if grads is not None else [])
+        p_leaves = jax.tree_util.tree_leaves(params)
+        for i, p in enumerate(p_leaves):
+            fields.extend(self._stats(p))
+            if i < len(g_leaves):
+                fields.extend(self._stats(g_leaves[i]))
+            else:  # gradients unavailable this step: blank columns
+                n_stats = (int(self.print_mean) + 2 * int(self.print_min_max)
+                           + int(self.print_mean_abs_value))
+                fields.extend([""] * n_stats)
+        self._emit(self.delimiter.join(fields))

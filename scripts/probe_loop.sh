@@ -43,11 +43,15 @@ for i in $(seq 1 "$attempts"); do
   if run_plan --steps charrnn_small --budget-s 1000; then
     echo "probe_loop: tunnel healthy — running the full plan"
     # the canary row was just recorded; don't re-measure it
-    if run_plan --skip charrnn_small --budget-s 14400; then
+    run_plan --skip charrnn_small --budget-s 14400
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
       echo "probe_loop: full plan finished ($(date -u +%H:%M:%SZ))"
       exit 0
     fi
-    echo "probe_loop: full plan wedged partway — resuming the hunt"
+    # rc 2 = partial results then a wedge; rc 1 = nothing — either way
+    # the backlog is unfinished, keep hunting
+    echo "probe_loop: full plan incomplete (rc=$rc) — resuming the hunt"
   fi
   [ "$i" -lt "$attempts" ] && sleep "$sleep_s"
 done

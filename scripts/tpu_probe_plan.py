@@ -95,16 +95,15 @@ STEPS = [
 
 
 _CURRENT_CHILD: "subprocess.Popen | None" = None
+_TERM_PENDING: "int | None" = None
 
 
-def _forward_term(signum, frame):
-    """A TERM'd plan must not orphan its chip child (one-TPU-process rule).
-
-    TERM first, then escalate: the bench child installs a Python SIGTERM
-    handler (clean PJRT teardown), but Python handlers cannot run while
-    the child is blocked inside a C call — the tunnel-wedge state — so a
-    bounded wait then SIGKILL mirrors the bench parent's own escalation."""
-    child = _CURRENT_CHILD
+def _reap(child) -> None:
+    """TERM first, then escalate: the bench child installs a Python
+    SIGTERM handler (clean PJRT teardown), but Python handlers cannot run
+    while the child is blocked inside a C call — the tunnel-wedge state —
+    so a bounded wait then SIGKILL mirrors the bench parent's own
+    escalation."""
     if child is not None and child.poll() is None:
         child.terminate()
         try:
@@ -112,7 +111,20 @@ def _forward_term(signum, frame):
         except subprocess.TimeoutExpired:
             child.kill()
             child.wait()
-    sys.exit(143)
+
+
+def _forward_term(signum, frame):
+    """A TERM'd plan must not orphan its chip child (one-TPU-process rule).
+
+    If the signal lands in the spawn window (child started but
+    _CURRENT_CHILD not yet assigned), exiting here would orphan it —
+    instead flag the shutdown and let run_step reap whatever it spawned."""
+    global _TERM_PENDING
+    if _CURRENT_CHILD is None:
+        _TERM_PENDING = signum
+        return
+    _reap(_CURRENT_CHILD)
+    sys.exit(128 + signum)
 
 
 def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
@@ -133,6 +145,9 @@ def run_step(name: str, env_extra: dict, timeout_s: float) -> dict | None:
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True, cwd=REPO)
     _CURRENT_CHILD = proc
+    if _TERM_PENDING is not None:  # signal landed in the spawn window
+        _reap(proc)
+        sys.exit(128 + _TERM_PENDING)
     try:
         stdout, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -176,13 +191,16 @@ def main() -> int:
     deadline = time.time() + args.budget_s
     wedges = 0
     got = 0
+    aborted = False  # wedge-stop or budget-break: steps were left unrun
     for name, env_extra, step_timeout, store_suffix in chosen:
         remaining = deadline - time.time()
         if remaining < 120:
             print(f"PLAN: budget exhausted before {name}")
+            aborted = True
             break
         if wedges >= 2:
             print("PLAN: two consecutive wedges — tunnel is down, stopping")
+            aborted = True
             break
         result = run_step(name, env_extra, min(step_timeout, remaining))
         if result is None or result.get("metric") == "bench_skip":
@@ -215,7 +233,12 @@ def main() -> int:
         print(f"PLAN: {name} -> {result.get('metric')}="
               f"{result.get('value')} {result.get('unit', '')}")
     print(f"PLAN: done, {got} results in {RESULTS}")
-    return 0 if got else 1
+    # exit semantics (probe_loop.sh keys off these): 0 = every chosen step
+    # ran to a verdict; 2 = partial (some results, then wedge/budget stop —
+    # worth resuming); 1 = nothing landed.
+    if got and not aborted:
+        return 0
+    return 2 if got else 1
 
 
 if __name__ == "__main__":

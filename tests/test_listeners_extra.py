@@ -1,0 +1,111 @@
+"""ComposableIterationListener + ParamAndGradientIterationListener
+(reference: optimize/listeners/ComposableIterationListener.java,
+ParamAndGradientIterationListener.java — the last two stock listeners of
+the reference catalog)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import (
+    CollectScoresIterationListener,
+    ComposableIterationListener,
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    ParamAndGradientIterationListener,
+    ScoreIterationListener,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+
+
+def _net(listeners):
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=8, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(5),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+        seed=0,
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(*listeners)
+    return net
+
+
+def _data(n=32):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def test_composable_forwards_and_aggregates_flags():
+    collect = CollectScoresIterationListener()
+    pag = ParamAndGradientIterationListener(iterations=1)
+    comp = ComposableIterationListener(collect, pag)
+    assert comp.needs_gradients          # pag needs them
+    assert not comp.supports_staged      # pag reads per-step state
+    comp2 = ComposableIterationListener([ScoreIterationListener(),
+                                         CollectScoresIterationListener()])
+    assert comp2.supports_staged and not comp2.needs_gradients
+    assert comp2.frequency == 1 and not comp2.needs_input
+    # instrumentation cadence: gcd of needing children, NOT forced to 1
+    sparse = ParamAndGradientIterationListener(iterations=50)
+    assert ComposableIterationListener(sparse).frequency == 50
+    sparse30 = ParamAndGradientIterationListener(iterations=30)
+    assert ComposableIterationListener(sparse, sparse30).frequency == 10
+    # needs_input aggregates from children (conv listener wrapping)
+    from deeplearning4j_tpu.ui.conv_listener import (
+        ConvolutionalIterationListener,
+    )
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    conv = ConvolutionalIterationListener(InMemoryStatsStorage())
+    if getattr(conv, "needs_input", False):
+        assert ComposableIterationListener(conv).needs_input
+
+    net = _net([comp])
+    x, y = _data()
+    for _ in range(4):
+        net.fit(DataSet(x, y))
+    assert len(collect.scores) == 4      # the child listener really ran
+    assert len(pag.lines) >= 5           # header + 4 rows
+
+
+def test_param_and_gradient_listener_stats_and_file(tmp_path):
+    out = tmp_path / "stats.tsv"
+    pag = ParamAndGradientIterationListener(
+        iterations=2, output_to_file=True, file=str(out))
+    net = _net([pag])
+    x, y = _data()
+    for _ in range(5):
+        net.fit(DataSet(x, y))
+    lines = out.read_text().strip().splitlines()
+    header, rows = lines[0], lines[1:]
+    # iteration counts from 1; frequency=2 -> iterations 2 and 4 fire
+    assert [r.split("\t")[0] for r in rows] == ["2", "4"]
+    cols = header.split("\t")
+    assert cols[0] == "iteration" and cols[1] == "score"
+    # each param leaf contributes mean/min/max/meanAbs for params AND grads
+    assert any(c.startswith("param") and c.endswith(".mean") for c in cols)
+    assert any(c.startswith("grad") and c.endswith(".meanAbs") for c in cols)
+    first = rows[0].split("\t")
+    assert len(first) == len(cols)
+    # gradient columns are populated (the instrumented step ran), finite
+    vals = [float(v) for v in first[2:] if v != ""]
+    assert vals and all(np.isfinite(v) for v in vals)
+    gidx = [i for i, c in enumerate(cols) if c.startswith("grad")]
+    assert all(first[i] != "" for i in gidx)
+
+
+def test_param_and_gradient_listener_column_toggles():
+    pag = ParamAndGradientIterationListener(
+        iterations=1, print_min_max=False, print_mean_abs_value=False)
+    net = _net([pag])
+    x, y = _data()
+    net.fit(DataSet(x, y))
+    header = pag.lines[0].split("\t")
+    assert not any(c.endswith(".min") or c.endswith(".max")
+                   or c.endswith(".meanAbs") for c in header)
+    assert any(c.endswith(".mean") for c in header)
