@@ -24,7 +24,9 @@ linear lr decay follow the reference/word2vec conventions.
 
 from __future__ import annotations
 
+import logging
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, List, Optional, Sequence as Seq, Tuple
 
@@ -32,6 +34,8 @@ import numpy as np
 
 from .vocab import Huffman, VocabCache, VocabConstructor, VocabWord
 from .lookup import InMemoryLookupTable
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -129,6 +133,7 @@ class SequenceVectors:
         elements_algo: str = "skipgram",  # skipgram | cbow | none
         sequence_algo: Optional[str] = None,  # dbow | dm | None
         train_elements: bool = True,
+        progress_log_every_s: float = 10.0,
     ):
         if negative <= 0 and not use_hs:
             raise ValueError("need hierarchical softmax and/or negative sampling")
@@ -146,6 +151,8 @@ class SequenceVectors:
         self.elements_algo = elements_algo
         self.sequence_algo = sequence_algo
         self.train_elements = train_elements
+        self.progress_log_every_s = progress_log_every_s
+        self.last_words_per_sec: Optional[float] = None
 
         self.vocab: Optional[VocabCache] = None
         self.lookup: Optional[InMemoryLookupTable] = None
@@ -192,6 +199,14 @@ class SequenceVectors:
         if self.negative > 0:
             self.lookup.make_negative_table()
 
+    def _current_lr(self, words_seen: int, total_words: int) -> float:
+        """Linear decay to min_learning_rate (word2vec convention); shared
+        by the training flush and the progress log so they cannot drift."""
+        return max(
+            self.min_learning_rate,
+            self.learning_rate * (1.0 - words_seen / max(total_words, 1)),
+        )
+
     # ---------------------------------------------------------------- training
     def fit(self, sequences: Iterable) -> "SequenceVectors":
         seqs = [_as_sequence(s) for s in sequences]
@@ -199,6 +214,13 @@ class SequenceVectors:
             self.build_vocab(seqs)
         total_words = sum(len(s.elements) for s in seqs) * self.epochs
         words_seen = 0
+        seqs_seen = 0
+        # periodic progress (reference: SequenceVectors.java:1157 —
+        # "Words vectorized so far ... Seq/sec ... Words/sec ...
+        # learningRate"); also kept on the instance for programmatic use
+        t_start = time.perf_counter()
+        next_log = t_start + self.progress_log_every_s
+        self.last_words_per_sec = None
 
         # training-example buffers: (src rows [S], target)
         S = self._num_sources()
@@ -210,10 +232,7 @@ class SequenceVectors:
             nonlocal src_buf, mask_buf, tgt_buf
             while len(tgt_buf) >= self.batch_size or (final and tgt_buf):
                 take = min(self.batch_size, len(tgt_buf))
-                lr = max(
-                    self.min_learning_rate,
-                    self.learning_rate * (1.0 - words_seen / max(total_words, 1)),
-                )
+                lr = self._current_lr(words_seen, total_words)
                 self._device_step(
                     np.stack(src_buf[:take]),
                     np.stack(mask_buf[:take]),
@@ -224,14 +243,29 @@ class SequenceVectors:
                 if final and not tgt_buf:
                     break
 
-        for _ in range(self.epochs):
+        for epoch in range(self.epochs):
             order = self._rng.permutation(len(seqs))
             for si in order:
                 s = seqs[si]
                 n_new = self._generate_examples(s, src_buf, mask_buf, tgt_buf)
                 words_seen += len(s.elements)
+                seqs_seen += 1
                 flush()
+                now = time.perf_counter()
+                if now >= next_log:
+                    elapsed = max(now - t_start, 1e-9)
+                    self.last_words_per_sec = words_seen / elapsed
+                    lr = self._current_lr(words_seen, total_words)
+                    logger.info(
+                        "Epoch: [%d]; Words vectorized so far: [%d]; "
+                        "Sequences so far: [%d]; Seq/sec: [%.2f]; "
+                        "Words/sec: [%.2f]; learningRate: [%g]",
+                        epoch, words_seen, seqs_seen,
+                        seqs_seen / elapsed, self.last_words_per_sec, lr)
+                    next_log = now + self.progress_log_every_s
         flush(final=True)
+        elapsed = max(time.perf_counter() - t_start, 1e-9)
+        self.last_words_per_sec = words_seen / elapsed
         self._sync_tables()
         return self
 
