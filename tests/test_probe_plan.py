@@ -1,0 +1,135 @@
+"""Probe-plan contract (scripts/tpu_probe_plan.py): exit semantics,
+step selection, metric suffixing, and store rules — driven with a stubbed
+child so no chip is needed. probe_loop.sh keys off these exact codes."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_plan(tmp_path, monkeypatch, outcomes):
+    """Import a fresh plan module whose run_step children are stubbed.
+
+    ``outcomes``: dict step-name -> dict (a metric line) | None (wedge).
+    """
+    spec = importlib.util.spec_from_file_location(
+        "plan_under_test", os.path.join(REPO, "scripts", "tpu_probe_plan.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    m.RESULTS = str(tmp_path / "PROBE_RESULTS.jsonl")
+    recorded = []
+
+    class FakeProc:
+        def __init__(self, stdout):
+            self._stdout = stdout
+
+        def communicate(self, timeout=None):
+            return self._stdout, ""
+
+        def poll(self):
+            return 0
+
+    real_popen = m.subprocess.Popen
+
+    def fake_popen(cmd, env=None, **kw):
+        # only intercept the plan's own tagged children; anything else in
+        # the patched window (m.subprocess IS the stdlib module) passes
+        # through to the real Popen
+        if not (env and "PROBE_STEP_NAME" in env):
+            return real_popen(cmd, env=env, **kw)
+        out = outcomes.get(env["PROBE_STEP_NAME"])
+        if out is None:
+            return FakeProc("")  # no metric line = wedge
+        return FakeProc(json.dumps(out) + "\n")
+
+    monkeypatch.setattr(m.subprocess, "Popen", fake_popen)
+
+    # tag each step's env with its name so the fake can route (the real
+    # run_step passes env through)
+    orig_run_step = m.run_step
+
+    def tagged_run_step(name, env_extra, timeout_s):
+        env_extra = dict(env_extra, PROBE_STEP_NAME=name)
+        return orig_run_step(name, env_extra, timeout_s)
+
+    monkeypatch.setattr(m, "run_step", tagged_run_step)
+
+    # capture baseline-store writes instead of touching BENCH_SELF.json
+    import bench
+
+    monkeypatch.setattr(bench, "_with_self_baseline",
+                        lambda r: recorded.append(r) or r)
+    return m, recorded
+
+
+def _run(m, argv):
+    import signal
+
+    old = sys.argv
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    sys.argv = ["tpu_probe_plan.py"] + argv
+    try:
+        return m.main()
+    finally:
+        sys.argv = old
+        # main() installed the plan's handlers process-wide; restore so a
+        # later hanging test stays Ctrl-C-able
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+
+
+def test_all_steps_land_exit_0_and_suffixing(tmp_path, monkeypatch):
+    row = {"metric": "char_rnn_train_chars_per_sec", "value": 1.0,
+           "unit": "chars/sec"}
+    m, recorded = _load_plan(tmp_path, monkeypatch, {
+        "charrnn_small": dict(row), "charrnn_scan": dict(row)})
+    rc = _run(m, ["--steps", "charrnn_small,charrnn_scan",
+                  "--budget-s", "900"])
+    assert rc == 0
+    lines = [json.loads(l) for l in open(m.RESULTS)]
+    assert [l["probe_step"] for l in lines] == ["charrnn_small",
+                                                "charrnn_scan"]
+    # charrnn_scan's store_suffix is "_scan": metric suffixed in the record
+    assert lines[1]["metric"].endswith("_scan")
+    assert len(recorded) == 2  # both steps store (suffix not None)
+
+
+def test_partial_then_wedges_exit_2(tmp_path, monkeypatch):
+    row = {"metric": "m", "value": 2.0, "unit": "u"}
+    m, _ = _load_plan(tmp_path, monkeypatch, {
+        "charrnn_small": row, "charrnn_scan": None, "charrnn_fused": None,
+        "charrnn_b128": row})
+    rc = _run(m, ["--steps",
+                  "charrnn_small,charrnn_scan,charrnn_fused,charrnn_b128",
+                  "--budget-s", "900"])
+    assert rc == 2  # one result, then two consecutive wedges stop the run
+    lines = [json.loads(l) for l in open(m.RESULTS)]
+    assert len(lines) == 1  # charrnn_b128 never ran
+
+
+def test_nothing_lands_exit_1(tmp_path, monkeypatch):
+    m, recorded = _load_plan(tmp_path, monkeypatch, {"charrnn_small": None})
+    rc = _run(m, ["--steps", "charrnn_small", "--budget-s", "900"])
+    assert rc == 1
+    assert not os.path.exists(m.RESULTS)
+    assert not recorded
+
+
+def test_skip_excludes_and_none_suffix_skips_store(tmp_path, monkeypatch):
+    sweep_row = {"metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+                 "value": 3.0, "unit": "images/sec/chip",
+                 "sweep": {"64": 1.0}}
+    m, recorded = _load_plan(tmp_path, monkeypatch, {
+        "sweep": sweep_row, "charrnn_small": {"metric": "x", "value": 1,
+                                              "unit": "u"}})
+    rc = _run(m, ["--steps", "charrnn_small,sweep",
+                  "--skip", "charrnn_small", "--budget-s", "900"])
+    assert rc == 0
+    lines = [json.loads(l) for l in open(m.RESULTS)]
+    assert [l["probe_step"] for l in lines] == ["sweep"]
+    # sweep's store_suffix is None: recorded in the jsonl, NOT the store
+    assert not recorded
