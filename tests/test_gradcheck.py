@@ -56,14 +56,23 @@ def data(n_out=3, n=8, seed=0, one_hot=True):
         ("l1", "identity", False),
         ("poisson", "softplus", False),
         ("squared_hinge", "identity", True),
+        ("hinge", "identity", True),
         ("cosine_proximity", "identity", False),
+        ("kl_divergence", "softmax", True),
+        ("mape", "identity", False),
+        ("msle", "softplus", False),
     ],
 )
 def test_loss_gradients(loss, activation, one_hot):
     net = build_net(loss, activation)
     x, y = data(one_hot=one_hot)
-    if loss == "poisson":
+    if loss in ("poisson", "msle"):
         y = np.abs(y)
+    if loss == "mape":
+        y = np.where(np.abs(y) < 0.3, 0.5, y)  # mape divides by labels
+    if loss == "kl_divergence":
+        y = np.abs(y) + 0.1
+        y = y / y.sum(-1, keepdims=True)  # probability labels
     ok, failures, max_rel = gradient_check(
         net.loss_fn, net.params, x, y, max_params_to_check=80, verbose=True
     )
