@@ -406,3 +406,46 @@ def test_fused_lrn_grad_even_and_odd_windows(n):
     g_p = jax.grad(lambda v: jnp.sum(jnp.sin(fused_lrn(v, k, n, alpha, beta))))(x)
     g_x = jax.grad(lambda v: jnp.sum(jnp.sin(xla_lrn(v))))(x)
     np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_x), atol=1e-5)
+
+
+def test_rnn_time_step_streaming_under_seq_kernel(monkeypatch):
+    """Streaming inference under the TPU-default dispatch: rnn_time_step's
+    carried h/c state through the seq-kernel path must match the scan
+    path step for step (single-step calls AND a multi-step warmup chunk)."""
+    from deeplearning4j_tpu import (
+        GravesLSTM,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        RnnOutputLayer,
+        UpdaterConfig,
+    )
+
+    def make():
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=12, activation="tanh"),
+                    RnnOutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent")],
+            input_type=InputType.recurrent(6),
+            updater=UpdaterConfig(updater="sgd", learning_rate=0.05),
+            seed=9,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(4)
+    warm = rng.normal(size=(3, 8, 6)).astype(np.float32)   # [B, T, F] chunk
+    steps = [rng.normal(size=(3, 6)).astype(np.float32) for _ in range(4)]
+
+    outs = {}
+    for mode in ("0", "seq"):
+        monkeypatch.setenv("DL4J_TPU_PALLAS", mode)
+        net = make()
+        chunk = np.asarray(net.rnn_time_step(warm), np.float32)
+        singles = [np.asarray(net.rnn_time_step(s), np.float32)
+                   for s in steps]
+        outs[mode] = (chunk, singles)
+    np.testing.assert_allclose(outs["0"][0], outs["seq"][0],
+                               atol=2e-5, rtol=2e-5)
+    for a, b in zip(outs["0"][1], outs["seq"][1]):
+        # the carried h/c crossed the kernel boundary identically
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
