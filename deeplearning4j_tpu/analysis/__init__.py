@@ -1,0 +1,43 @@
+"""dl4jtpu-check: static analysis for configs and JAX/TPU pitfalls.
+
+Two passes, run before anything compiles:
+
+- **Graph pass** (`graph_checks`): abstract-interpret a
+  ``MultiLayerConfiguration`` / ``ComputationGraphConfiguration`` with
+  ``jax.eval_shape`` and diff the traced output of every layer/vertex
+  against its declared ``get_output_type()`` — the same static contract
+  the reference DL4J enforces via ``InputType`` propagation
+  (SURVEY.md §2.1), now cross-checked against what JAX will actually
+  trace. Also flags TPU-hostile configs (lane padding, float64,
+  variable timesteps, NCHW-looking inputs).
+- **AST pass** (`ast_checks`): lint Python sources for the classic JAX
+  footguns — ``np.*`` under ``jit``, host syncs in hot paths, PRNG key
+  reuse, Python control flow on traced values, captured-state mutation.
+
+Each finding carries a rule id (``DT0xx``), severity, location and fix
+hint; rules live in a registry (`rules`) so later PRs add checks
+cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress findings
+(`pragmas`). CLI: ``python -m deeplearning4j_tpu.analysis``.
+"""
+
+from .findings import Finding, Severity, SEVERITY_ORDER
+from .rules import Rule, RULES, get_rule, register_rule
+from .pragmas import filter_findings
+from .graph_checks import check_multi_layer, check_graph, check_config
+from .ast_checks import check_source, check_file
+
+__all__ = [
+    "Finding",
+    "Severity",
+    "SEVERITY_ORDER",
+    "Rule",
+    "RULES",
+    "get_rule",
+    "register_rule",
+    "filter_findings",
+    "check_multi_layer",
+    "check_graph",
+    "check_config",
+    "check_source",
+    "check_file",
+]

@@ -1,0 +1,32 @@
+"""Trace-context annotations for the AST pass.
+
+The lint pass finds jit bodies from decorators (@jax.jit, @partial(jax.jit,
+...)) and direct wraps (jax.jit(f), pl.pallas_call(kernel)). Kernels and
+steps reached through indirection — functools.partial chains, tables of
+functions, factory closures — are invisible to that scan, so they opt in
+explicitly with :func:`jit_entry` (a runtime no-op the analyzer treats
+exactly like @jax.jit).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+# names the AST pass accepts as jit-tracing decorators / wrappers
+JIT_DECORATORS = {
+    "jit", "pmap", "jit_entry",  # bare names
+}
+JIT_WRAPPERS = {
+    "jit", "pmap", "pallas_call", "jit_entry",
+}
+
+
+def jit_entry(fn: F) -> F:
+    """Mark ``fn`` as traced (executed under jit/pallas) for the analyzer.
+
+    Returns ``fn`` unchanged — zero runtime cost, works on kernel bodies
+    that must stay plain functions for pallas_call/functools.partial.
+    """
+    return fn

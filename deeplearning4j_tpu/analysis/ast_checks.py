@@ -1,0 +1,451 @@
+"""Pass 2: AST lint for JAX/TPU pitfalls.
+
+Trace-context discovery first: a function body is considered *traced*
+("jit body") when it is
+
+- decorated with ``@jax.jit`` / ``@jit`` / ``@jax.pmap`` /
+  ``@functools.partial(jax.jit, ...)`` / ``@jit_entry`` (analysis.annotations),
+- wrapped at a call site — ``jax.jit(f)``, ``pl.pallas_call(kernel, ...)``,
+  ``pallas_call(functools.partial(kernel, ...), ...)``, or
+- lexically nested inside a traced function.
+
+Inside traced bodies the pass hunts np.* calls (DT101), host syncs
+(DT102), Python control flow on traced parameters (DT104), mutation of
+captured state (DT105) and print/logging side effects (DT106). PRNG key
+reuse (DT103) is checked in *every* function — reusing a key is wrong
+whether or not the call is traced.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .annotations import JIT_DECORATORS, JIT_WRAPPERS
+from .findings import Finding
+from .pragmas import filter_findings
+from .rules import get_rule
+
+# jax.random.* that do NOT consume a key's randomness
+_NONCONSUMING = {
+    "split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+    "clone", "key_impl",
+}
+# attribute reads that make a traced value static (shape algebra)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_LOGGING_NAMES = {"logging", "logger", "log"}
+
+
+def _full_name(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('' when not a plain chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    if _last(_full_name(dec)) in JIT_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        head = _full_name(dec.func)
+        if _last(head) in JIT_DECORATORS:  # @jax.jit(static_argnums=...)
+            return True
+        if _last(head) == "partial" and dec.args:  # @partial(jax.jit, ...)
+            return _last(_full_name(dec.args[0])) in JIT_DECORATORS
+    return False
+
+
+def _wrapped_function_names(call: ast.Call) -> List[str]:
+    """Function names passed into jax.jit(f) / pallas_call(kernel) /
+    pallas_call(functools.partial(kernel, ...))."""
+    if _last(_full_name(call.func)) not in JIT_WRAPPERS:
+        return []
+    names = []
+    for arg in call.args[:1]:  # the traced callable is the first argument
+        if isinstance(arg, ast.Name):
+            names.append(arg.id)
+        elif isinstance(arg, ast.Call):
+            if _last(_full_name(arg.func)) == "partial" and arg.args:
+                inner = arg.args[0]
+                if isinstance(inner, ast.Name):
+                    names.append(inner.id)
+    return names
+
+
+class _Index(ast.NodeVisitor):
+    """Collect functions, their nesting, jax.random aliases and jit marks."""
+
+    def __init__(self):
+        self.functions: List[ast.FunctionDef] = []
+        self.parents: Dict[ast.AST, Optional[ast.AST]] = {}
+        self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+        self.jit_marked: Set[ast.AST] = set()
+        self.random_aliases: Set[str] = set()
+        self._stack: List[ast.AST] = []
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = self._stack[-1] if self._stack else None
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_fn:
+            self.functions.append(node)
+            self.by_name.setdefault(node.name, []).append(node)
+            if any(_decorator_is_jit(d) for d in node.decorator_list):
+                self.jit_marked.add(node)
+            self._stack.append(node)
+        if isinstance(node, ast.Call):
+            for fname in _wrapped_function_names(node):
+                for fn in self.by_name.get(fname, []):
+                    self.jit_marked.add(fn)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.random":
+                    self.random_aliases.add(alias.asname or "jax")
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "random":
+                        self.random_aliases.add(alias.asname or "random")
+        super().generic_visit(node)
+        if is_fn:
+            self._stack.pop()
+
+    def resolve_nesting(self):
+        """A function nested in a jit body is itself traced. Wrap calls can
+        appear after the def, so iterate to a fixed point."""
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in self.jit_marked:
+                    continue
+                p = self.parents.get(fn)
+                while p is not None:
+                    if p in self.jit_marked:
+                        self.jit_marked.add(fn)
+                        changed = True
+                        break
+                    p = self.parents.get(p)
+
+
+def _is_jax_random_call(call: ast.Call, aliases: Set[str]) -> Optional[str]:
+    """Return the jax.random function name when ``call`` is one, else None."""
+    name = _full_name(call.func)
+    if not name:
+        return None
+    head, _, fn = name.rpartition(".")
+    if head == "jax.random":
+        return fn
+    if head and head in aliases:
+        return fn
+    if head.endswith(".random") and head.split(".")[0] in aliases:
+        return fn
+    return None
+
+
+def _key_arg_name(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value.id
+    return None
+
+
+def _assigned_names(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _assigned_names(elt)
+    elif isinstance(node, ast.Starred):
+        yield from _assigned_names(node.value)
+
+
+class _KeyReuseSim:
+    """DT103: abstract interpretation of one function/module scope.
+
+    Tracks which key variables have been consumed along each control-flow
+    path. Branches of an if/try are simulated independently; the consumed
+    sets of the paths that *fall through* are INTERSECTED afterwards, so a
+    scheme-dispatch chain of mutually exclusive `if ...: return draw(key)`
+    arms (one consumption per call) stays clean while straight-line double
+    draws are flagged. Paths ending in return/raise/break/continue do not
+    merge back.
+    """
+
+    def __init__(self, aliases: Set[str], filename: str):
+        self.aliases = aliases
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    # -- expression-level events, in source order
+    def _expr_events(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # nested scopes get their own pass
+            if isinstance(sub, ast.Call):
+                fn = _is_jax_random_call(sub, self.aliases)
+                if fn and fn not in _NONCONSUMING:
+                    key = _key_arg_name(sub)
+                    if key:
+                        yield sub, key
+
+    def _consume(self, consumed: Dict[str, int], node: ast.AST):
+        for call, key in sorted(
+            self._expr_events(node),
+            key=lambda e: (e[0].lineno, e[0].col_offset),
+        ):
+            if key in consumed:
+                self.findings.append(get_rule("DT103").finding(
+                    f"PRNG key '{key}' already consumed at line "
+                    f"{consumed[key]} — both draws return identical "
+                    "randomness",
+                    file=self.filename, line=call.lineno,
+                    col=call.col_offset, context=key,
+                ))
+            else:
+                consumed[key] = call.lineno
+
+    def _assign(self, consumed: Dict[str, int], target: ast.AST):
+        for name in _assigned_names(target):
+            consumed.pop(name, None)
+
+    @staticmethod
+    def _merge(branches: List[Optional[Dict[str, int]]]) -> Dict[str, int]:
+        """Intersect consumed-sets of fall-through branches (None = path
+        terminated); all-terminated yields an empty (unreachable) state."""
+        live = [b for b in branches if b is not None]
+        if not live:
+            return {}
+        keys = set(live[0])
+        for b in live[1:]:
+            keys &= set(b)
+        return {k: live[0][k] for k in keys}
+
+    def run(self, body: List[ast.stmt],
+            consumed: Dict[str, int]) -> Optional[Dict[str, int]]:
+        """Simulate a statement list; returns the fall-through consumed set,
+        or None when every path terminates."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                self._consume(consumed, stmt)
+                return None
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return None
+            if isinstance(stmt, ast.If):
+                self._consume(consumed, stmt.test)
+                then = self.run(stmt.body, dict(consumed))
+                other = self.run(stmt.orelse, dict(consumed))
+                consumed = self._merge([then, other])
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._consume(consumed, stmt.iter)
+                self._assign(consumed, stmt.target)
+                loop = self.run(stmt.body, dict(consumed))
+                tail = self.run(stmt.orelse, dict(consumed))
+                consumed = self._merge([loop, tail, consumed])
+                continue
+            if isinstance(stmt, ast.While):
+                self._consume(consumed, stmt.test)
+                loop = self.run(stmt.body, dict(consumed))
+                tail = self.run(stmt.orelse, dict(consumed))
+                consumed = self._merge([loop, tail, consumed])
+                continue
+            if isinstance(stmt, ast.Try):
+                tried = self.run(stmt.body, dict(consumed))
+                paths = [tried]
+                for handler in stmt.handlers:
+                    paths.append(self.run(handler.body, dict(consumed)))
+                paths.append(self.run(stmt.orelse, dict(consumed)))
+                merged = self._merge(paths + [consumed])
+                fin = self.run(stmt.finalbody, merged)
+                consumed = merged if fin is None else fin
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._consume(consumed, item.context_expr)
+                inner = self.run(stmt.body, consumed)
+                if inner is None:
+                    return None
+                consumed = inner
+                continue
+            # simple statement: uses first, then assignment resets
+            self._consume(consumed, stmt)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._assign(consumed, t)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                self._assign(consumed, stmt.target)
+        return consumed
+
+
+def _check_key_reuse(scope_body: List[ast.stmt], aliases: Set[str],
+                     filename: str) -> List[Finding]:
+    sim = _KeyReuseSim(aliases, filename)
+    sim.run(scope_body, {})
+    return sim.findings
+
+
+def _test_uses_traced_param(test: ast.AST, params: Set[str]) -> Optional[str]:
+    """A param referenced in a branch test, ignoring static uses
+    (x.shape/x.ndim/..., isinstance(x, ...), x is None)."""
+    skip: Set[ast.AST] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            for sub in ast.walk(node.value):
+                skip.add(sub)
+        elif isinstance(node, ast.Call):
+            head = _last(_full_name(node.func))
+            if head in ("isinstance", "len", "getattr", "hasattr", "callable"):
+                for sub in ast.walk(node):
+                    skip.add(sub)
+        elif isinstance(node, ast.Compare):
+            cmps = [node.left] + list(node.comparators)
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                for c in cmps:
+                    for sub in ast.walk(c):
+                        skip.add(sub)
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params and node not in skip:
+            return node.id
+    return None
+
+
+# annotations that mark a parameter as a static Python scalar, not a traced
+# array (kernel convention: `block_k: int, causal: bool` are partial-bound)
+_STATIC_ANNOTATIONS = {"bool", "int", "float", "str"}
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    """Parameters that may carry traced values (annotated static scalars and
+    self excluded)."""
+    a = fn.args
+    params = list(a.posonlyargs + a.args + a.kwonlyargs)
+    if a.vararg:
+        params.append(a.vararg)
+    if a.kwarg:
+        params.append(a.kwarg)
+    names = set()
+    for p in params:
+        if p.arg == "self":
+            continue
+        ann = getattr(p, "annotation", None)
+        if ann is not None and _last(_full_name(ann)) in _STATIC_ANNOTATIONS:
+            continue
+        names.add(p.arg)
+    return names
+
+
+def _check_jit_body(fn: ast.FunctionDef, filename: str) -> List[Finding]:
+    """DT101/102/104/105/106 inside one traced function body."""
+    findings: List[Finding] = []
+    params = _param_names(fn)
+    globals_nonlocals: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            globals_nonlocals.update(node.names)
+    ctx = fn.name
+    for node in ast.walk(fn):
+        loc = dict(file=filename, line=getattr(node, "lineno", 0),
+                   col=getattr(node, "col_offset", 0), context=ctx)
+        if isinstance(node, ast.Call):
+            name = _full_name(node.func)
+            head = name.split(".", 1)[0]
+            if head in ("np", "numpy") and "." in name:
+                findings.append(get_rule("DT101").finding(
+                    f"{name}() inside jit body '{ctx}' executes on host at "
+                    "trace time", **loc))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("item", "tolist"):
+                findings.append(get_rule("DT102").finding(
+                    f".{node.func.attr}() inside jit body '{ctx}' forces a "
+                    "host sync", **loc))
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    not isinstance(node.args[0], ast.Constant):
+                findings.append(get_rule("DT102").finding(
+                    f"{node.func.id}() on a traced value inside jit body "
+                    f"'{ctx}' forces a host sync", **loc))
+            elif isinstance(node.func, ast.Name) and node.func.id == "print":
+                findings.append(get_rule("DT106").finding(
+                    f"print() inside jit body '{ctx}' runs at trace time "
+                    "only", **loc))
+            elif head in _LOGGING_NAMES and "." in name:
+                findings.append(get_rule("DT106").finding(
+                    f"{name}() inside jit body '{ctx}' runs at trace time "
+                    "only", **loc))
+        elif isinstance(node, (ast.If, ast.While)):
+            used = _test_uses_traced_param(node.test, params)
+            if used is not None:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(get_rule("DT104").finding(
+                    f"Python `{kind}` on traced parameter '{used}' in jit "
+                    f"body '{ctx}'", **loc))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    base = _full_name(t.value)
+                    if base.split(".", 1)[0] == "self":
+                        findings.append(get_rule("DT105").finding(
+                            f"assignment to {_full_name(t)} inside jit body "
+                            f"'{ctx}' mutates captured state at trace time "
+                            "only", **loc))
+                for nm in _assigned_names(t):
+                    if nm in globals_nonlocals:
+                        findings.append(get_rule("DT105").finding(
+                            f"assignment to global/nonlocal '{nm}' inside "
+                            f"jit body '{ctx}' happens at trace time only",
+                            **loc))
+    return findings
+
+
+def check_source(source: str, filename: str = "<source>") -> List[Finding]:
+    """Lint one Python source string; pragma-filtered findings."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [get_rule("DT100").finding(
+            f"could not parse: {e.msg}", file=filename,
+            line=e.lineno or 1, col=(e.offset or 1) - 1,
+        )]
+    index = _Index()
+    index.visit(tree)
+    index.resolve_nesting()
+
+    findings: List[Finding] = []
+    # DT103 in every scope (module + each function)
+    findings += _check_key_reuse(tree.body, index.random_aliases, filename)
+    for fn in index.functions:
+        findings += _check_key_reuse(fn.body, index.random_aliases, filename)
+    # traced-body rules; nested jit functions are reached via their own
+    # entry in jit_marked, so dedup on (rule, line, col)
+    seen: Set[Tuple[str, int, int]] = set()
+    for fn in index.jit_marked:
+        for f in _check_jit_body(fn, filename):
+            k = (f.rule_id, f.line, f.col)
+            if k not in seen:
+                seen.add(k)
+                findings.append(f)
+    return filter_findings(findings, source)
+
+
+def check_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return check_source(fh.read(), filename=path)

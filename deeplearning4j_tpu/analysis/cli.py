@@ -1,0 +1,125 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis [paths...]``.
+
+- ``.py`` files (and directories, walked recursively) get the AST pass.
+- ``.json`` files are parsed as serialized configs (``to_json`` output of
+  MultiLayerConfiguration / ComputationGraphConfiguration) and get the
+  graph pass.
+
+``--fail-on`` picks the exit-code threshold: exit 1 when any finding at
+or above that severity survives pragmas, else 0. ``--json`` emits a
+machine-readable report on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .findings import Finding, SEVERITY_ORDER, count_by_severity, sort_findings
+from .rules import RULES
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+def _iter_py_files(root: str):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _SKIP_DIRS and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _analyze_json_config(path: str, batch: int, timesteps: int) -> List[Finding]:
+    from .graph_checks import check_config
+
+    with open(path, "r", encoding="utf-8") as fh:
+        d = json.load(fh)
+    return check_config(d, batch=batch, timesteps_probe=timesteps, source=path)
+
+
+def _list_rules() -> str:
+    lines = ["rule    severity  scope  title"]
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        lines.append(f"{rid}   {r.severity:<8}  {r.scope:<5}  {r.title}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="dl4jtpu-check: static analysis for model configs (.json) "
+                    "and JAX/TPU pitfalls (.py).",
+    )
+    ap.add_argument("paths", nargs="*", help=".py files, directories, or "
+                    "serialized config .json files")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "info", "never"],
+                    help="exit 1 when a finding at/above this severity "
+                    "survives (default: error)")
+    ap.add_argument("--batch", type=int, default=4,
+                    help="batch size for the eval_shape probe (default 4)")
+    ap.add_argument("--timesteps", type=int, default=16,
+                    help="probe length substituted for variable timesteps")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        ap.error("no paths given (or use --list-rules)")
+
+    findings: List[Finding] = []
+    n_files = 0
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+        if path.endswith(".json"):
+            n_files += 1
+            try:
+                findings += _analyze_json_config(path, args.batch, args.timesteps)
+            except Exception as e:
+                print(f"error: could not analyze config {path}: {e}",
+                      file=sys.stderr)
+                return 2
+        else:
+            from .ast_checks import check_file
+
+            for py in _iter_py_files(path):
+                n_files += 1
+                findings += check_file(py)
+
+    findings = sort_findings(findings)
+    counts = count_by_severity(findings)
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "files_analyzed": n_files,
+            "counts": counts,
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format_human())
+        print(f"{len(findings)} finding(s) ({counts['error']} error, "
+              f"{counts['warning']} warning, {counts['info']} info) "
+              f"across {n_files} file(s)")
+
+    if args.fail_on == "never":
+        return 0
+    threshold = SEVERITY_ORDER[args.fail_on]
+    worst = max((SEVERITY_ORDER[f.severity] for f in findings), default=-1)
+    return 1 if worst >= threshold else 0

@@ -1,0 +1,69 @@
+"""Finding: one diagnostic from either analyzer pass.
+
+Locations come in two flavors and share one rendering:
+- AST findings: ``file:line:col``
+- graph findings: ``source:vertex 'name'`` (configs have no line numbers;
+  the vertex/layer name is the address inside the config)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# total order used by --fail-on and sorting; "never" is a CLI threshold
+# only (no finding carries it)
+Severity = str
+SEVERITY_ORDER = {"info": 0, "warning": 1, "error": 2}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str  # "DT001" ... registered in rules.py
+    severity: Severity
+    message: str
+    file: str = "<config>"
+    line: int = 0  # 0 = no line info (graph findings)
+    col: int = 0
+    context: str = ""  # vertex/layer/function name the finding anchors to
+    hint: str = ""  # how to fix (rule default unless overridden)
+
+    @property
+    def location(self) -> str:
+        if self.line:
+            return f"{self.file}:{self.line}:{self.col}"
+        if self.context:
+            return f"{self.file}:{self.context}"
+        return self.file
+
+    def format_human(self) -> str:
+        ctx = f" [{self.context}]" if self.line and self.context else ""
+        s = f"{self.location}: {self.rule_id} {self.severity}: {self.message}{ctx}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def to_dict(self) -> dict:
+        return {
+            "rule_id": self.rule_id,
+            "severity": self.severity,
+            "message": self.message,
+            "file": self.file,
+            "line": self.line,
+            "col": self.col,
+            "context": self.context,
+            "hint": self.hint,
+        }
+
+
+def sort_findings(findings) -> list:
+    return sorted(
+        findings,
+        key=lambda f: (f.file, f.line, f.col, f.rule_id, f.context),
+    )
+
+
+def count_by_severity(findings) -> dict:
+    counts = {"error": 0, "warning": 0, "info": 0}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts

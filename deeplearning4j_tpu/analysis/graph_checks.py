@@ -1,0 +1,280 @@
+"""Pass 1: abstract interpretation of network configs.
+
+For every layer/vertex the pass runs ``jax.eval_shape`` over
+``init_params``/``init_state``/``apply`` (no FLOPs, no allocation — pure
+shape/dtype algebra) and diffs the traced output against the layer's
+declared ``get_output_type()``. The declared algebra drives preprocessor
+insertion, distributed sharding and serialization, so drift between the
+two is a latent correctness bug even when both paths "work".
+
+Vertices are checked independently: each one is fed its *declared*
+input types, so one drifting vertex yields one finding instead of a
+cascade through everything downstream.
+
+On top of the contract diff, config-level TPU heuristics: lane padding
+(DT003), variable timesteps (DT004), NCHW-looking inputs (DT005),
+float64 compute (DT006), missing loss head (DT007).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .findings import Finding
+from .rules import get_rule
+
+# timesteps probe substituted for variable-length (None) recurrent inputs
+DEFAULT_TIMESTEPS_PROBE = 16
+DEFAULT_BATCH = 4
+
+_LANE = 128  # TPU vector lane width; VPU/MXU tile is (8, 128)
+_SUBLANE = 8
+
+
+# ------------------------------------------------------------------ plumbing
+def _compute_dtype(conf_dtype: str):
+    if conf_dtype == "bfloat16":
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(conf_dtype)
+
+
+def _probe_shape(it, t_probe: int) -> Tuple[int, ...]:
+    """Per-example probe shape; variable timesteps pinned to ``t_probe``."""
+    if it.kind == "rnn" and it.timesteps is None:
+        return (t_probe, it.size)
+    return it.example_shape()
+
+
+def _retype_floats(tree, dt):
+    """Re-dtype floating leaves of a struct pytree to the compute dtype —
+    mirrors _cast_params/_cast_input in nn/multilayer.py so the trace sees
+    the dtypes the real forward would."""
+    def one(s):
+        if hasattr(s, "dtype") and jnp.issubdtype(s.dtype, jnp.floating):
+            return jax.ShapeDtypeStruct(s.shape, dt)
+        if hasattr(s, "dtype"):
+            return jax.ShapeDtypeStruct(s.shape, s.dtype)
+        return s
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _trace_apply(obj, input_types: Sequence, batch: int, t_probe: int, dt,
+                 *, as_vertex: bool):
+    """eval_shape through init_params/init_state/apply; returns the output
+    ShapeDtypeStruct (first element when apply returns (out, state))."""
+    key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p = jax.eval_shape(lambda k: obj.init_params(k, *input_types), key_struct)
+    s = jax.eval_shape(lambda: obj.init_state(*input_types))
+    p, s = _retype_floats(p, dt), _retype_floats(s, dt)
+    xs = [
+        jax.ShapeDtypeStruct((batch,) + _probe_shape(it, t_probe), dt)
+        for it in input_types
+    ]
+    if as_vertex:
+        fn = lambda pp, ss, *aa: obj.apply(pp, list(aa), ss, train=False)  # noqa: E731
+    else:
+        fn = lambda pp, ss, aa: obj.apply(pp, aa, ss, train=False)  # noqa: E731
+    out = jax.eval_shape(fn, p, s, *xs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return out
+
+
+def _diff_contract(rule_ctx: dict, declared, traced, t_probe: int,
+                   compute_dt) -> List[Finding]:
+    """DT001/DT002: declared InputType vs traced ShapeDtypeStruct.
+
+    The batch axis is skipped — InputType describes one example, and
+    batch-reshaping vertices (Stack/Unstack) change it legitimately.
+    """
+    out: List[Finding] = []
+    want = _probe_shape(declared, t_probe)
+    got = tuple(traced.shape[1:])
+    if got != tuple(want):
+        out.append(get_rule("DT001").finding(
+            f"declared output {declared} (example shape {tuple(want)}) but "
+            f"jax.eval_shape traced {got}",
+            **rule_ctx,
+        ))
+    if jnp.issubdtype(traced.dtype, jnp.floating) and traced.dtype != compute_dt:
+        out.append(get_rule("DT002").finding(
+            f"traced output dtype {traced.dtype} != configured compute "
+            f"dtype {compute_dt}",
+            **rule_ctx,
+        ))
+    return out
+
+
+def _lane_findings(it, rule_ctx: dict) -> List[Finding]:
+    """DT003 on the trailing (lane) dim of a declared type."""
+    if it.kind == "ff":
+        dim, label = it.size, "feature dim"
+    elif it.kind == "rnn":
+        dim, label = it.size, "feature dim"
+    elif it.kind == "cnn":
+        dim, label = it.channels, "channel dim"
+    else:
+        return []
+    rule = get_rule("DT003")
+    if dim >= 64 and dim % _LANE != 0:
+        padded = -(-dim // _LANE) * _LANE
+        return [rule.finding(
+            f"{label} {dim} pads to {padded} on the {_LANE}-wide TPU lane "
+            f"({100 * (padded - dim) // padded}% of the tile wasted)",
+            **rule_ctx,
+        )]
+    if _SUBLANE < dim < 64 and dim % _SUBLANE != 0:
+        return [rule.finding(
+            f"{label} {dim} is not a multiple of the {_SUBLANE}-row sublane",
+            severity="info", **rule_ctx,
+        )]
+    return []
+
+
+def _input_findings(input_types: Iterable, source: str,
+                    names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """DT004/DT005 on declared network inputs."""
+    out: List[Finding] = []
+    for i, it in enumerate(input_types):
+        label = names[i] if names else f"input[{i}]"
+        ctx = {"file": source, "context": label}
+        if it.kind == "rnn" and it.timesteps is None:
+            out.append(get_rule("DT004").finding(
+                f"{label} declares variable timesteps (None): each distinct "
+                "sequence length recompiles the whole step", **ctx,
+            ))
+        if it.kind in ("cnn", "cnn_flat") and it.height <= 4 and it.channels >= 32:
+            out.append(get_rule("DT005").finding(
+                f"{label} is {it.height}x{it.width}x{it.channels} (HxWxC) — "
+                "tiny height with a large channel count looks like NCHW data "
+                "declared as NHWC", **ctx,
+            ))
+    return out
+
+
+def _dtype_findings(conf, source: str) -> List[Finding]:
+    if conf.dtype == "float64":
+        return [get_rule("DT006").finding(
+            "compute dtype float64: TPUs emulate f64 in software",
+            file=source, context="dtype",
+        )]
+    return []
+
+
+# ----------------------------------------------------------------- MLN check
+def check_multi_layer(conf, *, batch: int = DEFAULT_BATCH,
+                      timesteps_probe: int = DEFAULT_TIMESTEPS_PROBE,
+                      source: str = "<MultiLayerConfiguration>") -> List[Finding]:
+    """Analyze a MultiLayerConfiguration; returns findings (possibly empty)."""
+    findings: List[Finding] = []
+    findings += _dtype_findings(conf, source)
+    if conf.input_type is not None:
+        findings += _input_findings([conf.input_type], source, ["input"])
+    if conf.layers and not conf.layers[-1].is_output_layer:
+        findings.append(get_rule("DT007").finding(
+            f"last layer {type(conf.layers[-1]).__name__} is not an output "
+            "layer — fit() has no loss to differentiate",
+            file=source, context=f"layer[{len(conf.layers) - 1}]",
+        ))
+    if conf.input_type is None:
+        return findings  # shape pass needs a declared input type
+
+    dt = _compute_dtype(conf.dtype)
+    try:
+        its = conf.layer_input_types()
+    except Exception as e:  # propagation itself failed: one finding, stop
+        findings.append(get_rule("DT001").finding(
+            f"declared shape propagation failed: {e}",
+            file=source, context="layer_input_types",
+        ))
+        return findings
+    for i, (layer, it) in enumerate(zip(conf.layers, its)):
+        ctx = {"file": source,
+               "context": f"layer[{i}] {type(layer).__name__}"}
+        try:
+            declared = layer.get_output_type(it)
+        except Exception as e:
+            findings.append(get_rule("DT001").finding(
+                f"get_output_type({it}) raised: {e}", **ctx))
+            continue
+        findings += _lane_findings(declared, ctx)
+        try:
+            traced = _trace_apply(layer, [it], batch, timesteps_probe, dt,
+                                  as_vertex=False)
+        except Exception as e:
+            findings.append(get_rule("DT001").finding(
+                f"apply() failed to trace at declared input {it}: {e}", **ctx))
+            continue
+        findings += _diff_contract(ctx, declared, traced, timesteps_probe, dt)
+    return findings
+
+
+# --------------------------------------------------------------- graph check
+def check_graph(conf, *, batch: int = DEFAULT_BATCH,
+                timesteps_probe: int = DEFAULT_TIMESTEPS_PROBE,
+                source: str = "<ComputationGraphConfiguration>") -> List[Finding]:
+    """Analyze a ComputationGraphConfiguration; returns findings."""
+    findings: List[Finding] = []
+    findings += _dtype_findings(conf, source)
+    findings += _input_findings(conf.input_types, source, conf.network_inputs)
+    for o in conf.network_outputs:
+        v = conf.vertices.get(o)
+        if v is not None and not v.is_output_layer:
+            findings.append(get_rule("DT007").finding(
+                f"network output '{o}' ({type(v).__name__}) is not an "
+                "output layer — fit() has no loss to differentiate",
+                file=source, context=f"vertex '{o}'",
+            ))
+    if not conf.input_types:
+        return findings
+
+    dt = _compute_dtype(conf.dtype)
+    try:
+        vit = conf.vertex_input_types()
+    except Exception as e:
+        findings.append(get_rule("DT001").finding(
+            f"declared shape propagation failed: {e}",
+            file=source, context="vertex_input_types",
+        ))
+        return findings
+    for name in conf.topological_order():
+        vertex = conf.vertices[name]
+        ins = vit[name]
+        ctx = {"file": source, "context": f"vertex '{name}'"}
+        try:
+            declared = vertex.get_output_type(*ins)
+        except Exception as e:
+            findings.append(get_rule("DT001").finding(
+                f"get_output_type raised: {e}", **ctx))
+            continue
+        findings += _lane_findings(declared, ctx)
+        try:
+            traced = _trace_apply(vertex, ins, batch, timesteps_probe, dt,
+                                  as_vertex=True)
+        except Exception as e:
+            findings.append(get_rule("DT001").finding(
+                "apply() failed to trace at declared inputs "
+                f"{[str(t) for t in ins]}: {e}", **ctx))
+            continue
+        findings += _diff_contract(ctx, declared, traced, timesteps_probe, dt)
+    return findings
+
+
+def check_config(conf, **kw) -> List[Finding]:
+    """Dispatch on config type (or a parsed to_dict()-style mapping)."""
+    from ..nn.conf.multi_layer import MultiLayerConfiguration
+    from ..nn.conf.computation_graph import ComputationGraphConfiguration
+
+    if isinstance(conf, dict):
+        if "vertices" in conf:
+            conf = ComputationGraphConfiguration.from_dict(conf)
+        else:
+            conf = MultiLayerConfiguration.from_dict(conf)
+    if isinstance(conf, ComputationGraphConfiguration):
+        return check_graph(conf, **kw)
+    if isinstance(conf, MultiLayerConfiguration):
+        return check_multi_layer(conf, **kw)
+    raise TypeError(f"Cannot analyze {type(conf).__name__}")

@@ -1,0 +1,57 @@
+"""Inline suppression pragmas.
+
+Syntax (anywhere in a comment on the flagged line):
+    # dl4jtpu: ignore[DT101]          suppress one rule on this line
+    # dl4jtpu: ignore[DT101,DT102]    suppress several
+    # dl4jtpu: ignore                 suppress every rule on this line
+    # dl4jtpu: skip-file              (first 5 lines) skip the whole file
+
+Graph findings have no line numbers, so pragmas only apply to AST
+findings; suppress graph findings by fixing the config or narrowing the
+checks passed to check_config().
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from .findings import Finding
+
+# the pragma may share a comment with prose: "# static arg — dl4jtpu: ignore[DT104]"
+_PRAGMA_RE = re.compile(r"#.*?dl4jtpu:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+_SKIP_FILE_RE = re.compile(r"#.*?dl4jtpu:\s*skip-file")
+
+
+def file_skipped(source: str) -> bool:
+    head = source.splitlines()[:5]
+    return any(_SKIP_FILE_RE.search(line) for line in head)
+
+
+def line_pragmas(source: str) -> Dict[int, Optional[Set[str]]]:
+    """1-based line -> set of suppressed rule ids (None = all rules)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def filter_findings(findings: Iterable[Finding], source: str) -> List[Finding]:
+    """Drop findings suppressed by pragmas in ``source``."""
+    if file_skipped(source):
+        return []
+    pragmas = line_pragmas(source)
+    kept: List[Finding] = []
+    for f in findings:
+        rules = pragmas.get(f.line, "absent")
+        if rules == "absent":
+            kept.append(f)
+        elif rules is not None and f.rule_id not in rules:
+            kept.append(f)
+    return kept

@@ -1,0 +1,165 @@
+"""Rule registry: every check has a DT0xx id, default severity and fix hint.
+
+DT0xx = graph/config rules (pass 1), DT1xx = AST lint rules (pass 2).
+Register new rules with :func:`register_rule`; the catalog drives
+``--list-rules``, docs/static_analysis.md, and pragma validation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from .findings import Finding, Severity
+
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    title: str
+    severity: Severity  # default; individual findings may downgrade
+    scope: str  # "graph" | "ast"
+    description: str
+    hint: str
+
+    def finding(self, message: str, *, severity: Severity = None, **kw) -> Finding:
+        return Finding(
+            rule_id=self.rule_id,
+            severity=severity or self.severity,
+            message=message,
+            hint=kw.pop("hint", self.hint),
+            **kw,
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule: Rule) -> Rule:
+    if rule.rule_id in RULES:
+        raise ValueError(f"Duplicate rule id {rule.rule_id}")
+    RULES[rule.rule_id] = rule
+    return rule
+
+
+def get_rule(rule_id: str) -> Rule:
+    try:
+        return RULES[rule_id]
+    except KeyError:
+        raise KeyError(
+            f"Unknown rule id {rule_id!r}. Known: {', '.join(sorted(RULES))}"
+        ) from None
+
+
+# --------------------------------------------------------------- graph rules
+register_rule(Rule(
+    "DT001", "shape contract drift", "error", "graph",
+    "A layer/vertex's declared get_output_type() disagrees with the shape "
+    "jax.eval_shape traces through its apply() — the static shape algebra "
+    "is lying about what XLA will actually build.",
+    "Fix get_output_type() (or the layer's apply()) so the declared and "
+    "traced shapes match; shape inference feeds preprocessor insertion and "
+    "distributed sharding, so drift compounds downstream.",
+))
+register_rule(Rule(
+    "DT002", "dtype contract drift", "error", "graph",
+    "A layer/vertex output dtype differs from the configured compute dtype "
+    "(e.g. accidental float64 promotion from a NumPy scalar, or a hardcoded "
+    "float32 cast under a bfloat16 config).",
+    "Keep constants weakly-typed (Python floats / jnp scalars), avoid "
+    "np.float64 intermediates, and derive casts from x.dtype.",
+))
+register_rule(Rule(
+    "DT003", "dim not padded to TPU lanes", "warning", "graph",
+    "A feature/channel dim is not a multiple of the 128-wide TPU lane "
+    "(VPU/MXU tile (8, 128)); XLA pads each such tensor, silently wasting "
+    "compute and HBM bandwidth.",
+    "Round hidden/channel sizes up to a multiple of 128 (or at least 8) "
+    "when the model permits; padding waste scales with every op touching "
+    "the tensor.",
+))
+register_rule(Rule(
+    "DT004", "variable timesteps force recompiles", "warning", "graph",
+    "A recurrent input declares timesteps=None (variable length): every "
+    "distinct sequence length traces and compiles a fresh XLA program at "
+    "runtime.",
+    "Pad/bucket sequences to a fixed set of lengths (datasets/bucketing) "
+    "and declare InputType.recurrent(size, timesteps=T) per bucket.",
+))
+register_rule(Rule(
+    "DT005", "NCHW-shaped input suspected", "warning", "graph",
+    "A convolutional input looks channels-first (tiny height, large "
+    "channel count). This stack is NHWC-native on TPU; NCHW data fed as "
+    "NHWC trains on scrambled pixels without any error.",
+    "Declare InputType.convolutional(height, width, channels) in NHWC "
+    "order and transpose the data once at ingest (x.transpose(0, 2, 3, 1)).",
+))
+register_rule(Rule(
+    "DT006", "TPU-hostile compute dtype", "warning", "graph",
+    "The configured compute dtype is float64: TPUs have no f64 ALU path — "
+    "XLA emulates it in software at a massive slowdown.",
+    "Use float32 (or bfloat16 for MXU-bound nets) as the compute dtype; "
+    "keep float64 for offline gradient checks only.",
+))
+register_rule(Rule(
+    "DT007", "network output has no loss head", "info", "graph",
+    "A network output layer/vertex is not an output (loss-bearing) layer; "
+    "fit() will have no loss to differentiate.",
+    "End trainable networks with OutputLayer/RnnOutputLayer/LossLayer "
+    "(inference-only models can ignore this).",
+))
+
+# ----------------------------------------------------------------- AST rules
+register_rule(Rule(
+    "DT100", "unparseable source", "error", "ast",
+    "The file could not be parsed as Python, so none of the AST checks ran "
+    "on it.",
+    "Fix the syntax error (the analyzer uses the running interpreter's "
+    "grammar).",
+))
+register_rule(Rule(
+    "DT101", "numpy call inside jit", "error", "ast",
+    "np.* called inside a jit/pallas-traced body: NumPy executes at trace "
+    "time on host — on traced values it either crashes (TracerArrayConversion) "
+    "or silently bakes a constant into the compiled program.",
+    "Use jnp.* inside traced code; np.* is fine only on static values "
+    "(shapes, python ints) — suppress with # dl4jtpu: ignore[DT101] there.",
+))
+register_rule(Rule(
+    "DT102", "host sync in traced/hot path", "error", "ast",
+    ".item()/.tolist()/float()/int()/np.asarray() on a traced value blocks "
+    "the host on the device queue — under jit it fails or constant-folds; "
+    "in a train-step hot path it serializes every dispatch.",
+    "Keep values on device; aggregate with jnp and sync once per logging "
+    "interval outside the step function.",
+))
+register_rule(Rule(
+    "DT103", "PRNG key reused", "error", "ast",
+    "The same jax.random key is consumed by two or more random ops without "
+    "an intervening split: both draw identical randomness (correlated "
+    "dropout masks, identical init columns).",
+    "jax.random.split the key once per consumer: k1, k2 = jax.random.split(key).",
+))
+register_rule(Rule(
+    "DT104", "Python control flow on traced value", "warning", "ast",
+    "if/while on a parameter of a jit-traced function: tracing a Python "
+    "branch on a traced value raises TracerBoolConversionError, or silently "
+    "specializes on the traced-time value if it is static-adjacent.",
+    "Use lax.cond / lax.while_loop / jnp.where, or mark the argument "
+    "static_argnums if it is genuinely static.",
+))
+register_rule(Rule(
+    "DT105", "captured state mutated under jit", "error", "ast",
+    "Assignment to self.*/global/nonlocal state inside a jit-traced body: "
+    "the mutation happens once at trace time, then never again — cached "
+    "executions silently skip it.",
+    "Thread state functionally: take it as an argument, return the new "
+    "value (see how layer state/rnn_state are threaded in nn/).",
+))
+register_rule(Rule(
+    "DT106", "host side effect inside jit", "warning", "ast",
+    "print()/logging inside a jit-traced body runs at trace time only (and "
+    "prints tracers, not values); it vanishes from cached executions.",
+    "Use jax.debug.print / jax.debug.callback for runtime values, or move "
+    "logging outside the jitted function.",
+))

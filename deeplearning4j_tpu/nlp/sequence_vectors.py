@@ -389,11 +389,17 @@ class SequenceVectors:
 
     def _sync_tables(self):
         if hasattr(self, "_dev"):
-            self.lookup.syn0 = np.asarray(self._dev["syn0"])
+            # np.array (copy), NOT np.asarray: on the CPU backend asarray can
+            # return a zero-copy VIEW of the jax buffer, and these tables feed
+            # donate_argnums steps — once _dev is dropped the allocator
+            # recycles that memory for later donated computations, silently
+            # rewriting syn0 under us (caught by the c-binary roundtrip test
+            # going flaky under load).
+            self.lookup.syn0 = np.array(self._dev["syn0"])
             if self.use_hs:
-                self.lookup.syn1 = np.asarray(self._dev["syn1"])
+                self.lookup.syn1 = np.array(self._dev["syn1"])
             if self.negative > 0:
-                self.lookup.syn1neg = np.asarray(self._dev["syn1neg"])
+                self.lookup.syn1neg = np.array(self._dev["syn1neg"])
             del self._dev
 
     # --------------------------------------------------------------- queries

@@ -94,6 +94,15 @@ class ComputationGraphConfiguration:
             known[name] = self.vertices[name].get_output_type(*ins)
         return result
 
+    def analyze(self, **kw):
+        """Run the dl4jtpu-check graph pass over this DAG; returns a list of
+        :class:`~deeplearning4j_tpu.analysis.Finding` with per-vertex
+        diagnostics (empty = clean). See docs/static_analysis.md; keywords
+        forward to :func:`deeplearning4j_tpu.analysis.check_graph`."""
+        from ...analysis import check_graph  # local: analysis is optional at runtime
+
+        return check_graph(self, **kw)
+
     def output_types(self) -> List[InputType]:
         known: Dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))
         for name in self.topological_order():

@@ -71,6 +71,16 @@ class MultiLayerConfiguration:
         its = self.layer_input_types()
         return self.layers[-1].get_output_type(its[-1])
 
+    # ---- static analysis ----------------------------------------------------
+    def analyze(self, **kw):
+        """Run the dl4jtpu-check graph pass over this config; returns a list
+        of :class:`~deeplearning4j_tpu.analysis.Finding` (empty = clean).
+        See docs/static_analysis.md; keywords forward to
+        :func:`deeplearning4j_tpu.analysis.check_multi_layer`."""
+        from ...analysis import check_multi_layer  # local: analysis is optional at runtime
+
+        return check_multi_layer(self, **kw)
+
     # ---- JSON ---------------------------------------------------------------
     def to_dict(self) -> dict:
         return {

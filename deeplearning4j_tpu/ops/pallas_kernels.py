@@ -30,6 +30,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.annotations import jit_entry
+
 # gate/activation catalog usable inside kernels, with value-derivatives
 # (derivative expressed in terms of the *activated* value, so the backward
 # kernel needs no pre-activation residuals)
@@ -99,6 +101,7 @@ def _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate):
     return h, c, a, f, o, i, cact
 
 
+@jit_entry
 def _fwd_kernel(act, gate, zx_ref, h_ref, c_ref, rw_ref, pf_ref, pi_ref,
                 po_ref, h_out, c_out, a_out, f_out, o_out, i_out, cact_out):
     h, c, a, f, o, i, cact = _cell_math(
@@ -109,6 +112,7 @@ def _fwd_kernel(act, gate, zx_ref, h_ref, c_ref, rw_ref, pf_ref, pi_ref,
     a_out[:], f_out[:], o_out[:], i_out[:], cact_out[:] = a, f, o, i, cact
 
 
+@jit_entry
 def _bwd_kernel(dact, dgate, a_ref, f_ref, o_ref, i_ref, cact_ref, cprev_ref,
                 c_ref, hprev_ref, rw_ref, pf_ref, pi_ref, po_ref,
                 dh_ref, dc_ref,
@@ -235,6 +239,7 @@ def _window_sum_adjoint(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return acc
 
 
+@jit_entry
 def _lrn_fwd_kernel(k, n, alpha, beta, x_ref, y_ref, d_ref):
     x = x_ref[:]
     d = k + alpha * _window_sum(x * x, n)
@@ -242,6 +247,7 @@ def _lrn_fwd_kernel(k, n, alpha, beta, x_ref, y_ref, d_ref):
     y_ref[:] = x * d**-beta
 
 
+@jit_entry
 def _lrn_bwd_kernel(k, n, alpha, beta, x_ref, d_ref, g_ref, dx_ref):
     x, d, g = x_ref[:], d_ref[:], g_ref[:]
     # dx_c = g_c d_c^-b - 2ab x_c * Σ_{j: c∈W(j)} g_j x_j d_j^{-b-1}
@@ -350,6 +356,7 @@ def _seq_fits(B: int, H: int, itemsize: int) -> bool:
     return resident + streamed < _SEQ_VMEM_BUDGET_BYTES
 
 
+@jit_entry
 def _seq_fwd_kernel(act, gate,
                     zx_ref, h0_ref, c0_ref, rw_ref, pf_ref, pi_ref, po_ref,
                     y_out, a_out, f_out, o_out, i_out, c_out, hT_out, cT_out,
@@ -373,6 +380,7 @@ def _seq_fwd_kernel(act, gate,
     hT_out[:], cT_out[:] = h, c
 
 
+@jit_entry
 def _seq_bwd_kernel(act, dact, dgate, T,
                     dy_ref, dhT_ref, dcT_ref,
                     a_ref, f_ref, o_ref, i_ref, cprev_ref, hprev_ref,
@@ -444,10 +452,11 @@ def fused_lstm_sequence(zx, h0, c0, RW, pF, pI, pO,
                           act_name, gate_name)
 
 
+@jit_entry
 def _seq_lean_kernel(act, gate, masked, *refs):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
-    if masked:
+    if masked:  # static via partial — dl4jtpu: ignore[DT104]
         (zx_ref, m_ref, h0_ref, c0_ref, rw_ref, pf_ref, pi_ref, po_ref,
          y_out, hT_out, cT_out, h_scr, c_scr) = refs
     else:
@@ -463,7 +472,7 @@ def _seq_lean_kernel(act, gate, masked, *refs):
     h_prev, c_prev = h_scr[:], c_scr[:]
     h, c, *_ = _cell_math(zx_ref[0], h_prev, c_prev, rw_ref[:],
                           pf_ref[:], pi_ref[:], po_ref[:], act, gate)
-    if masked:
+    if masked:  # static via partial — dl4jtpu: ignore[DT104]
         m = m_ref[0]
         h = m * h + (1.0 - m) * h_prev
         c = m * c + (1.0 - m) * c_prev
@@ -640,6 +649,7 @@ fused_lstm_sequence.defvjp(_seq_fwd, _seq_bwd)
 # the same five tensors plus the [T, B, 1] mask.
 
 
+@jit_entry
 def _seq_fwd_kernel_masked(act, gate,
                            zx_ref, m_ref, h0_ref, c0_ref, rw_ref, pf_ref,
                            pi_ref, po_ref,
@@ -667,6 +677,7 @@ def _seq_fwd_kernel_masked(act, gate,
     hT_out[:], cT_out[:] = h, c
 
 
+@jit_entry
 def _seq_bwd_kernel_masked(act, dact, dgate, T,
                            dy_ref, dhT_ref, dcT_ref, m_ref,
                            a_ref, f_ref, o_ref, i_ref, cprev_ref,

@@ -44,7 +44,7 @@ def main(quick: bool = False):
         seed=1,
     )
     base = MultiLayerNetwork(conf).init()
-    base.fit(task(4, seed=0), epochs=25 if quick else 60)
+    base.fit(task(4, seed=0), epochs=40 if quick else 60)
     print("base task accuracy:", round(base.evaluate(task(4, seed=9)).accuracy(), 3))
 
     # freeze layers 0-1, replace the 4-way head with a 3-way head
@@ -56,7 +56,7 @@ def main(quick: bool = False):
         .build()
     )
     frozen_before = jax.tree_util.tree_map(np.asarray, new_net.params[0])
-    new_net.fit(task(3, seed=2), epochs=25 if quick else 60)
+    new_net.fit(task(3, seed=2), epochs=40 if quick else 60)
     frozen_after = jax.tree_util.tree_map(np.asarray, new_net.params[0])
     for a, b in zip(jax.tree_util.tree_leaves(frozen_before),
                     jax.tree_util.tree_leaves(frozen_after)):

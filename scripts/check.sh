@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Tier-1 verify flow: static analysis first (fails in seconds), then tests.
+#
+#   scripts/check.sh            # self-check + tier-1 tests
+#   scripts/check.sh --lint     # self-check only
+#
+# The self-check is also enforced inside the suite
+# (tests/test_analysis.py::TestSelfHosting), so a plain pytest run cannot
+# silently skip it.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dl4jtpu-check: analyzer self-check (deeplearning4j_tpu/ --fail-on error)"
+env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ --fail-on error
+
+if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests"
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc

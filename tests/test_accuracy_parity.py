@@ -62,7 +62,10 @@ class TestRealDataAccuracy:
             seed=5,
         )
         net = MultiLayerNetwork(conf).init()
-        net.fit(DigitsDataSetIterator(batch=128, train=True), epochs=12)
+        # 18 epochs: the 12-epoch budget sat right on the 0.95 pin and
+        # fractional numeric drift across jax/backend versions pushed it to
+        # 0.947; the longer run clears the pin with margin (0.964 here)
+        net.fit(DigitsDataSetIterator(batch=128, train=True), epochs=18)
         ev = net.evaluate(DigitsDataSetIterator(batch=120, train=False, shuffle=False))
         assert ev.accuracy() >= 0.95, ev.stats()
 

@@ -1,0 +1,377 @@
+"""dl4jtpu-check: every shipped rule id fires on a seeded violation and
+stays silent on the clean fixtures; the analyzer self-hosts on this repo.
+
+Fixture map (ISSUE 1 acceptance):
+- AST rules DT100-DT106: seeded source snippets below
+- graph rules DT001-DT007: seeded configs (lying get_output_type, dtype
+  drift, lane padding, variable timesteps, NCHW-looking input, float64,
+  missing loss head)
+- clean fixtures: a CNN MultiLayerConfiguration, an LSTM
+  ComputationGraphConfiguration, and a pitfall-free source file — all
+  must produce ZERO findings
+- the broken ComputationGraphConfiguration is caught with a
+  vertex-name diagnostic
+"""
+
+import json
+import os
+import textwrap
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    RULES,
+    check_graph,
+    check_multi_layer,
+    check_source,
+)
+from deeplearning4j_tpu.analysis.cli import main as cli_main
+from deeplearning4j_tpu.nn.conf.computation_graph import (
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+# --------------------------------------------------------------------------
+# seeded layers for the graph pass
+# --------------------------------------------------------------------------
+@dataclass
+class LyingDense(DenseLayer):
+    """Declares 7 more features than apply() produces (DT001 seed)."""
+
+    def get_output_type(self, it):
+        return InputType.feed_forward(self.n_out + 7)
+
+
+@dataclass
+class F64Leak(BaseLayer):
+    """Promotes its input to float64 (DT002 seed under x64)."""
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return x.astype(jnp.float64), state
+
+
+def _clean_cnn_mln():
+    return MultiLayerConfiguration(
+        layers=[
+            ConvolutionLayer(n_out=8, kernel=(3, 3), activation="relu"),
+            SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+            DenseLayer(n_out=128, activation="relu"),
+            OutputLayer(n_out=8, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.convolutional(8, 8, 1),
+        preprocessors={2: CnnToFeedForwardPreProcessor()},
+    )
+
+
+def _clean_lstm_graph():
+    return (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.recurrent(128, 12))
+        .add_layer("lstm", GravesLSTM(n_out=128, activation="tanh"), "in")
+        .add_layer("out", RnnOutputLayer(n_out=8, activation="softmax"), "lstm")
+        .set_outputs("out")
+        .build()
+    )
+
+
+class TestGraphRules:
+    def test_clean_mln_zero_findings(self):
+        assert check_multi_layer(_clean_cnn_mln()) == []
+
+    def test_clean_graph_zero_findings(self):
+        assert check_graph(_clean_lstm_graph()) == []
+
+    def test_dt001_broken_graph_vertex_diagnostic(self):
+        """ISSUE 1 acceptance: a deliberately broken ComputationGraphConf
+        (declared get_output_type disagreeing with jax.eval_shape) is caught
+        with a file:line-style vertex-name diagnostic."""
+        g = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(128))
+            .add_layer("liar", LyingDense(n_out=128, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=8, activation="softmax"), "liar")
+            .set_outputs("out")
+            .build()
+        )
+        findings = check_graph(g, source="nets/broken.json")
+        drift = [f for f in findings if f.rule_id == "DT001"]
+        assert drift, _ids(findings)
+        f = drift[0]
+        assert f.context == "vertex 'liar'"
+        assert f.location == "nets/broken.json:vertex 'liar'"
+        assert "(128,)" in f.message and "135" in f.message
+
+    def test_dt001_mln_layer_diagnostic(self):
+        conf = MultiLayerConfiguration(
+            layers=[LyingDense(n_out=128), OutputLayer(n_out=8, activation="softmax")],
+            input_type=InputType.feed_forward(128),
+        )
+        drift = [f for f in check_multi_layer(conf) if f.rule_id == "DT001"]
+        assert drift and "layer[0]" in drift[0].context
+
+    def test_dt002_dtype_drift(self):
+        conf = MultiLayerConfiguration(
+            layers=[F64Leak(), OutputLayer(n_out=8, n_in=128, activation="softmax")],
+            input_type=InputType.feed_forward(128),
+        )
+        assert "DT002" in _ids(check_multi_layer(conf))
+
+    def test_dt003_lane_padding_warning_and_info(self):
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=100),  # 100 >= 64, % 128 != 0 -> warning
+                    OutputLayer(n_out=12, activation="softmax")],  # 12 % 8 -> info
+            input_type=InputType.feed_forward(128),
+        )
+        pads = [f for f in check_multi_layer(conf) if f.rule_id == "DT003"]
+        assert {f.severity for f in pads} == {"warning", "info"}
+
+    def test_dt004_variable_timesteps(self):
+        g = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(128, None))
+            .add_layer("lstm", GravesLSTM(n_out=128), "in")
+            .add_layer("out", RnnOutputLayer(n_out=8, activation="softmax"), "lstm")
+            .set_outputs("out")
+            .build()
+        )
+        assert "DT004" in _ids(check_graph(g))
+
+    def test_dt005_nchw_suspect(self):
+        conf = MultiLayerConfiguration(
+            layers=[ConvolutionLayer(n_out=8, kernel=(1, 3), activation="relu"),
+                    OutputLayer(n_out=8, activation="softmax")],
+            input_type=InputType.convolutional(3, 224, 224),  # NCHW-looking
+            preprocessors={1: CnnToFeedForwardPreProcessor()},
+        )
+        assert "DT005" in _ids(check_multi_layer(conf))
+
+    def test_dt006_float64_dtype(self):
+        conf = _clean_cnn_mln()
+        conf.dtype = "float64"
+        assert "DT006" in _ids(check_multi_layer(conf))
+
+    def test_dt007_missing_loss_head(self):
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=128, activation="relu")],
+            input_type=InputType.feed_forward(128),
+        )
+        heads = [f for f in check_multi_layer(conf) if f.rule_id == "DT007"]
+        assert heads and heads[0].severity == "info"
+
+
+# --------------------------------------------------------------------------
+# AST pass
+# --------------------------------------------------------------------------
+_CLEAN_SRC = textwrap.dedent("""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(params, x):
+        y = jnp.sum(x * params["w"])
+        return jnp.where(y > 0, y, 0.0)
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (4,))
+        b = jax.random.normal(k2, (4,))
+        return a, b
+
+    def dispatch(scheme, key):
+        # mutually exclusive arms each consume once: NOT a reuse
+        if scheme == "normal":
+            return jax.random.normal(key, (4,))
+        if scheme == "uniform":
+            return jax.random.uniform(key, (4,))
+        raise ValueError(scheme)
+
+    def kernel(x_ref, o_ref, block: int, causal: bool):
+        if causal:   # static (annotated bool) -> no DT104
+            o_ref[:] = x_ref[:]
+""")
+
+_VIOLATIONS = {
+    "DT101": "import jax, numpy as np\n@jax.jit\ndef f(x):\n    return np.sum(x)\n",
+    "DT102": "import jax\n@jax.jit\ndef f(x):\n    return float(x.sum())\n",
+    "DT103": (
+        "import jax\ndef init(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a, b\n"
+    ),
+    "DT104": "import jax\n@jax.jit\ndef f(x):\n    if x > 0:\n        x = x + 1\n    return x\n",
+    "DT105": (
+        "import jax\nclass M:\n    def go(self):\n"
+        "        @jax.jit\n        def inner(x):\n"
+        "            self.cache = x\n            return x\n"
+        "        return inner\n"
+    ),
+    "DT106": "import jax\n@jax.jit\ndef f(x):\n    print(x)\n    return x\n",
+    "DT100": "def broken(:\n",
+}
+
+
+class TestAstRules:
+    def test_clean_source_zero_findings(self):
+        assert check_source(_CLEAN_SRC, "clean.py") == []
+
+    @pytest.mark.parametrize("rule_id", sorted(_VIOLATIONS))
+    def test_rule_fires(self, rule_id):
+        findings = check_source(_VIOLATIONS[rule_id], f"{rule_id}.py")
+        assert rule_id in _ids(findings), findings
+        for f in findings:
+            assert f.line > 0 and f.file == f"{rule_id}.py"
+
+    def test_every_shipped_ast_rule_has_a_fixture(self):
+        ast_rules = {r for r, rule in RULES.items() if rule.scope == "ast"}
+        assert ast_rules == set(_VIOLATIONS)
+
+    def test_every_shipped_graph_rule_has_a_fixture(self):
+        graph_rules = {r for r, rule in RULES.items() if rule.scope == "graph"}
+        assert graph_rules == {"DT001", "DT002", "DT003", "DT004", "DT005",
+                               "DT006", "DT007"}
+
+    def test_wrap_call_marks_jit_body(self):
+        src = (
+            "import jax, numpy as np\n"
+            "def step(x):\n"
+            "    return np.sum(x)\n"
+            "train = jax.jit(step, donate_argnums=(0,))\n"
+        )
+        assert "DT101" in _ids(check_source(src, "wrap.py"))
+
+    def test_pallas_call_partial_marks_kernel(self):
+        src = (
+            "import functools, numpy as np\n"
+            "from jax.experimental import pallas as pl\n"
+            "def kern(a, x_ref, o_ref):\n"
+            "    o_ref[:] = np.tanh(x_ref[:])\n"
+            "def run(x):\n"
+            "    return pl.pallas_call(functools.partial(kern, 1.0))(x)\n"
+        )
+        assert "DT101" in _ids(check_source(src, "pallas.py"))
+
+    def test_jit_entry_annotation_marks_body(self):
+        src = (
+            "import numpy as np\n"
+            "from deeplearning4j_tpu.analysis.annotations import jit_entry\n"
+            "@jit_entry\ndef kern(x_ref):\n    return np.abs(x_ref[:])\n"
+        )
+        assert "DT101" in _ids(check_source(src, "annot.py"))
+
+    def test_nested_function_inherits_jit_context(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\ndef outer(x):\n"
+            "    def helper(v):\n        return np.sqrt(v)\n"
+            "    return helper(x)\n"
+        )
+        assert "DT101" in _ids(check_source(src, "nested.py"))
+
+
+class TestPragmas:
+    def test_line_pragma_suppresses_named_rule(self):
+        src = (
+            "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+            "    return np.sum(x)  # dl4jtpu: ignore[DT101]\n"
+        )
+        assert check_source(src, "p.py") == []
+
+    def test_line_pragma_with_prose(self):
+        src = (
+            "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+            "    return np.sum(x)  # static shape math — dl4jtpu: ignore[DT101]\n"
+        )
+        assert check_source(src, "p.py") == []
+
+    def test_pragma_for_other_rule_keeps_finding(self):
+        src = (
+            "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+            "    return np.sum(x)  # dl4jtpu: ignore[DT106]\n"
+        )
+        assert "DT101" in _ids(check_source(src, "p.py"))
+
+    def test_bare_ignore_suppresses_everything_on_line(self):
+        src = (
+            "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+            "    return float(np.sum(x))  # dl4jtpu: ignore\n"
+        )
+        assert check_source(src, "p.py") == []
+
+    def test_skip_file(self):
+        src = "# dl4jtpu: skip-file\nimport jax, numpy as np\n@jax.jit\ndef f(x):\n    return np.sum(x)\n"
+        assert check_source(src, "p.py") == []
+
+
+# --------------------------------------------------------------------------
+# CLI + self-hosting
+# --------------------------------------------------------------------------
+class TestCli:
+    def test_fail_on_error_exit_codes(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(_VIOLATIONS["DT101"])
+        assert cli_main([str(bad)]) == 1
+        clean = tmp_path / "clean.py"
+        clean.write_text(_CLEAN_SRC)
+        assert cli_main([str(clean)]) == 0
+        capsys.readouterr()
+
+    def test_json_report(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(_VIOLATIONS["DT102"])
+        assert cli_main([str(bad), "--json", "--fail-on", "never"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["counts"]["error"] == 1
+        assert report["findings"][0]["rule_id"] == "DT102"
+
+    def test_json_config_analyzed(self, tmp_path, capsys):
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=128),
+                    RnnOutputLayer(n_out=8, activation="softmax")],
+            input_type=InputType.recurrent(128, None),  # DT004
+        )
+        p = tmp_path / "net.json"
+        p.write_text(conf.to_json())
+        assert cli_main([str(p), "--fail-on", "warning"]) == 1
+        assert cli_main([str(p), "--fail-on", "error"]) == 0
+        out = capsys.readouterr().out
+        assert "DT004" in out
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in RULES:
+            assert rule_id in out
+
+
+class TestSelfHosting:
+    def test_package_self_check_is_clean(self, capsys):
+        """ISSUE 1 acceptance: `python -m deeplearning4j_tpu.analysis
+        deeplearning4j_tpu/ --fail-on error` exits 0 on this repo."""
+        pkg = os.path.join(REPO, "deeplearning4j_tpu")
+        rc = cli_main([pkg, "--fail-on", "error"])
+        capsys.readouterr()
+        assert rc == 0

