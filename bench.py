@@ -58,6 +58,32 @@ def _enable_compilation_cache() -> None:
         pass
 
 
+def _telemetry_block(step_times_s, mfu_pct=None, extra_gauges=None) -> dict:
+    """Per-mode results routed through the telemetry registry, then emitted
+    as the machine-comparable "telemetry" block in the BENCH_* artifact:
+    the step-time histogram summary comes from a real registry Histogram
+    (same bucketing the /metrics endpoint scrapes), MFU from a Gauge —
+    so the perf trajectory and the live scrape speak one schema."""
+    from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hist = reg.histogram("bench_step_time_seconds",
+                         "per-step wall time of the timed runs")
+    for t in step_times_s:
+        hist.observe(float(t))
+    if mfu_pct is not None:
+        reg.gauge("bench_mfu_pct", "XLA-cost-analysis MFU").set(mfu_pct)
+    for name, value in (extra_gauges or {}).items():
+        reg.gauge(name).set(value)
+    snap = reg.snapshot()
+    block = {"step_time_seconds": snap["bench_step_time_seconds"]["values"][0]}
+    block["step_time_seconds"].pop("labels", None)
+    for name in snap:
+        if snap[name]["type"] == "gauge":
+            block[name] = snap[name]["values"][0]["value"]
+    return block
+
+
 def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     """ResNet-50 training throughput + step breakdown + XLA-reported MFU.
 
@@ -142,6 +168,9 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
             flops_per_step /= steps  # cost analysis counted the whole loop
         result["flops_per_step"] = flops_per_step
         result["mfu_pct"] = round(profiler.mfu(flops_per_step, step_s), 1)
+    result["telemetry"] = _telemetry_block(
+        [step_s], mfu_pct=result.get("mfu_pct"),
+        extra_gauges={"bench_images_per_sec": result["value"]})
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # optional deep dive: xplane trace of one scanned run
         with profiler.trace(trace_dir):
@@ -227,6 +256,9 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
             flops_per_step /= steps  # backend hides cost analysis: heuristic
         result["flops_per_step"] = flops_per_step
         result["mfu_pct"] = round(profiler.mfu(flops_per_step, step_s), 1)
+    result["telemetry"] = _telemetry_block(
+        [t / steps for t in times], mfu_pct=result.get("mfu_pct"),
+        extra_gauges={"bench_chars_per_sec": result["value"]})
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
         with profiler.trace(trace_dir):
@@ -293,11 +325,13 @@ def bench_word2vec(layer_size: int = 128, negative: int = 5,
                    seed=7)
     w2v.fit(sents)  # builds vocab + compiles the NEG kernel (warmup epoch)
     n_pairs = 0
+    n_calls = 0
     orig = w2v._device_step
 
     def counting(src, src_mask, tgt, lr):
-        nonlocal n_pairs
+        nonlocal n_pairs, n_calls
         n_pairs += len(tgt)
+        n_calls += 1
         return orig(src, src_mask, tgt, lr)
 
     w2v._device_step = counting
@@ -316,6 +350,11 @@ def bench_word2vec(layer_size: int = 128, negative: int = 5,
         "vocab_size": w2v.vocab.num_words(),
         "layer_size": layer_size,
         "negative": negative,
+        # mean device-kernel dispatch time stands in for step time here
+        "telemetry": _telemetry_block(
+            [dt / max(n_calls, 1)],
+            extra_gauges={"bench_words_per_sec": round(n_words / dt, 1),
+                          "bench_pairs_per_sec": round(n_pairs / dt, 1)}),
     }
 
 
@@ -378,6 +417,9 @@ def bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
         "shape": {"batch": batch, "heads": heads, "seq": seq, "dim": dim},
         "timed_steps": steps,
         "step_ms": round(1000 * dt_flash / steps, 3),
+        "telemetry": _telemetry_block(
+            [dt_flash / steps],
+            extra_gauges={"bench_tokens_per_sec": round(tokens / dt_flash, 1)}),
     }
 
 
@@ -411,6 +453,12 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
         rng.normal(size=(batch, 784)).astype(np.float32),
         np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)],
     )
+    from deeplearning4j_tpu.telemetry import MetricsRegistry, Telemetry
+
+    # full telemetry spine on the fallback too: the jitted step carries the
+    # device metrics vector, fetched ONCE after the timed loop (K=steps)
+    reg = MetricsRegistry()
+    net.set_telemetry(Telemetry(registry=reg, fetch_every=steps + warmup))
     net._train_step = net._build_train_step()
     for _ in range(warmup):
         net._fit_batch(ds)
@@ -420,11 +468,18 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
         net._fit_batch(ds)
     jax.block_until_ready(net.params)
     dt = time.perf_counter() - t0
-    return {
+    net.telemetry.flush()
+    grad_norm = reg.get("dl4jtpu_train_grad_norm")
+    result = {
         "metric": "mlp_mnist_train_samples_per_sec",
         "value": round(steps * batch / dt, 1),
         "unit": "samples/sec",
+        "telemetry": _telemetry_block(
+            [dt / steps],
+            extra_gauges={"bench_samples_per_sec": round(steps * batch / dt, 1),
+                          "bench_last_grad_norm": round(grad_norm.value, 6)}),
     }
+    return result
 
 
 def _load_baselines() -> dict:

@@ -95,6 +95,12 @@ from .optimize.listeners import (
     PerformanceListener,
 )
 from .utils.serialization import write_model, restore_model
+from .telemetry import (
+    MetricsRegistry,
+    Telemetry,
+    Watchdog,
+    get_registry,
+)
 
 __all__ = [
     "InputType",
@@ -172,4 +178,8 @@ __all__ = [
     "PerformanceListener",
     "write_model",
     "restore_model",
+    "MetricsRegistry",
+    "Telemetry",
+    "Watchdog",
+    "get_registry",
 ]

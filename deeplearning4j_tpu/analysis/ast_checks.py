@@ -13,7 +13,12 @@ Inside traced bodies the pass hunts np.* calls (DT101), host syncs
 (DT102), Python control flow on traced parameters (DT104), mutation of
 captured state (DT105) and print/logging side effects (DT106). PRNG key
 reuse (DT103) is checked in *every* function — reusing a key is wrong
-whether or not the call is traced.
+whether or not the call is traced. Two whole-scope dataflow rules run
+everywhere too: DT107 (a zero-copy ``np.asarray`` view taken before the
+viewed buffer crosses a ``donate_argnums`` boundary — donation recycles
+the buffer and rewrites the view) and DT108 (``lax.scan`` carry seeded
+with bare Python scalars, whose weak dtype can drift between carry-in
+and carry-out).
 """
 
 from __future__ import annotations
@@ -300,6 +305,176 @@ def _check_key_reuse(scope_body: List[ast.stmt], aliases: Set[str],
     return sim.findings
 
 
+def _is_zero_copy_view(call: ast.Call) -> bool:
+    """np.asarray(x) / np.array(x, copy=False): a (potential) zero-copy view."""
+    name = _full_name(call.func)
+    head, _, fn = name.rpartition(".")
+    if head not in ("np", "numpy") or not call.args:
+        return False
+    if fn == "asarray":
+        # an explicit dtype can force a copy only when it differs; stay
+        # conservative and treat dtype-less asarray as the view case
+        return not any(kw.arg == "copy" for kw in call.keywords)
+    if fn == "array":
+        return any(
+            kw.arg == "copy" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in call.keywords
+        )
+    return False
+
+
+def _donating_callables(tree: ast.AST) -> Set[str]:
+    """Names bound (anywhere in the file) to a jit with donate_argnums:
+    ``f = jax.jit(g, donate_argnums=...)`` assignments and functions
+    decorated ``@partial(jax.jit, donate_argnums=...)``."""
+
+    def _call_donates(call: ast.Call) -> bool:
+        head = _last(_full_name(call.func))
+        if head in ("jit", "pmap"):
+            return any(kw.arg == "donate_argnums" for kw in call.keywords)
+        if head == "partial" and call.args:
+            if _last(_full_name(call.args[0])) in ("jit", "pmap"):
+                return any(kw.arg == "donate_argnums" for kw in call.keywords)
+        return False
+
+    donating: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _call_donates(node.value):
+                for t in node.targets:
+                    name = _full_name(t)
+                    if name:
+                        donating.add(name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _call_donates(dec):
+                    donating.add(node.name)
+    return donating
+
+
+class _DonationAliasScan:
+    """DT107: one scope's statement-ordered dataflow. Tracks zero-copy view
+    sources; a later call of a donating callable on a viewed source means
+    the donated buffer may be recycled under the live numpy view."""
+
+    def __init__(self, donating: Set[str], filename: str):
+        self.donating = donating
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    def run(self, body: List[ast.stmt]) -> None:
+        views: Dict[str, int] = {}  # viewed source name -> view line
+        for stmt in body:
+            self._stmt(stmt, views)
+
+    def _stmt(self, stmt: ast.stmt, views: Dict[str, int]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes run their own pass
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _full_name(node.func)
+            if name in self.donating:
+                arg_names = [_full_name(a) for a in node.args] + [
+                    _full_name(kw.value) for kw in node.keywords
+                ]
+                for an in arg_names:
+                    if an and an in views:
+                        self.findings.append(get_rule("DT107").finding(
+                            f"'{an}' is donated here but a zero-copy view "
+                            f"of it was taken at line {views[an]}; donation "
+                            "recycles the buffer and silently rewrites the "
+                            "view",
+                            file=self.filename, line=node.lineno,
+                            col=node.col_offset, context=an,
+                        ))
+                        views.pop(an, None)  # one report per view
+        if isinstance(stmt, ast.Assign):
+            viewed = (
+                _full_name(stmt.value.args[0])
+                if isinstance(stmt.value, ast.Call)
+                and _is_zero_copy_view(stmt.value) and stmt.value.args
+                else ""
+            )
+            for t in stmt.targets:
+                tname = _full_name(t)
+                # rebinding a name breaks any alias recorded against it
+                views.pop(tname, None)
+            if viewed:
+                views[viewed] = stmt.lineno
+        # recurse into compound statements in order (approximate: branches
+        # merge by union — a view on any path stays suspect)
+        for attr in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, attr, []) or []:
+                self._stmt(sub, views)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for sub in handler.body:
+                self._stmt(sub, views)
+
+
+def _check_donation_aliasing(tree: ast.AST, index: "_Index",
+                             filename: str) -> List[Finding]:
+    donating = _donating_callables(tree)
+    if not donating:
+        return []
+    findings: List[Finding] = []
+    scan = _DonationAliasScan(donating, filename)
+    scan.run(tree.body)
+    for fn in index.functions:
+        scan.run(fn.body)
+    findings += scan.findings
+    return findings
+
+
+_SCAN_HEADS = ("lax", "jax.lax")
+
+
+def _bare_scalars(node: ast.AST):
+    """Bare numeric literals inside a carry-init expression — descends only
+    through tuple/list structure and unary minus, never into calls (a shape
+    literal in jnp.zeros((4, 8)) is not a carry component)."""
+    if isinstance(node, ast.Constant) and type(node.value) in (int, float):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _bare_scalars(elt)
+    elif isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                      (ast.USub, ast.UAdd)):
+        yield from _bare_scalars(node.operand)
+
+
+def _check_scan_carry(tree: ast.AST, filename: str) -> List[Finding]:
+    """DT108: lax.scan carry initialized from weakly-typed Python scalars."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _full_name(node.func)
+        head, _, fn = name.rpartition(".")
+        if fn != "scan" or (head not in _SCAN_HEADS
+                            and not head.endswith(".lax")):
+            continue
+        init = None
+        if len(node.args) >= 2:
+            init = node.args[1]
+        else:
+            init = next((kw.value for kw in node.keywords
+                         if kw.arg == "init"), None)
+        if init is None:
+            continue
+        for const in _bare_scalars(init):
+            findings.append(get_rule("DT108").finding(
+                f"lax.scan carry component seeded with bare Python scalar "
+                f"{const.value!r}: its weak dtype is set by the first loop "
+                "op and can differ from the carry-out dtype",
+                file=filename, line=const.lineno, col=const.col_offset,
+                context="scan carry",
+            ))
+    return findings
+
+
 def _test_uses_traced_param(test: ast.AST, params: Set[str]) -> Optional[str]:
     """A param referenced in a branch test, ignoring static uses
     (x.shape/x.ndim/..., isinstance(x, ...), x is None)."""
@@ -434,6 +609,9 @@ def check_source(source: str, filename: str = "<source>") -> List[Finding]:
     findings += _check_key_reuse(tree.body, index.random_aliases, filename)
     for fn in index.functions:
         findings += _check_key_reuse(fn.body, index.random_aliases, filename)
+    # whole-scope dataflow rules, traced or not
+    findings += _check_donation_aliasing(tree, index, filename)
+    findings += _check_scan_carry(tree, filename)
     # traced-body rules; nested jit functions are reached via their own
     # entry in jit_marked, so dedup on (rule, line, col)
     seen: Set[Tuple[str, int, int]] = set()

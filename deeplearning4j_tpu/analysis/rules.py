@@ -163,3 +163,23 @@ register_rule(Rule(
     "Use jax.debug.print / jax.debug.callback for runtime values, or move "
     "logging outside the jitted function.",
 ))
+register_rule(Rule(
+    "DT107", "zero-copy view crosses a donation boundary", "error", "ast",
+    "np.asarray()/np.array(..., copy=False) takes a zero-copy VIEW of a "
+    "device buffer that is later passed through a donate_argnums boundary: "
+    "donation lets the allocator recycle the buffer, silently rewriting the "
+    "numpy view's contents (the nlp _sync_tables bug class fixed in PR 1).",
+    "Materialize a real copy (np.array(x), no copy=False) before the "
+    "donating call, or take the view only after the LAST donating call on "
+    "that buffer.",
+))
+register_rule(Rule(
+    "DT108", "lax.scan carry seeded with weak Python scalar", "warning", "ast",
+    "A lax.scan carry component is initialized from a bare Python number: "
+    "weakly-typed scalars take their dtype from the first loop operation, "
+    "so the carry-out dtype can differ from the carry-in and scan fails "
+    "with a carry-shape/dtype mismatch (or silently upcasts every step). "
+    "The carry must be loop-invariant in shape AND dtype.",
+    "Seed carry components as typed arrays: jnp.zeros((), dtype=x.dtype) / "
+    "jnp.asarray(0.0, jnp.float32) instead of 0 / 0.0.",
+))

@@ -54,6 +54,8 @@ class ComputationGraph:
         self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
+        self.telemetry = None  # telemetry.Telemetry session (set_telemetry)
+        self._telemetry_step = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "ComputationGraph":
@@ -82,10 +84,18 @@ class ComputationGraph:
         self._rnn_state = None
         self._grad_stats_step = None
         self._multi_step_cache = None
+        self._telemetry_step = None
         return self
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
+
+    def set_telemetry(self, telemetry) -> "ComputationGraph":
+        """Attach a :class:`telemetry.Telemetry` session — see
+        MultiLayerNetwork.set_telemetry (same K-step-fetch contract)."""
+        self.telemetry = telemetry
+        self._telemetry_step = None
+        return self
 
     def _wants_grad_stats(self) -> bool:
         """See MultiLayerNetwork._wants_grad_stats — instrumented step only on
@@ -232,9 +242,11 @@ class ComputationGraph:
         return val
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self, with_grad_stats: bool = False):
+    def _build_train_step(self, with_grad_stats: bool = False,
+                          with_telemetry: bool = False):
         """Jitted step; ``with_grad_stats`` also returns gradient/update
-        pytrees for StatsListener histograms (see MultiLayerNetwork note)."""
+        pytrees for StatsListener histograms, ``with_telemetry`` only the
+        in-step-reduced metrics vector (see MultiLayerNetwork note)."""
         tx = self._tx
 
         def step(params, opt_state, state, inputs, labels, rng, labels_masks, masks):
@@ -249,13 +261,19 @@ class ComputationGraph:
             new_params = optax.apply_updates(params, updates)
             if with_grad_stats:
                 return new_params, new_opt, new_state, loss, grads, updates
+            if with_telemetry:
+                from ...telemetry import device as _tdev  # noqa: PLC0415
+
+                return (new_params, new_opt, new_state, loss,
+                        _tdev.step_stats(loss, grads))
             return new_params, new_opt, new_state, loss
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------- on-device multi-step
-    def _build_multi_step(self, num_steps: int, num_batches: int):
+    def _build_multi_step(self, num_steps: int, num_batches: int,
+                          with_telemetry: bool = False):
         """ONE device dispatch for ``num_steps`` steps — lax.scan over batches
         staged in HBM (each input/label stacked ``[K, B, ...]``, step i uses
         batch ``i % K``). See MultiLayerNetwork._build_multi_step: same RNG
@@ -286,12 +304,20 @@ class ComputationGraph:
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt, params)
                 new_params = optax.apply_updates(params, updates)
+                if with_telemetry:
+                    from ...telemetry import device as _tdev  # noqa: PLC0415
+
+                    return ((new_params, new_opt, new_state, rng),
+                            (loss, _tdev.step_stats(loss, grads)))
                 return (new_params, new_opt, new_state, rng), loss
 
-            (params, opt_state, state, rng), losses = jax.lax.scan(
+            (params, opt_state, state, rng), out = jax.lax.scan(
                 body, (params, opt_state, state, rng), jnp.arange(num_steps)
             )
-            return params, opt_state, state, rng, losses
+            if with_telemetry:
+                losses, mvecs = out
+                return params, opt_state, state, rng, losses, mvecs
+            return params, opt_state, state, rng, out
 
         donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
@@ -325,19 +351,30 @@ class ComputationGraph:
                     f"{int(arr.shape[0])} batches, expected {num_batches}"
                 )
         n_steps = int(steps) if steps is not None else num_batches
+        tel = self.telemetry
         if self._multi_step_cache is None:
             self._multi_step_cache = {}
-        cache_key = (n_steps, num_batches)
+        cache_key = (n_steps, num_batches, tel is not None)
         fn = self._multi_step_cache.get(cache_key)
         if fn is None:
-            fn = self._build_multi_step(n_steps, num_batches)
+            fn = self._build_multi_step(n_steps, num_batches,
+                                        with_telemetry=tel is not None)
             self._multi_step_cache[cache_key] = fn
         t0 = time.perf_counter()
-        (self.params, self.opt_state, self.state, self._rng, losses) = fn(
+        out = fn(
             self.params, self.opt_state, self.state, self._rng, xs_list, ys_list
         )
+        mvecs = None
+        if tel is not None:
+            (self.params, self.opt_state, self.state, self._rng,
+             losses, mvecs) = out
+        else:
+            self.params, self.opt_state, self.state, self._rng, losses = out
         losses = np.asarray(losses)  # host fetch = the sync point
         elapsed = time.perf_counter() - t0
+        if tel is not None:
+            tel.on_staged(self.iteration + 1, mvecs,
+                          per_step_time_s=elapsed / max(len(losses), 1))
         self.last_batch_size = int(xs_list[0].shape[1])
         # see MultiLayerNetwork.fit_on_device: even per-step attribution for
         # throughput listeners during the tight replay loop
@@ -393,6 +430,8 @@ class ComputationGraph:
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self, self.epoch)
+        if self.telemetry is not None:
+            self.telemetry.flush()  # drain a partial K-window at fit end
         return self
 
     @staticmethod
@@ -484,6 +523,8 @@ class ComputationGraph:
         lmasks = mds.labels_masks
         if lmasks is not None and all(m is None for m in lmasks):
             lmasks = None
+        tel = self.telemetry
+        mvec = None
         if self._wants_grad_stats():
             if self._grad_stats_step is None:
                 self._grad_stats_step = self._build_train_step(with_grad_stats=True)
@@ -492,6 +533,19 @@ class ComputationGraph:
                 self.params, self.opt_state, self.state,
                 list(mds.features), list(mds.labels), step_key, lmasks, masks,
             )
+            if tel is not None:
+                from ...telemetry import device as _tdev  # noqa: PLC0415
+
+                mvec = _tdev.step_stats(loss, self._last_grads)
+        elif tel is not None:
+            if self._telemetry_step is None:
+                self._telemetry_step = self._build_train_step(with_telemetry=True)
+            (self.params, self.opt_state, self.state, loss, mvec) = \
+                self._telemetry_step(
+                    self.params, self.opt_state, self.state,
+                    list(mds.features), list(mds.labels), step_key, lmasks,
+                    masks,
+                )
         else:
             self.params, self.opt_state, self.state, loss = self._train_step(
                 self.params, self.opt_state, self.state,
@@ -499,6 +553,8 @@ class ComputationGraph:
             )
         self._last_loss = loss
         self.iteration += 1
+        if tel is not None and mvec is not None:
+            tel.on_step(self.iteration, mvec)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
         # listeners have copied what they need; free the grad/update buffers

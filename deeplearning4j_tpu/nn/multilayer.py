@@ -122,6 +122,8 @@ class MultiLayerNetwork:
         self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
+        self.telemetry = None  # telemetry.Telemetry session (set_telemetry)
+        self._telemetry_step = None
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "MultiLayerNetwork":
@@ -151,6 +153,7 @@ class MultiLayerNetwork:
         self._rnn_step_fn = None
         self._grad_stats_step = None
         self._multi_step_cache = None
+        self._telemetry_step = None
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -158,6 +161,18 @@ class MultiLayerNetwork:
 
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
+
+    def set_telemetry(self, telemetry) -> "MultiLayerNetwork":
+        """Attach a :class:`telemetry.Telemetry` session to the fit paths.
+
+        With a session attached the jitted step additionally returns the
+        device-side metrics vector (loss, grad norm, non-finite flag —
+        telemetry.device.step_stats); the session fetches it every K steps,
+        so instrumentation adds zero per-step host syncs. Pass None to
+        detach."""
+        self.telemetry = telemetry
+        self._telemetry_step = None  # force rebuild with/without the vector
+        return self
 
     def _wants_grad_stats(self) -> bool:
         """True when some listener will consume gradient/update stats on the
@@ -275,12 +290,16 @@ class MultiLayerNetwork:
         return val
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self, with_grad_stats: bool = False):
+    def _build_train_step(self, with_grad_stats: bool = False,
+                          with_telemetry: bool = False):
         """Jitted step. ``with_grad_stats`` additionally returns the gradient
         and update pytrees so StatsListener can histogram them (reference:
         BaseStatsListener.java:419-437 collects parameters, gradients AND
         per-iteration updates). Kept off the default path: returning them
-        defeats buffer reuse XLA would otherwise apply."""
+        defeats buffer reuse XLA would otherwise apply. ``with_telemetry``
+        returns only the small device-side metrics vector instead
+        (telemetry.device.step_stats) — the grad norm is reduced INSIDE the
+        step, so the full gradient pytree never leaves the program."""
         tx = self._tx
 
         def step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
@@ -295,6 +314,11 @@ class MultiLayerNetwork:
             new_params = optax.apply_updates(params, updates)
             if with_grad_stats:
                 return new_params, new_opt, new_state, loss, grads, updates
+            if with_telemetry:
+                from ..telemetry import device as _tdev  # noqa: PLC0415
+
+                return (new_params, new_opt, new_state, loss,
+                        _tdev.step_stats(loss, grads))
             return new_params, new_opt, new_state, loss
 
         donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
@@ -302,7 +326,8 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------- on-device multi-step
     def _build_multi_step(self, num_steps: int, num_batches: int,
-                          with_masks: bool = False):
+                          with_masks: bool = False,
+                          with_telemetry: bool = False):
         """ONE device dispatch for ``num_steps`` optimizer steps: lax.scan of
         the train step over batches staged in HBM (stacked ``[K, B, ...]``),
         cycling ``i % K``.
@@ -339,12 +364,22 @@ class MultiLayerNetwork:
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt, params)
                 new_params = optax.apply_updates(params, updates)
+                if with_telemetry:
+                    from ..telemetry import device as _tdev  # noqa: PLC0415
+
+                    # per-step metrics vector stacked by the scan — the host
+                    # fetches [steps, NUM_SLOTS] once, after the dispatch
+                    return ((new_params, new_opt, new_state, rng),
+                            (loss, _tdev.step_stats(loss, grads)))
                 return (new_params, new_opt, new_state, rng), loss
 
-            (params, opt_state, state, rng), losses = jax.lax.scan(
+            (params, opt_state, state, rng), out = jax.lax.scan(
                 body, (params, opt_state, state, rng), jnp.arange(num_steps)
             )
-            return params, opt_state, state, rng, losses
+            if with_telemetry:
+                losses, mvecs = out
+                return params, opt_state, state, rng, losses, mvecs
+            return params, opt_state, state, rng, out
 
         donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
@@ -372,22 +407,36 @@ class MultiLayerNetwork:
                                            ("labels_masks", labels_masks)))
         n_steps = int(steps) if steps is not None else num_batches
         with_masks = features_masks is not None or labels_masks is not None
+        tel = self.telemetry
         cache_key = (n_steps, num_batches,
-                     features_masks is not None, labels_masks is not None)
+                     features_masks is not None, labels_masks is not None,
+                     tel is not None)
         if getattr(self, "_multi_step_cache", None) is None:
             self._multi_step_cache = {}
         fn = self._multi_step_cache.get(cache_key)
         if fn is None:
-            fn = self._build_multi_step(n_steps, num_batches, with_masks)
+            fn = self._build_multi_step(n_steps, num_batches, with_masks,
+                                        with_telemetry=tel is not None)
             self._multi_step_cache[cache_key] = fn
         t0 = time.perf_counter()
-        (self.params, self.opt_state, self.state, self._rng, losses) = fn(
+        out = fn(
             self.params, self.opt_state, self.state, self._rng, xs, ys,
             None if features_masks is None else jnp.asarray(features_masks),
             None if labels_masks is None else jnp.asarray(labels_masks),
         )
+        mvecs = None
+        if tel is not None:
+            (self.params, self.opt_state, self.state, self._rng,
+             losses, mvecs) = out
+        else:
+            self.params, self.opt_state, self.state, self._rng, losses = out
         losses = np.asarray(losses)  # host fetch = the sync point
         elapsed = time.perf_counter() - t0
+        if tel is not None:
+            # the scan stacked per-step metrics; ONE more (already-computed)
+            # fetch records the whole window — never a per-step sync
+            tel.on_staged(self.iteration + 1, mvecs,
+                          per_step_time_s=elapsed / max(len(losses), 1))
         self.last_batch_size = int(xs.shape[1])
         # replayed callbacks arrive in a tight host loop; wall-clock deltas
         # between them measure nothing, so publish the dispatch's even
@@ -452,6 +501,8 @@ class MultiLayerNetwork:
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self, self.epoch)
+        if self.telemetry is not None:
+            self.telemetry.flush()  # drain a partial K-window at fit end
         return self
 
     @staticmethod
@@ -517,6 +568,8 @@ class MultiLayerNetwork:
             self._fit_tbptt(ds)
             return
         self._rng, step_key = jax.random.split(self._rng)
+        tel = self.telemetry
+        mvec = None
         if self._wants_grad_stats():
             if self._grad_stats_step is None:
                 self._grad_stats_step = self._build_train_step(with_grad_stats=True)
@@ -526,6 +579,22 @@ class MultiLayerNetwork:
                 step_key,
                 getattr(ds, "labels_mask", None), getattr(ds, "features_mask", None),
             )
+            if tel is not None:
+                # grads already left the program for StatsListener; reduce
+                # them eagerly (async dispatch, still no host sync)
+                from ..telemetry import device as _tdev  # noqa: PLC0415
+
+                mvec = _tdev.step_stats(loss, self._last_grads)
+        elif tel is not None:
+            if self._telemetry_step is None:
+                self._telemetry_step = self._build_train_step(with_telemetry=True)
+            (self.params, self.opt_state, self.state, loss, mvec) = \
+                self._telemetry_step(
+                    self.params, self.opt_state, self.state, ds.features,
+                    ds.labels, step_key,
+                    getattr(ds, "labels_mask", None),
+                    getattr(ds, "features_mask", None),
+                )
         else:
             self.params, self.opt_state, self.state, loss = self._train_step(
                 self.params, self.opt_state, self.state, ds.features, ds.labels,
@@ -534,6 +603,8 @@ class MultiLayerNetwork:
             )
         self._last_loss = loss
         self.iteration += 1
+        if tel is not None and mvec is not None:
+            tel.on_step(self.iteration, mvec)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss)
         # listeners have copied what they need; don't pin ~2x model size of
@@ -633,6 +704,12 @@ class MultiLayerNetwork:
             )
             self._last_loss = loss
             self.iteration += 1
+            if self.telemetry is not None:
+                # TBPTT's step returns no gradient view; record loss +
+                # finiteness (grad norm reads 0 on this path)
+                from ..telemetry import device as _tdev  # noqa: PLC0415
+
+                self.telemetry.on_step(self.iteration, _tdev.step_stats(loss))
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration, loss)
 

@@ -48,16 +48,31 @@ class TrainingListener(IterationListener):
 
 
 class ScoreIterationListener(TrainingListener):
-    """Log score every N iterations (reference: ScoreIterationListener)."""
+    """Log score every N iterations (reference: ScoreIterationListener).
+
+    Scores land in the telemetry registry (``dl4jtpu_score`` gauge +
+    ``dl4jtpu_score_reports_total`` counter) rather than listener-private
+    state, so the logged number is also the scraped number. The ``float()``
+    host sync stays on the print cadence, exactly as before."""
 
     supports_staged = True  # consumes only (iteration, score)
 
-    def __init__(self, print_every: int = 10):
+    def __init__(self, print_every: int = 10, registry=None):
+        from ..telemetry import get_registry  # noqa: PLC0415
+
         self.print_every = max(1, print_every)
+        reg = registry if registry is not None else get_registry()
+        self._score_gauge = reg.gauge(
+            "dl4jtpu_score", "last reported training score")
+        self._reports = reg.counter(
+            "dl4jtpu_score_reports_total", "score reports emitted")
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.print_every == 0:
-            logger.info("Score at iteration %d is %s", iteration, float(score))
+            value = float(score)
+            self._score_gauge.set(value)
+            self._reports.inc()
+            logger.info("Score at iteration %d is %s", iteration, value)
 
 
 class CollectScoresIterationListener(TrainingListener):
@@ -90,13 +105,23 @@ class PerformanceListener(TrainingListener):
     #                           first dispatch of a program includes its JIT
     #                           compile, same as any cold-start interval.
 
-    def __init__(self, frequency: int = 1, report_score: bool = False):
+    def __init__(self, frequency: int = 1, report_score: bool = False,
+                 registry=None):
+        from ..telemetry import get_registry  # noqa: PLC0415
+
         self.frequency = max(1, frequency)
         self.report_score = report_score
         self._last_time: Optional[float] = None
         self._last_iter = 0
         self._accum = 0.0  # time attributed to steps since the last record
         self.history: List[dict] = []
+        reg = registry if registry is not None else get_registry()
+        self._batches_gauge = reg.gauge(
+            "dl4jtpu_throughput_batches_per_sec",
+            "training throughput over the last report window")
+        self._samples_gauge = reg.gauge(
+            "dl4jtpu_throughput_samples_per_sec",
+            "training sample throughput over the last report window")
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
@@ -121,6 +146,10 @@ class PerformanceListener(TrainingListener):
                 )
             if self.report_score:
                 rec["score"] = float(score)
+            if dt > 0:  # scraped gauges mirror the appended record
+                self._batches_gauge.set(rec["batches_per_sec"])
+                if "samples_per_sec" in rec:
+                    self._samples_gauge.set(rec["samples_per_sec"])
             self.history.append(rec)
             logger.info("perf: %s", rec)
         self._last_iter = iteration

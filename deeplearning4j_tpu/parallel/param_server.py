@@ -33,7 +33,22 @@ class ParameterServer:
 
     def __init__(self, initial_params: np.ndarray, learning_rate: float = 0.01,
                  host: str = "127.0.0.1", port: int = 0,
-                 max_frame_bytes: Optional[int] = None):
+                 max_frame_bytes: Optional[int] = None, registry=None):
+        from ..telemetry import get_registry  # noqa: PLC0415
+
+        reg = registry if registry is not None else get_registry()
+        self._m_pushes = reg.counter(
+            "dl4jtpu_param_server_pushes_total",
+            "gradient pushes applied by the parameter server")
+        self._m_pulls = reg.counter(
+            "dl4jtpu_param_server_pulls_total",
+            "parameter snapshot pulls served")
+        self._m_rejects = reg.counter(
+            "dl4jtpu_param_server_rejected_pushes_total",
+            "gradient pushes rejected (shape mismatch)")
+        self._m_updates = reg.gauge(
+            "dl4jtpu_param_server_updates",
+            "total SGD updates applied to the server's parameter vector")
         self._params = np.ascontiguousarray(initial_params, np.float32).copy()
         # Frame cap (DoS guard) sized to the model: a legit gradient is exactly
         # params-sized, so default to that (+slack) rather than the global cap,
@@ -106,13 +121,17 @@ class ParameterServer:
                     with self._lock:
                         if grad.shape != self._params.shape:
                             conn.sendall(b"E")
+                            self._m_rejects.inc()
                             continue
                         self._params -= self.learning_rate * grad
                         self._updates += 1
+                        self._m_updates.set(self._updates)
+                    self._m_pushes.inc()
                     conn.sendall(b"A")  # ack
                 elif op == b"P":
                     with self._lock:
                         snapshot = self._params.copy()
+                    self._m_pulls.inc()
                     _send_array(conn, snapshot)
                 else:
                     return

@@ -139,11 +139,14 @@ class ParallelWrapper:
         self._sync_ready = False
         # Shared instrumentation path (profiler.StepTimer): the same
         # data/step/average phases feed the TrainingMaster's phase stats, the
-        # StatsListener records (UI system page), and the bench breakdown —
+        # StatsListener records (UI system page), the bench breakdown AND the
+        # telemetry registry (dl4jtpu_phase_seconds at /metrics) —
         # reference: ParameterAveragingTrainingWorkerStats per-phase events.
         from ..profiler import StepTimer  # noqa: PLC0415
+        from ..telemetry import get_registry  # noqa: PLC0415
 
-        self.timer = StepTimer()
+        self.timer = StepTimer(registry=get_registry(),
+                               component="parallel_wrapper")
         net._phase_timer = self.timer
 
     # ------------------------------------------------------------- sync mode
@@ -186,13 +189,28 @@ class ParallelWrapper:
             fm_ = getattr(global_ds, "features_mask", None)
             lm = None if lm_ is None else put(np.asarray(lm_), shard)
             fm = None if fm_ is None else put(np.asarray(fm_), shard)
+        tel = getattr(net, "telemetry", None)
         with self.timer.phase("step"):
-            net.params, net.opt_state, net.state, loss = net._train_step(
-                net.params, net.opt_state, net.state, x, y, step_key, lm, fm
-            )
+            if tel is not None:
+                # telemetry-instrumented SPMD step: the metrics vector is
+                # reduced on-mesh (grad-norm psums ride ICI with the grads)
+                if net._telemetry_step is None:
+                    net._telemetry_step = net._build_train_step(
+                        with_telemetry=True)
+                (net.params, net.opt_state, net.state, loss, mvec) = \
+                    net._telemetry_step(
+                        net.params, net.opt_state, net.state, x, y, step_key,
+                        lm, fm,
+                    )
+            else:
+                net.params, net.opt_state, net.state, loss = net._train_step(
+                    net.params, net.opt_state, net.state, x, y, step_key, lm, fm
+                )
         net._last_loss = loss
         net.iteration += 1
         self.iteration += 1
+        if tel is not None:
+            tel.on_step(net.iteration, mvec)
         for lst in net.listeners:
             lst.iteration_done(net, net.iteration, loss)
 
@@ -471,6 +489,8 @@ class ParallelWrapper:
             # report this wrapper's frozen breakdown as the new run's timings.
             if getattr(self.net, "_phase_timer", None) is self.timer:
                 self.net._phase_timer = None
+            if getattr(self.net, "telemetry", None) is not None:
+                self.net.telemetry.flush()  # drain a partial K-window
         return self
 
     def _fit_epochs(self, data, epochs: int, sync: bool) -> None:

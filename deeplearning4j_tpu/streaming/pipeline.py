@@ -143,7 +143,9 @@ class StreamingPipeline:
     """
 
     def __init__(self, source: RecordSource, routes: Sequence[Route],
-                 batch: int = 32, linger: float = 0.5):
+                 batch: int = 32, linger: float = 0.5, registry=None):
+        from ..telemetry import get_registry  # noqa: PLC0415
+
         self.source = source
         self.routes = list(routes)
         self.batch = int(batch)
@@ -151,6 +153,20 @@ class StreamingPipeline:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        reg = registry if registry is not None else get_registry()
+        self._m_records = reg.counter(
+            "dl4jtpu_streaming_records_total",
+            "records consumed from the source")
+        self._m_batches = reg.counter(
+            "dl4jtpu_streaming_batches_total",
+            "micro-batches delivered to routes")
+        self._m_batch_size = reg.histogram(
+            "dl4jtpu_streaming_batch_size",
+            "assembled micro-batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self._m_errors = reg.counter(
+            "dl4jtpu_streaming_pump_failures_total",
+            "pump-thread deaths from a route/source error")
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "StreamingPipeline":
@@ -205,6 +221,7 @@ class StreamingPipeline:
             if buf:
                 self._flush(buf)
         except BaseException as e:  # surfaced on stop()/raise_if_failed()
+            self._m_errors.inc()
             self._error = e
 
     def _flush(self, buf) -> None:
@@ -214,3 +231,6 @@ class StreamingPipeline:
             labels = np.stack([l for _, l in buf])
         for route in self.routes:
             route.on_batch(feats, labels)
+        self._m_records.inc(len(buf))
+        self._m_batches.inc()
+        self._m_batch_size.observe(len(buf))

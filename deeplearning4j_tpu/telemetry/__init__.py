@@ -1,0 +1,54 @@
+"""Unified metrics/span/watchdog spine for training and serving.
+
+The reference DL4J observed training through three disconnected mechanisms —
+PerformanceListener samples/sec, Spark per-phase stats, StatsListener memory
+sections (SURVEY.md §5.1). This package is the single instrumentation path
+that replaces all of them, TPU-honest by construction (no per-step host
+syncs; see docs/observability.md):
+
+- :mod:`registry` — process-wide counters/gauges/histograms with Prometheus
+  text exposition (``GET /metrics`` on the UI server) and JSON snapshots.
+- :mod:`spans` — host spans exporting Chrome/Perfetto trace JSON, wrapped in
+  ``jax.profiler.TraceAnnotation`` so they align with XLA slices.
+- :mod:`device` — the per-step jnp metrics vector computed inside the jitted
+  step (loss, grad norm, non-finite flag).
+- :mod:`session` — :class:`Telemetry`, the K-step-fetch glue the fit paths
+  call.
+- :mod:`watchdog` — structured anomaly events (nan-loss,
+  exploding-grad-norm, stalled-step-time) with pluggable sinks.
+"""
+
+from .registry import (
+    DEFAULT_BUCKETS,
+    MetricFamily,
+    MetricsRegistry,
+    get_registry,
+)
+from .session import Telemetry
+from .spans import Span, SpanRecorder, get_recorder, span
+from .watchdog import (
+    EXPLODING_GRAD_NORM,
+    NAN_LOSS,
+    STALLED_STEP_TIME,
+    AnomalyEvent,
+    Watchdog,
+    logging_sink,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "MetricFamily",
+    "MetricsRegistry",
+    "get_registry",
+    "Telemetry",
+    "Span",
+    "SpanRecorder",
+    "get_recorder",
+    "span",
+    "AnomalyEvent",
+    "Watchdog",
+    "logging_sink",
+    "NAN_LOSS",
+    "EXPLODING_GRAD_NORM",
+    "STALLED_STEP_TIME",
+]

@@ -1,0 +1,146 @@
+"""Telemetry session: the glue between jitted steps and the registry.
+
+One :class:`Telemetry` object rides a training run. The fit paths hand it
+the device-side metrics vector each step (``on_step``) or the whole stacked
+``[steps, NUM_SLOTS]`` array of a staged dispatch (``on_staged``); it fetches
+to host at most once every ``fetch_every`` steps (ONE ``np.asarray`` of the
+stacked pending vectors), records into the registry, and feeds the watchdog.
+
+The overhead contract, explicit because it is the whole point:
+
+- ``on_step`` appends a device scalar vector and bumps host-side counters —
+  no device read, no sync. The step's async dispatch pipeline is untouched.
+- A fetch happens when K vectors are pending (or at ``flush()``, which the
+  fit loops call once at the end of training). ``fetch_count`` is public so
+  tests can assert the ceil(steps/K) bound.
+- ``on_staged`` is one fetch for the whole dispatch regardless of K: the
+  scan already materialized per-step rows, and the losses fetch that
+  precedes it has already paid the sync.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import device as device_stats
+from .registry import MetricsRegistry, get_registry
+from .watchdog import Watchdog
+
+
+class Telemetry:
+    """Per-run recorder: K-step device fetch -> registry + watchdog."""
+
+    # staticmethod indirection so tests can count host fetches
+    _fetch = staticmethod(np.asarray)
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        fetch_every: int = 10,
+        watchdog: Optional[Watchdog] = None,
+        prefix: str = "dl4jtpu_train",
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.fetch_every = max(1, int(fetch_every))
+        self.watchdog = watchdog
+        self.fetch_count = 0
+        self._pending: List[Tuple[int, object, Optional[float]]] = []
+        self._last_step_t: Optional[float] = None
+        r = self.registry
+        self.steps = r.counter(f"{prefix}_steps_total",
+                               "optimizer steps dispatched")
+        self.loss_gauge = r.gauge(f"{prefix}_loss",
+                                  "last fetched training loss")
+        self.grad_norm_gauge = r.gauge(f"{prefix}_grad_norm",
+                                       "last fetched global gradient norm")
+        self.grad_norm_hist = r.histogram(
+            f"{prefix}_grad_norm_hist", "fetched global gradient norms",
+            buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 50.0,
+                     100.0, 1000.0),
+        )
+        self.step_time_hist = r.histogram(
+            f"{prefix}_step_time_seconds",
+            "per-step wall time (staged dispatches attribute the dispatch "
+            "evenly across its steps)",
+        )
+        self.nonfinite_steps = r.counter(
+            f"{prefix}_nonfinite_steps_total",
+            "steps whose loss/gradients contained NaN/Inf")
+        self.fetches = r.counter(
+            f"{prefix}_fetches_total",
+            "host fetches of device-side step metrics")
+
+    # ------------------------------------------------------------- per-step
+    def on_step(self, iteration: int, mvec,
+                step_time_s: Optional[float] = None) -> None:
+        """Record one step's DEVICE metrics vector; fetch only at K pending.
+
+        When no explicit ``step_time_s`` is given, the wall-clock delta
+        since the previous ``on_step`` stands in — under async dispatch the
+        queue's backpressure makes the steady-state inter-dispatch interval
+        the honest per-step time (PerformanceListener's convention); the
+        first step of a run has no interval and records none.
+        """
+        import time  # noqa: PLC0415
+
+        now = time.perf_counter()
+        if step_time_s is None and self._last_step_t is not None:
+            step_time_s = now - self._last_step_t
+        self._last_step_t = now
+        self.steps.inc()
+        if step_time_s is not None:
+            self.step_time_hist.observe(step_time_s)
+        self._pending.append((int(iteration), mvec, step_time_s))
+        if len(self._pending) >= self.fetch_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Fetch all pending vectors in ONE host sync and record them."""
+        if not self._pending:
+            return
+        import jax.numpy as jnp  # noqa: PLC0415 - keep module import light
+
+        pending, self._pending = self._pending, []
+        rows = self._fetch(jnp.stack([m for _, m, _ in pending]))
+        self.fetch_count += 1
+        self.fetches.inc()
+        for (iteration, _, step_time_s), row in zip(pending, rows):
+            self._record_row(iteration, row, step_time_s)
+
+    # -------------------------------------------------------------- staged
+    def on_staged(self, first_iteration: int, mvecs,
+                  per_step_time_s: Optional[float] = None) -> None:
+        """Record a staged dispatch's ``[steps, NUM_SLOTS]`` metrics.
+
+        One fetch for the whole window; ``per_step_time_s`` is the even
+        per-step share of the dispatch wall time (callback wall-clock deltas
+        measure nothing during the post-scan replay — same convention as
+        ``fit_on_device``'s ``staged_step_time``).
+        """
+        rows = self._fetch(mvecs)
+        self.fetch_count += 1
+        self.fetches.inc()
+        self.steps.inc(len(rows))
+        self._last_step_t = None  # wall deltas across a staged window lie
+        for j, row in enumerate(rows):
+            if per_step_time_s is not None:
+                self.step_time_hist.observe(per_step_time_s)
+            self._record_row(first_iteration + j, row, per_step_time_s)
+
+    # ------------------------------------------------------------- shared
+    def _record_row(self, iteration: int, row,
+                    step_time_s: Optional[float]) -> None:
+        loss = float(row[device_stats.LOSS])
+        gnorm = float(row[device_stats.GRAD_NORM])
+        nonfinite = float(row[device_stats.NONFINITE])
+        self.loss_gauge.set(loss)
+        self.grad_norm_gauge.set(gnorm)
+        if np.isfinite(gnorm):
+            self.grad_norm_hist.observe(gnorm)
+        if nonfinite > 0:
+            self.nonfinite_steps.inc()
+        if self.watchdog is not None:
+            self.watchdog.observe(iteration, loss, gnorm, nonfinite,
+                                  step_time_s)

@@ -17,6 +17,9 @@ Pages:
   stripped these — VERDICT weak #3).
 - ``/train/system``   — host/device memory + iteration-time charts.
 - ``/train/flow``     — the network graph rendered from the static report.
+- ``/metrics``        — Prometheus text exposition of the telemetry registry
+  (scrape target); ``/api/telemetry`` is its JSON twin plus a system
+  snapshot (host RSS, device memory).
 """
 
 from __future__ import annotations
@@ -369,6 +372,16 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         return {k: v[0] for k, v in q.items()}
 
+    def _registry(self):
+        """The metrics registry to expose: a server-attached one, else the
+        process-wide default (telemetry.get_registry())."""
+        reg = getattr(self.server, "registry", None)
+        if reg is not None:
+            return reg
+        from ..telemetry import get_registry  # noqa: PLC0415
+
+        return get_registry()
+
     def _updates(self, session: str, worker: Optional[str] = None) -> List[dict]:
         out: List[dict] = []
         for st in self.server.storages:  # type: ignore
@@ -384,6 +397,19 @@ class _Handler(BaseHTTPRequestHandler):
             lang = self._query().get("lang") or None
             page = i18n.get_instance().render(_PAGES[path], lang)
             return self._send(200, page.encode(), "text/html")
+        if path == "/metrics":
+            # Prometheus scrape endpoint over the telemetry registry — the
+            # alertable twin of the HTML dashboard
+            text = self._registry().prometheus_text()
+            return self._send(200, text.encode(),
+                              "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/api/telemetry":
+            from ..profiler import SystemInfoSampler  # noqa: PLC0415
+
+            return self._send(200, json.dumps({
+                "metrics": self._registry().snapshot(),
+                "system": SystemInfoSampler.sample(),
+            }).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]
@@ -489,9 +515,11 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, registry=None):
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.storages = []  # type: ignore
+        # None -> the handler falls back to telemetry.get_registry()
+        self._httpd.registry = registry  # type: ignore
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
 
@@ -504,6 +532,11 @@ class UIServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def set_registry(self, registry) -> None:
+        """Expose a specific MetricsRegistry at /metrics (None = process
+        default)."""
+        self._httpd.registry = registry  # type: ignore
 
     def attach(self, storage: StatsStorage) -> None:
         self._httpd.storages.append(storage)  # type: ignore

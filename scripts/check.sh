@@ -13,6 +13,27 @@ cd "$(dirname "$0")/.."
 echo "== dl4jtpu-check: analyzer self-check (deeplearning4j_tpu/ --fail-on error)"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ --fail-on error
 
+echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
+env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
+
+echo "== /metrics smoke scrape (in-process UI server)"
+env JAX_PLATFORMS=cpu python - <<'PY'
+import urllib.request
+
+from deeplearning4j_tpu.telemetry import get_registry
+from deeplearning4j_tpu.ui.server import UIServer
+
+get_registry().counter("dl4jtpu_check_smoke_total", "check.sh scrape probe").inc()
+server = UIServer.get_instance(port=0)
+try:
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    body = urllib.request.urlopen(url, timeout=10).read().decode()
+    assert "dl4jtpu_check_smoke_total 1" in body, body[:400]
+    print(f"scraped {url}: {len(body)} bytes, smoke counter present")
+finally:
+    server.stop()
+PY
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi

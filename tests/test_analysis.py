@@ -230,6 +230,21 @@ _VIOLATIONS = {
         "        return inner\n"
     ),
     "DT106": "import jax\n@jax.jit\ndef f(x):\n    print(x)\n    return x\n",
+    "DT107": (
+        "import jax, numpy as np\n"
+        "def train(step, params, x):\n"
+        "    jstep = jax.jit(step, donate_argnums=(0,))\n"
+        "    view = np.asarray(params)\n"
+        "    params = jstep(params, x)\n"
+        "    return view, params\n"
+    ),
+    "DT108": (
+        "import jax\nfrom jax import lax\n"
+        "def cumsum(xs):\n"
+        "    def body(c, x):\n"
+        "        return c + x, c\n"
+        "    return lax.scan(body, 0.0, xs)\n"
+    ),
     "DT100": "def broken(:\n",
 }
 
@@ -281,6 +296,61 @@ class TestAstRules:
             "@jit_entry\ndef kern(x_ref):\n    return np.abs(x_ref[:])\n"
         )
         assert "DT101" in _ids(check_source(src, "annot.py"))
+
+    def test_dt107_copy_false_variant_fires(self):
+        src = (
+            "import jax, numpy as np\n"
+            "def go(step, buf, x):\n"
+            "    jstep = jax.jit(step, donate_argnums=(0,))\n"
+            "    v = np.array(buf, copy=False)\n"
+            "    buf = jstep(buf, x)\n"
+            "    return v\n"
+        )
+        assert "DT107" in _ids(check_source(src, "d.py"))
+
+    def test_dt107_real_copy_is_clean(self):
+        src = (
+            "import jax, numpy as np\n"
+            "def go(step, buf, x):\n"
+            "    jstep = jax.jit(step, donate_argnums=(0,))\n"
+            "    v = np.array(buf)\n"  # materialized copy: safe
+            "    buf = jstep(buf, x)\n"
+            "    return v\n"
+        )
+        assert check_source(src, "d.py") == []
+
+    def test_dt107_view_after_last_donation_is_clean(self):
+        src = (
+            "import jax, numpy as np\n"
+            "def go(step, buf, x):\n"
+            "    jstep = jax.jit(step, donate_argnums=(0,))\n"
+            "    buf = jstep(buf, x)\n"
+            "    return np.asarray(buf)\n"  # no later donation: safe
+        )
+        assert check_source(src, "d.py") == []
+
+    def test_dt108_literal_inside_call_not_flagged(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "def cumsum(xs):\n"
+            "    def body(c, x):\n"
+            "        return c + x, c\n"
+            "    init = jnp.zeros((4, 8), jnp.float32)\n"
+            "    return jax.lax.scan(body, (init, xs), xs)\n"
+        )
+        assert check_source(src, "s.py") == []
+
+    def test_dt108_tuple_carry_and_kwarg_init(self):
+        src = (
+            "import jax\n"
+            "def f(params, xs):\n"
+            "    def body(c, x):\n"
+            "        p, n = c\n"
+            "        return (p, n + 1), x\n"
+            "    return jax.lax.scan(body, init=(params, 0), xs=xs)\n"
+        )
+        hits = [f for f in check_source(src, "s.py") if f.rule_id == "DT108"]
+        assert hits and hits[0].severity == "warning"
 
     def test_nested_function_inherits_jit_context(self):
         src = (
