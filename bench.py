@@ -114,7 +114,11 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
             conf.params_dtype = "bfloat16"  # carry bf16 weights in the scan
             #   (the round-5 trace's weight-copy-bound lever); own metric key
         net = ComputationGraph(conf).init()
-        multi = net._build_multi_step(steps, 1)
+        # step/batch counts are device scalars since the compile-manager
+        # rework — one executable per staged SHAPE, however many steps
+        multi = net._build_multi_step(steps)
+        n1 = jnp.asarray(steps, jnp.int32)
+        k1 = jnp.asarray(1, jnp.int32)
 
     with timer.phase("data"):
         rng = np.random.default_rng(0)
@@ -128,13 +132,15 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
 
     p, o, s = net.params, net.opt_state, net.state
     with timer.phase("compile"):  # compile (or disk-cache hit) + full warmup run
-        p, o, s, key, losses = multi(p, o, s, key, [xs], [ys])
+        p, o, s, key, losses = multi(p, o, s, key, n1, k1, [xs], [ys],
+                                     None, None)
         warm = np.asarray(losses)
     assert np.all(np.isfinite(warm)), "non-finite warmup losses"
 
     with timer.phase("step"):
         t0 = time.perf_counter()
-        p, o, s, key, losses = multi(p, o, s, key, [xs], [ys])
+        p, o, s, key, losses = multi(p, o, s, key, n1, k1, [xs], [ys],
+                                     None, None)
         losses = np.asarray(losses)  # host fetch: the only reliable sync
         dt = time.perf_counter() - t0
     assert np.all(np.isfinite(losses)), "non-finite losses"
@@ -147,7 +153,8 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     # timing; (2) XLA cost analysis counts the scan body ONCE (same figure
     # for 1 and 60 steps), so the result IS per-step flops — the >100% MFU
     # guard self-corrects if a future XLA starts counting the unrolled loop.
-    flops_per_step = profiler.compiled_flops(multi, p, o, s, key, [xs], [ys])
+    flops_per_step = profiler.compiled_flops(multi, p, o, s, key, n1, k1,
+                                             [xs], [ys], None, None)
 
     step_s = dt / steps
     metric = "resnet50_imagenet_train_images_per_sec_per_chip"
@@ -174,7 +181,8 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # optional deep dive: xplane trace of one scanned run
         with profiler.trace(trace_dir):
-            p, o, s, key, losses = multi(p, o, s, key, [xs], [ys])
+            p, o, s, key, losses = multi(p, o, s, key, n1, k1, [xs], [ys],
+                                         None, None)
             np.asarray(losses)
         result["trace_dir"] = trace_dir
     return result
@@ -198,7 +206,9 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     if os.environ.get("BENCH_PARAMS_BF16") == "1":
         conf.params_dtype = "bfloat16"  # bf16 weight carry (own metric key)
     net = MultiLayerNetwork(conf).init()
-    multi = net._build_multi_step(steps, 1)
+    multi = net._build_multi_step(steps)  # steps/batches ride as device scalars
+    n1 = jnp.asarray(steps, jnp.int32)
+    k1 = jnp.asarray(1, jnp.int32)
     rng = np.random.default_rng(0)
     idx = rng.integers(0, vocab, size=(batch, seq + 1))
     xs = jax.device_put(
@@ -209,7 +219,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     )
     key = jax.random.PRNGKey(0)
     p, o, s = net.params, net.opt_state, net.state
-    p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)  # warmup
+    p, o, s, key, losses = multi(p, o, s, key, n1, k1, xs, ys,
+                                 None, None)  # warmup
     assert np.all(np.isfinite(np.asarray(losses))), "non-finite warmup losses"
     # median of 3 timed scans: at ~5ms/step this row showed real
     # run-to-run variance on the tunnel chip (3.1-4.2M chars/sec band,
@@ -218,7 +229,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)
+        p, o, s, key, losses = multi(p, o, s, key, n1, k1, xs, ys,
+                                     None, None)
         losses = np.asarray(losses)  # host fetch = sync
         times.append(time.perf_counter() - t0)
         assert np.all(np.isfinite(losses)), "non-finite losses"
@@ -229,7 +241,7 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     from deeplearning4j_tpu import profiler
 
     flops_per_step = profiler.compiled_flops(
-        multi, p, o, s, key, xs, ys, None, None)
+        multi, p, o, s, key, n1, k1, xs, ys, None, None)
     step_s = dt / steps
     result = {
         "metric": ("char_rnn_train_chars_per_sec"
@@ -249,7 +261,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
         # ~steps means cost analysis counted every scan iteration. Compiled
         # AFTER the timed region, so the measurement is undisturbed.
         flops_1 = profiler.compiled_flops(
-            net._build_multi_step(1, 1), p, o, s, key, xs, ys, None, None)
+            net._build_multi_step(1), p, o, s, key,
+            jnp.asarray(1, jnp.int32), k1, xs, ys, None, None)
         if flops_1 and flops_per_step / flops_1 > steps / 2:
             flops_per_step /= steps
         elif not flops_1 and profiler.mfu(flops_per_step, step_s) > 100.0:
@@ -262,7 +275,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
         with profiler.trace(trace_dir):
-            p, o, s, key, losses = multi(p, o, s, key, xs, ys, None, None)
+            p, o, s, key, losses = multi(p, o, s, key, n1, k1, xs, ys,
+                                         None, None)
             np.asarray(losses)
         result["trace_dir"] = trace_dir
     return result
@@ -482,6 +496,100 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
     return result
 
 
+def bench_ragged(batch: int = 512, tail: int = 196, full_batches: int = 10,
+                 stage: int = 4, epochs: int = 4, hidden: int = 1024) -> dict:
+    """Ragged-epoch throughput (ISSUE 3 acceptance): every epoch ends in a
+    trailing partial batch. Without bucketing that tail (and, historically,
+    any shape change) forced per-batch dispatch and fresh XLA programs; with
+    the bucketed stager + compile manager the whole epoch runs staged with a
+    bounded executable set. Reports samples/sec WITH and WITHOUT bucketing,
+    the staged-step fraction, and the compile counters
+    (``dl4jtpu_compiles_total`` + compile-seconds) so BENCH_*.json tracks the
+    recompile trajectory round over round. Select with BENCH_MODEL=ragged."""
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+    def make_net(seed=42):
+        conf = MultiLayerConfiguration(
+            layers=[
+                DenseLayer(n_out=hidden, activation="relu"),
+                DenseLayer(n_out=hidden, activation="relu"),
+                OutputLayer(n_out=10, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.feed_forward(784),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+            dtype="bfloat16",
+            seed=seed,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+
+    def mk(rows):
+        return DataSet(
+            rng.normal(size=(rows, 784)).astype(np.float32),
+            np.eye(10, dtype=np.float32)[rng.integers(0, 10, rows)],
+        )
+
+    batches = [mk(batch) for _ in range(full_batches)] + [mk(tail)]
+    n_samples = full_batches * batch + tail
+    cm = get_compile_manager()
+
+    def timed_fit(bucketing: bool):
+        import jax
+
+        net = make_net()
+        it = ListDataSetIterator(list(batches))
+        net.fit(it, epochs=1, stage_on_device=stage,
+                bucketing=bucketing)  # warmup epoch: pays the compiles
+        jax.block_until_ready(net.params)
+        compiles_before = cm.compiles.value
+        t0 = time.perf_counter()
+        net.fit(it, epochs=epochs, stage_on_device=stage,
+                bucketing=bucketing)
+        jax.block_until_ready(net.params)
+        dt = time.perf_counter() - t0
+        return {
+            "samples_per_sec": round(epochs * n_samples / dt, 1),
+            "staged_fraction": round(net.staged_steps_total / net.iteration, 4),
+            "warm_epoch_compiles": cm.compiles.value - compiles_before,
+            "seconds": round(dt, 4),
+        }
+
+    bucketed = timed_fit(True)
+    fallback = timed_fit(False)
+    cm_stats = cm.stats()
+    result = {
+        "metric": "ragged_epoch_bucketed_train_samples_per_sec",
+        "value": bucketed["samples_per_sec"],
+        "unit": "samples/sec",
+        "bucketed": bucketed,
+        "unbucketed": fallback,
+        "bucketing_speedup": round(
+            bucketed["samples_per_sec"] / max(fallback["samples_per_sec"], 1e-9), 3),
+        "shape": {"batch": batch, "tail": tail, "full_batches": full_batches,
+                  "stage": stage, "epochs": epochs, "hidden": hidden},
+    }
+    result["telemetry"] = _telemetry_block(
+        [bucketed["seconds"] / max(epochs * (full_batches + 1), 1)],
+        extra_gauges={
+            "bench_samples_per_sec": bucketed["samples_per_sec"],
+            "bench_staged_fraction": bucketed["staged_fraction"],
+            "bench_compiles_total": cm_stats["compiles_total"],
+            "bench_compile_seconds_sum": cm_stats["compile_seconds"]["sum"],
+        })
+    result["telemetry"]["compile"] = cm_stats
+    return result
+
+
 def _load_baselines() -> dict:
     """Parse BENCH_SELF.json defensively: any malformed content reads as {}."""
     try:
@@ -581,6 +689,9 @@ def _tpu_child_main() -> int:
             result["metric"] += f"_b{cfg['batch']}xs{cfg['seq']}xn{cfg['steps']}"
     elif os.environ.get("BENCH_MODEL") == "word2vec":
         result = bench_word2vec()
+    elif os.environ.get("BENCH_MODEL") == "ragged":
+        result = bench_ragged(batch=_ienv("BENCH_BATCH", 512),
+                              stage=_ienv("BENCH_STAGE", 4))
     elif os.environ.get("BENCH_MODEL") == "attention":
         result = bench_attention(seq=_ienv("BENCH_SEQ", 4096))
         if result["shape"]["seq"] != 4096:

@@ -23,7 +23,12 @@ cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress findings
 from .findings import Finding, Severity, SEVERITY_ORDER
 from .rules import Rule, RULES, get_rule, register_rule
 from .pragmas import filter_findings
-from .graph_checks import check_multi_layer, check_graph, check_config
+from .graph_checks import (
+    check_multi_layer,
+    check_graph,
+    check_config,
+    check_shardings,
+)
 from .ast_checks import check_source, check_file
 
 __all__ = [
@@ -38,6 +43,7 @@ __all__ = [
     "check_multi_layer",
     "check_graph",
     "check_config",
+    "check_shardings",
     "check_source",
     "check_file",
 ]

@@ -544,6 +544,13 @@ def _check_jit_body(fn: ast.FunctionDef, filename: str) -> List[Finding]:
                 findings.append(get_rule("DT101").finding(
                     f"{name}() inside jit body '{ctx}' executes on host at "
                     "trace time", **loc))
+            elif _last(name) in ("device_put", "device_get"):
+                # DT009 (AST half): an explicit transfer inside a traced
+                # body executes on EVERY step — resharding belongs to
+                # lax.with_sharding_constraint, staging outside the step
+                findings.append(get_rule("DT009").finding(
+                    f"{name}() inside jit body '{ctx}' forces a cross-device "
+                    "transfer every step", **loc))
             elif isinstance(node.func, ast.Attribute) and \
                     node.func.attr in ("item", "tolist"):
                 findings.append(get_rule("DT102").finding(

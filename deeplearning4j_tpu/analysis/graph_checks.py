@@ -263,6 +263,84 @@ def check_graph(conf, *, batch: int = DEFAULT_BATCH,
     return findings
 
 
+# ------------------------------------------------------------ DT009 check
+def _leaf_shardings(params_subtree):
+    """Distinct (device-set, spec) placements of a param subtree's leaves.
+    Device sets are frozensets of device ids; spec is the NamedSharding
+    PartitionSpec when present (SingleDeviceSharding and friends report
+    None — only the device set matters for transfer detection)."""
+    placements = {}
+    for leaf in jax.tree_util.tree_leaves(params_subtree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        try:
+            devices = frozenset(d.id for d in sharding.device_set)
+        except Exception:
+            continue
+        spec = getattr(sharding, "spec", None)
+        placements[(devices, str(spec))] = (devices, spec)
+    return placements
+
+
+def check_shardings(net, *, source: str = "<network>") -> List[Finding]:
+    """DT009: detect per-step cross-device transfers between consecutive
+    vertices/layers of an *initialized* network.
+
+    Unlike the config passes this inspects live param placements (configs
+    carry no sharding), so it runs after ``init()``/``shard_params``: for
+    every graph edge (or layer i -> i+1 in a MultiLayerNetwork), if the two
+    ends' parameters live on different device sets, the activation crossing
+    that edge is resharded on EVERY optimizer step — usually an accidental
+    ``device_put`` of one subtree onto the wrong mesh. A vertex whose own
+    leaves span several device sets is flagged too.
+    """
+    findings: List[Finding] = []
+    net.init()
+    rule = get_rule("DT009")
+
+    if hasattr(net, "conf") and hasattr(net.conf, "vertices"):
+        names = net.conf.topological_order()
+        params_of = lambda n: net.params[n]  # noqa: E731
+        edges = [
+            (src, dst)
+            for dst in names
+            for src in net.conf.vertex_inputs[dst]
+            if src in net.conf.vertices
+        ]
+        label = lambda n: f"vertex '{n}'"  # noqa: E731
+    else:
+        names = list(range(len(net.conf.layers)))
+        params_of = lambda i: net.params[i]  # noqa: E731
+        edges = [(i, i + 1) for i in names[:-1]]
+        label = lambda i: f"layer[{i}]"  # noqa: E731
+
+    placements = {n: _leaf_shardings(params_of(n)) for n in names}
+    for n in names:
+        device_sets = {devs for devs, _ in placements[n].values()}
+        if len(device_sets) > 1:
+            findings.append(rule.finding(
+                f"{label(n)} parameters span {len(device_sets)} distinct "
+                "device sets — the vertex reshards its own params every step",
+                file=source, context=label(n),
+            ))
+    for src, dst in edges:
+        a, b = placements.get(src), placements.get(dst)
+        if not a or not b:
+            continue  # param-less vertex (merge/activation): no placement
+        sets_a = {devs for devs, _ in a.values()}
+        sets_b = {devs for devs, _ in b.values()}
+        if len(sets_a) == 1 and len(sets_b) == 1 and sets_a != sets_b:
+            da, db = next(iter(sets_a)), next(iter(sets_b))
+            findings.append(rule.finding(
+                f"edge {label(src)} -> {label(dst)}: parameters live on "
+                f"different device sets ({sorted(da)} vs {sorted(db)}) — the "
+                "activation crossing this edge is resharded every step",
+                file=source, context=f"{label(src)} -> {label(dst)}",
+            ))
+    return findings
+
+
 def check_config(conf, **kw) -> List[Finding]:
     """Dispatch on config type (or a parsed to_dict()-style mapping)."""
     from ..nn.conf.multi_layer import MultiLayerConfiguration

@@ -102,6 +102,18 @@ register_rule(Rule(
     "keep float64 for offline gradient checks only.",
 ))
 register_rule(Rule(
+    "DT009", "cross-device transfer between consecutive vertices", "warning",
+    "graph",
+    "Consecutive layers/vertices are pinned to different device sets or "
+    "shardings (or a jitted body calls jax.device_put/device_get): every "
+    "training step pays a cross-device resharding transfer of the "
+    "activations on that edge.",
+    "Place consecutive vertices' params on ONE mesh (parallel/sharding."
+    "shard_params) and let GSPMD insert collectives; inside jit use "
+    "lax.with_sharding_constraint, never device_put — explicit transfers "
+    "belong outside the step (e.g. DevicePrefetchIterator).",
+))
+register_rule(Rule(
     "DT007", "network output has no loss head", "info", "graph",
     "A network output layer/vertex is not an output (loss-bearing) layer; "
     "fit() will have no loss to differentiate.",

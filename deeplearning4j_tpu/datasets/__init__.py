@@ -55,9 +55,16 @@ from .normalizers import (
     NormalizerStandardize,
     NormalizingIterator,
 )
+from .bucketing import (
+    BucketedStager,
+    PaddedWindow,
+    bucket_length,
+    pad_batch_arrays,
+)
 
 __all__ = [
     "AsyncDataSetIterator", "AsyncMultiDataSetIterator",
+    "BucketedStager", "PaddedWindow", "bucket_length", "pad_batch_arrays",
     "BucketingSequenceIterator", "CombinedPreProcessor", "DataSet", "pad_to_bucket",
     "DataSetIterator",
     "DevicePrefetchIterator", "ExistingDataSetIterator", "IteratorDataSetIterator",

@@ -51,11 +51,12 @@ class ComputationGraph:
         self._rnn_step_fn = None
         self._tbptt_step = None
         self._grad_stats_step = None
-        self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
         self.telemetry = None  # telemetry.Telemetry session (set_telemetry)
         self._telemetry_step = None
+        self._cm_token = None  # compile-manager owner token (one per init())
+        self.staged_steps_total = 0  # optimizer steps run via fit_on_device
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "ComputationGraph":
@@ -77,15 +78,37 @@ class ComputationGraph:
         self._tx = self.conf.updater.build()
         self.opt_state = self._tx.init(self.params)
         self.iteration = 0
+        self._invalidate_compiled()
+        return self
+
+    def _invalidate_compiled(self) -> None:
+        """See MultiLayerNetwork._invalidate_compiled: retire this
+        generation's executables from the compile manager and null the
+        per-instance step handles (they close over self._tx)."""
+        from ...runtime.compile_manager import get_compile_manager
+
+        cm = get_compile_manager()
+        if self._cm_token is not None:
+            cm.drop_token(self._cm_token)
+        self._cm_token = cm.new_token()
         self._train_step = None
         self._eval_forward = None
-        self._tbptt_step = None  # closes over self._tx — must follow it
+        self._tbptt_step = None
         self._rnn_step_fn = None
         self._rnn_state = None
         self._grad_stats_step = None
-        self._multi_step_cache = None
         self._telemetry_step = None
-        return self
+
+    def _step_callable(self, variant: str = "plain"):
+        """Per-batch jitted step via the process-wide compile manager (one
+        bounded LRU across every net — see MultiLayerNetwork._step_callable)."""
+        from ...runtime.compile_manager import get_compile_manager
+
+        flags = {"grad_stats": {"with_grad_stats": True},
+                 "telemetry": {"with_telemetry": True}}.get(variant, {})
+        return get_compile_manager().callable(
+            (self._cm_token, "graph_train_step", variant),
+            lambda: self._build_train_step(**flags))
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
@@ -272,61 +295,187 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------- on-device multi-step
-    def _build_multi_step(self, num_steps: int, num_batches: int,
+    def _build_multi_step(self, steps_cap: int, with_masks: bool = False,
                           with_telemetry: bool = False):
-        """ONE device dispatch for ``num_steps`` steps — lax.scan over batches
-        staged in HBM (each input/label stacked ``[K, B, ...]``, step i uses
-        batch ``i % K``). See MultiLayerNetwork._build_multi_step: same RNG
-        split chain as sequential ``_fit_batch``, so numerics are identical to
-        per-step dispatch while the whole loop stays on-chip."""
+        """ONE device dispatch for a window of steps — ``lax.fori_loop`` over
+        batches staged in HBM (each input/label stacked ``[K, B, ...]``, step
+        i uses batch ``i % n_batches``). See
+        MultiLayerNetwork._build_multi_step: same RNG split chain as
+        sequential ``_fit_batch`` (numerics identical to per-step dispatch)
+        and device-scalar step/batch counts (changing them reuses one
+        executable). ``xmasks``/``ymasks``: per-input features masks and
+        per-output labels masks (None entries allowed), stacked ``[K, ...]``
+        — the bucketed stager's padded batches flow through here."""
         tx = self._tx
 
-        def run(params, opt_state, state, rng, xs_list, ys_list):
-            def body(carry, i):
-                params, opt, st, rng = carry
+        def run(params, opt_state, state, rng, n_steps, n_batches,
+                xs_list, ys_list, xmasks, ymasks):
+            from ...telemetry import device as _tdev  # noqa: PLC0415
+
+            losses0 = jnp.zeros((steps_cap,), jnp.float32)
+            mvecs0 = (jnp.zeros((steps_cap, _tdev.NUM_SLOTS), jnp.float32)
+                      if with_telemetry else None)
+
+            def pick(arr, idx):
+                return jax.lax.dynamic_index_in_dim(arr, idx, 0,
+                                                    keepdims=False)
+
+            def body(i, carry):
+                params, opt, st, rng, losses, mvecs = carry
                 rng, step_key = jax.random.split(rng)
-                idx = i % num_batches
-                inputs = [
-                    jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
-                    for x in xs_list
-                ]
-                labels = [
-                    jax.lax.dynamic_index_in_dim(y, idx, 0, keepdims=False)
-                    for y in ys_list
-                ]
+                idx = i % n_batches
+                inputs = [pick(x, idx) for x in xs_list]
+                labels = [pick(y, idx) for y in ys_list]
+                masks = None
+                lms = None
+                # the mask branches test pytree STRUCTURE (None-ness) —
+                # trace-static, not a traced value
+                if with_masks and xmasks is not None and any(  # dl4jtpu: ignore[DT104]
+                        m is not None for m in xmasks):
+                    masks = {
+                        name: (None if m is None else pick(m, idx))
+                        for name, m in zip(self.conf.network_inputs, xmasks)
+                    }
+                if with_masks and ymasks is not None and any(  # dl4jtpu: ignore[DT104]
+                        m is not None for m in ymasks):
+                    lms = [None if m is None else pick(m, idx)
+                           for m in ymasks]
 
                 def loss_of(p):
                     loss, new_state, _ = self._loss(
-                        p, st, inputs, labels, step_key, True, None, None
+                        p, st, inputs, labels, step_key, True, lms, masks
                     )
                     return loss, new_state
 
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt, params)
                 new_params = optax.apply_updates(params, updates)
+                losses = jax.lax.dynamic_update_index_in_dim(
+                    losses, loss.astype(jnp.float32), i, 0)
                 if with_telemetry:
-                    from ...telemetry import device as _tdev  # noqa: PLC0415
+                    mvecs = jax.lax.dynamic_update_index_in_dim(
+                        mvecs, _tdev.step_stats(loss, grads), i, 0)
+                return (new_params, new_opt, new_state, rng, losses, mvecs)
 
-                    return ((new_params, new_opt, new_state, rng),
-                            (loss, _tdev.step_stats(loss, grads)))
-                return (new_params, new_opt, new_state, rng), loss
-
-            (params, opt_state, state, rng), out = jax.lax.scan(
-                body, (params, opt_state, state, rng), jnp.arange(num_steps)
-            )
+            (params, opt_state, state, rng, losses, mvecs) = jax.lax.fori_loop(
+                0, n_steps, body,
+                (params, opt_state, state, rng, losses0, mvecs0))
             if with_telemetry:
-                losses, mvecs = out
                 return params, opt_state, state, rng, losses, mvecs
-            return params, opt_state, state, rng, out
+            return params, opt_state, state, rng, losses
 
         donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
 
-    def fit_on_device(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
+    @staticmethod
+    def _as_stage_list(value, n: int, kind: str):
+        """Normalize a masks argument to a length-``n`` list (None entries
+        allowed); a bare array is accepted for single-input/-output graphs."""
+        if value is None:
+            return None
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        value = [None if v is None else v for v in value]
+        if len(value) != n:
+            raise ValueError(f"{kind} has {len(value)} entries, expected {n}")
+        return list(value)
+
+    def _staged_args(self, xs_list, ys_list, steps, fmasks, lmasks,
+                     real_batches):
+        """Validate + canonicalize (see MultiLayerNetwork._staged_args)."""
+        from ..multilayer import _staged_dim0
+        from ...runtime.compile_manager import next_pow2
+
+        num_slots = _staged_dim0(xs_list[0])
+        if num_slots == 0:
+            raise ValueError("fit_on_device needs at least one staged batch")
+        # dynamic_index_in_dim CLAMPS out-of-range indices — a K mismatch in
+        # any input/label would silently pair the wrong batches
+        for i, arr in enumerate(xs_list + ys_list):
+            if _staged_dim0(arr) != num_slots:
+                kind = "input" if i < len(xs_list) else "label"
+                idx = i if i < len(xs_list) else i - len(xs_list)
+                raise ValueError(
+                    f"{kind} array {idx} stages "
+                    f"{_staged_dim0(arr)} batches, expected {num_slots}"
+                )
+        for masks, kind in ((fmasks, "features mask"), (lmasks, "labels mask")):
+            for i, m in enumerate(masks or []):
+                if m is not None and _staged_dim0(m) != num_slots:
+                    raise ValueError(
+                        f"{kind} {i} stages {_staged_dim0(m)} batches, "
+                        f"expected {num_slots}"
+                    )
+        n_real = num_slots if real_batches is None else int(real_batches)
+        if not 1 <= n_real <= num_slots:
+            raise ValueError(f"real_batches={n_real} outside [1, {num_slots}]")
+        n_steps = int(steps) if steps is not None else n_real
+        steps_cap = num_slots if n_steps <= num_slots else next_pow2(n_steps)
+        with_masks = fmasks is not None or lmasks is not None
+        args = (self.params, self.opt_state, self.state, self._rng,
+                jnp.asarray(n_steps, jnp.int32),
+                jnp.asarray(n_real, jnp.int32),
+                xs_list, ys_list, fmasks, lmasks)
+        return steps_cap, with_masks, n_steps, args
+
+    def _staged_executable(self, steps_cap, with_masks, with_telemetry, args):
+        from ...runtime.compile_manager import get_compile_manager, signature
+
+        cm = get_compile_manager()
+        # token stays the key's FIRST element (drop_token matches on it)
+        key = (self._cm_token, "graph_multi_step",
+               signature(steps_cap, with_masks, with_telemetry, args))
+        return cm.aot(
+            key,
+            lambda: self._build_multi_step(steps_cap, with_masks,
+                                           with_telemetry),
+            args,
+        )
+
+    def warmup(self, features, labels, steps: Optional[int] = None,
+               features_masks=None, labels_masks=None,
+               real_batches: Optional[int] = None) -> "ComputationGraph":
+        """Compile-ahead for the staged path (see MultiLayerNetwork.warmup);
+        arrays may be real data or ``jax.ShapeDtypeStruct`` shells."""
+        self.init()
+        if not isinstance(features, (list, tuple)):
+            features = [features]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+
+        def _shell(a):
+            if a is None or isinstance(a, jax.ShapeDtypeStruct):
+                return a
+            a = np.asarray(a) if not hasattr(a, "dtype") else a
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+        fmasks = self._as_stage_list(features_masks,
+                                     len(self.conf.network_inputs),
+                                     "features_masks")
+        lmasks = self._as_stage_list(labels_masks,
+                                     len(self.conf.network_outputs),
+                                     "labels_masks")
+        steps_cap, with_masks, _, args = self._staged_args(
+            [_shell(x) for x in features], [_shell(y) for y in labels],
+            steps,
+            None if fmasks is None else [_shell(m) for m in fmasks],
+            None if lmasks is None else [_shell(m) for m in lmasks],
+            real_batches)
+        self._staged_executable(steps_cap, with_masks,
+                                self.telemetry is not None, args)
+        return self
+
+    def fit_on_device(self, features, labels, steps: Optional[int] = None,
+                      features_masks=None, labels_masks=None,
+                      real_batches: Optional[int] = None) -> np.ndarray:
         """Whole training loop in ONE dispatch (TPU-native fit; see
         MultiLayerNetwork.fit_on_device). ``features``/``labels``: lists (one
         per network input/output) of stacked batches ``[K, B, ...]``; a single
-        array is accepted for single-input/-output graphs. Masks and TBPTT are
+        array is accepted for single-input/-output graphs.
+        ``features_masks``/``labels_masks``: per-input/-output stacked masks
+        (None entries allowed) — the bucketed stager threads padded batches
+        through here. ``real_batches`` marks how many leading slots hold real
+        data (trailing slots may be dummy padding, never indexed). TBPTT is
         not supported on this path — use :meth:`fit`."""
         self.init()
         if self.conf.backprop_type == "tbptt":
@@ -337,45 +486,42 @@ class ComputationGraph:
             labels = [labels]
         xs_list = [jnp.asarray(x) for x in features]
         ys_list = [jnp.asarray(y) for y in labels]
-        num_batches = int(xs_list[0].shape[0])
-        if num_batches == 0:
-            raise ValueError("fit_on_device needs at least one staged batch")
-        # dynamic_index_in_dim CLAMPS out-of-range indices — a K mismatch in
-        # any input/label would silently pair the wrong batches
-        for i, arr in enumerate(xs_list + ys_list):
-            if int(arr.shape[0]) != num_batches:
-                kind = "input" if i < len(xs_list) else "label"
-                idx = i if i < len(xs_list) else i - len(xs_list)
-                raise ValueError(
-                    f"{kind} array {idx} stages "
-                    f"{int(arr.shape[0])} batches, expected {num_batches}"
-                )
-        n_steps = int(steps) if steps is not None else num_batches
+        fmasks = self._as_stage_list(features_masks,
+                                     len(self.conf.network_inputs),
+                                     "features_masks")
+        lmasks = self._as_stage_list(labels_masks,
+                                     len(self.conf.network_outputs),
+                                     "labels_masks")
+        if fmasks is not None:
+            fmasks = [None if m is None else jnp.asarray(m) for m in fmasks]
+            if all(m is None for m in fmasks):
+                fmasks = None
+        if lmasks is not None:
+            lmasks = [None if m is None else jnp.asarray(m) for m in lmasks]
+            if all(m is None for m in lmasks):
+                lmasks = None
         tel = self.telemetry
-        if self._multi_step_cache is None:
-            self._multi_step_cache = {}
-        cache_key = (n_steps, num_batches, tel is not None)
-        fn = self._multi_step_cache.get(cache_key)
-        if fn is None:
-            fn = self._build_multi_step(n_steps, num_batches,
-                                        with_telemetry=tel is not None)
-            self._multi_step_cache[cache_key] = fn
+        steps_cap, with_masks, n_steps, args = self._staged_args(
+            xs_list, ys_list, steps, fmasks, lmasks, real_batches)
+        fn = self._staged_executable(steps_cap, with_masks, tel is not None,
+                                     args)
         t0 = time.perf_counter()
-        out = fn(
-            self.params, self.opt_state, self.state, self._rng, xs_list, ys_list
-        )
+        out = fn(*args)
         mvecs = None
         if tel is not None:
             (self.params, self.opt_state, self.state, self._rng,
              losses, mvecs) = out
         else:
             self.params, self.opt_state, self.state, self._rng, losses = out
-        losses = np.asarray(losses)  # host fetch = the sync point
+        # host fetch = the sync point; buffer tails slice off HOST-side (a
+        # device-side slice would compile per distinct step count)
+        losses = np.asarray(losses)[:n_steps]
         elapsed = time.perf_counter() - t0
         if tel is not None:
-            tel.on_staged(self.iteration + 1, mvecs,
+            tel.on_staged(self.iteration + 1, np.asarray(mvecs)[:n_steps],
                           per_step_time_s=elapsed / max(len(losses), 1))
         self.last_batch_size = int(xs_list[0].shape[1])
+        self.staged_steps_total += len(losses)
         # see MultiLayerNetwork.fit_on_device: even per-step attribution for
         # throughput listeners during the tight replay loop
         self.staged_step_time = elapsed / max(len(losses), 1)
@@ -389,22 +535,27 @@ class ComputationGraph:
             self.staged_step_time = None
         return losses
 
-    def fit(self, data, epochs: int = 1,
-            stage_on_device: int = 0) -> "ComputationGraph":
+    def fit(self, data, epochs: int = 1, stage_on_device: int = 0,
+            bucketing: bool = True) -> "ComputationGraph":
         """Train (reference: ComputationGraph.fit(MultiDataSet):743).
 
         ``data``: MultiDataSet, DataSet, (x, y) tuple, or an iterator of any.
 
-        ``stage_on_device=K``: buffer K uniform mask-free batches and run
-        them as ONE scanned dispatch (see MultiLayerNetwork.fit — same
-        bit-identical contract; masked/TBPTT/grad-stats batches train
-        per-batch).
+        ``stage_on_device=K``: buffer K batches and run the window as ONE
+        on-device dispatch, double-buffered (see MultiLayerNetwork.fit).
+        With ``bucketing`` (default) ragged/masked batches stay on the
+        staged path — trailing partial batches pad up with masked rows,
+        variable sequence lengths pad to power-of-two time buckets, and the
+        trailing partial window runs with device-scalar step counts;
+        ``bucketing=False`` restores the strict legacy contract (only full
+        uniform mask-free groups stage). TBPTT/grad-stats batches always
+        train per-batch.
         """
         from ...datasets.iterators import AsyncDataSetIterator, as_iterator
 
         self.init()
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._step_callable()
         stage = int(stage_on_device)
         if stage > 1 and (
             self.conf.backprop_type == "tbptt"
@@ -422,7 +573,7 @@ class ComputationGraph:
             if getattr(it, "prefetch_supported", False):
                 it = AsyncDataSetIterator(it)
             if stage > 1:
-                self._fit_epoch_staged(it, stage)
+                self._fit_epoch_staged(it, stage, bucketing)
             else:
                 for ds in it:
                     self._fit_batch(self._as_multi(ds))
@@ -434,59 +585,71 @@ class ComputationGraph:
             self.telemetry.flush()  # drain a partial K-window at fit end
         return self
 
-    @staticmethod
-    def _stage_signature(mds):
-        """Uniform-group key: staging requires identical shapes and NO masks
-        (the graph's fit_on_device path doesn't thread masks)."""
-        has_masks = (
-            (mds.features_masks is not None
-             and any(m is not None for m in mds.features_masks))
-            or (mds.labels_masks is not None
-                and any(m is not None for m in mds.labels_masks))
-        )
-        return (
-            tuple(np.shape(f) for f in mds.features),
-            tuple(np.shape(l) for l in mds.labels),
-            has_masks,
+    def _pad_examples_ok(self) -> bool:
+        """Row padding is exact only for per-example models (see
+        MultiLayerNetwork._pad_examples_ok)."""
+        from ..layers.normalization import BatchNormalization
+
+        return not any(
+            isinstance(getattr(v, "layer", None), BatchNormalization)
+            for v in self.conf.vertices.values()
         )
 
-    def _fit_epoch_staged(self, it, stage: int) -> None:
-        """See MultiLayerNetwork._fit_epoch_staged: full uniform groups run
-        as one scanned dispatch; stragglers/masked/shape-breaking batches
-        train per-batch in order."""
-        group: list = []
-        sig = None
+    def _fit_epoch_staged(self, it, stage: int, bucketing: bool = True) -> None:
+        """See MultiLayerNetwork._fit_epoch_staged: bucketed windows run as
+        one on-device dispatch, double-buffered (window i+1's device_put
+        overlaps window i's compute); unstageable batches train per-batch in
+        stream order."""
+        from ...datasets.bucketing import BucketedStager
 
-        def flush_per_batch():
-            nonlocal group, sig
-            for mds in group:
-                self._fit_batch(mds)
-            group, sig = [], None
+        stager = BucketedStager(stage, bucketing=bucketing,
+                                pad_examples=self._pad_examples_ok())
 
-        def flush_staged():
-            nonlocal group, sig
-            xs = [np.stack([np.asarray(m.features[i]) for m in group])
-                  for i in range(len(group[0].features))]
-            ys = [np.stack([np.asarray(m.labels[i]) for m in group])
-                  for i in range(len(group[0].labels))]
-            self.fit_on_device(xs, ys, steps=stage)
-            group, sig = [], None
-
-        for ds in it:
+        def normalize(ds):
             mds = self._as_multi(ds)
-            s = self._stage_signature(mds)
-            if s[2]:  # masked: never stageable — train immediately, in order
-                flush_per_batch()
-                self._fit_batch(mds)
-                continue
-            if group and s != sig:
-                flush_per_batch()
-            sig = s
-            group.append(mds)
-            if len(group) == stage:
-                flush_staged()
-        if group:
-            flush_per_batch()
+            n_in, n_out = len(mds.features), len(mds.labels)
+            return (
+                [np.asarray(f) for f in mds.features],
+                [np.asarray(l) for l in mds.labels],
+                list(mds.features_masks or [None] * n_in),
+                list(mds.labels_masks or [None] * n_out),
+            )
+
+        def to_device(win):
+            put = jax.device_put  # async: overlaps the pending dispatch
+
+            def opt(ms):
+                return None if ms is None else [
+                    None if m is None else put(m) for m in ms]
+
+            win.features = [put(a) for a in win.features]
+            win.labels = [put(a) for a in win.labels]
+            win.features_masks = opt(win.features_masks)
+            win.labels_masks = opt(win.labels_masks)
+            return win
+
+        def dispatch(win):
+            self.fit_on_device(
+                win.features, win.labels, steps=win.n_real,
+                features_masks=win.features_masks,
+                labels_masks=win.labels_masks,
+                real_batches=win.n_real,
+            )
+
+        pending = None
+        for kind, payload in stager.plan(it, normalize):
+            if kind == "window":
+                staged = to_device(payload)
+                if pending is not None:
+                    dispatch(pending)
+                pending = staged
+            else:
+                if pending is not None:
+                    dispatch(pending)
+                    pending = None
+                self._fit_batch(self._as_multi(payload))
+        if pending is not None:
+            dispatch(pending)
 
     @staticmethod
     def _as_multi(ds):
@@ -527,7 +690,7 @@ class ComputationGraph:
         mvec = None
         if self._wants_grad_stats():
             if self._grad_stats_step is None:
-                self._grad_stats_step = self._build_train_step(with_grad_stats=True)
+                self._grad_stats_step = self._step_callable("grad_stats")
             (self.params, self.opt_state, self.state, loss,
              self._last_grads, self._last_updates) = self._grad_stats_step(
                 self.params, self.opt_state, self.state,
@@ -539,7 +702,7 @@ class ComputationGraph:
                 mvec = _tdev.step_stats(loss, self._last_grads)
         elif tel is not None:
             if self._telemetry_step is None:
-                self._telemetry_step = self._build_train_step(with_telemetry=True)
+                self._telemetry_step = self._step_callable("telemetry")
             (self.params, self.opt_state, self.state, loss, mvec) = \
                 self._telemetry_step(
                     self.params, self.opt_state, self.state,

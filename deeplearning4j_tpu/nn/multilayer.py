@@ -87,14 +87,22 @@ def _format_summary_table(rows, total: int) -> str:
     return "\n".join(lines)
 
 
+def _staged_dim0(arr) -> int:
+    """Leading (staged-batch) dim of an array or ShapeDtypeStruct."""
+    shape = getattr(arr, "shape", None)
+    if shape is None:
+        shape = np.shape(arr)
+    return int(shape[0])
+
+
 def _check_staged_counts(num_batches: int, named_arrays) -> None:
     """Shared fit_on_device guard: dynamic_index_in_dim CLAMPS out-of-range
     indices, so a staged-batch-count mismatch would silently train features i
     against labels min(i, K-1) — refuse loudly instead."""
     for name, arr in named_arrays:
-        if arr is not None and int(jnp.asarray(arr).shape[0]) != num_batches:
+        if arr is not None and _staged_dim0(arr) != num_batches:
             raise ValueError(
-                f"{name} stages {int(jnp.asarray(arr).shape[0])} batches, "
+                f"{name} stages {_staged_dim0(arr)} batches, "
                 f"expected {num_batches}"
             )
 
@@ -119,11 +127,12 @@ class MultiLayerNetwork:
         self._rnn_state = None  # streaming rnnTimeStep state, one entry per layer
         self._rnn_step_fn = None
         self._grad_stats_step = None
-        self._multi_step_cache = None
         self._last_grads = None  # populated when a listener needs_gradients
         self._last_updates = None
         self.telemetry = None  # telemetry.Telemetry session (set_telemetry)
         self._telemetry_step = None
+        self._cm_token = None  # compile-manager owner token (one per init())
+        self.staged_steps_total = 0  # optimizer steps run via fit_on_device
 
     # ------------------------------------------------------------------ init
     def init(self, params=None, force: bool = False) -> "MultiLayerNetwork":
@@ -146,15 +155,39 @@ class MultiLayerNetwork:
         self._tx = self.conf.updater.build()
         self.opt_state = self._tx.init(self.params)
         self.iteration = 0
+        self._invalidate_compiled()
+        return self
+
+    def _invalidate_compiled(self) -> None:
+        """Retire every executable built for the previous generation (the
+        optimizer closure changed) and start a fresh compile-manager token;
+        the manager evicts the stale entries eagerly instead of leaking them
+        until LRU pressure."""
+        from ..runtime.compile_manager import get_compile_manager
+
+        cm = get_compile_manager()
+        if self._cm_token is not None:
+            cm.drop_token(self._cm_token)
+        self._cm_token = cm.new_token()
         self._train_step = None
         self._tbptt_step = None
         self._eval_forward = None
         self._rnn_state = None
         self._rnn_step_fn = None
         self._grad_stats_step = None
-        self._multi_step_cache = None
         self._telemetry_step = None
-        return self
+
+    def _step_callable(self, variant: str = "plain"):
+        """The per-batch jitted step, deduplicated through the process-wide
+        compile manager (one LRU holds every executable of every net, so
+        long-running jobs stay bounded)."""
+        from ..runtime.compile_manager import get_compile_manager
+
+        flags = {"grad_stats": {"with_grad_stats": True},
+                 "telemetry": {"with_telemetry": True}}.get(variant, {})
+        return get_compile_manager().callable(
+            (self._cm_token, "mln_train_step", variant),
+            lambda: self._build_train_step(**flags))
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
@@ -325,27 +358,39 @@ class MultiLayerNetwork:
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------- on-device multi-step
-    def _build_multi_step(self, num_steps: int, num_batches: int,
-                          with_masks: bool = False,
+    def _build_multi_step(self, steps_cap: int, with_masks: bool = False,
                           with_telemetry: bool = False):
-        """ONE device dispatch for ``num_steps`` optimizer steps: lax.scan of
-        the train step over batches staged in HBM (stacked ``[K, B, ...]``),
-        cycling ``i % K``.
+        """ONE device dispatch for a whole window of optimizer steps: a
+        ``lax.fori_loop`` of the train step over batches staged in HBM
+        (stacked ``[K, B, ...]``), cycling ``i % n_batches``.
 
         The reference's fit loop dispatches per minibatch
         (MultiLayerNetwork.fit:917) — on TPU that pays a host round-trip per
         step, which over a tunnel/network-attached device costs more than the
-        step itself. Scanning keeps the whole loop on-chip; per-step RNG uses
-        the same split chain as sequential ``_fit_batch``, so results are
+        step itself. The loop keeps everything on-chip; per-step RNG uses the
+        same split chain as sequential ``_fit_batch``, so results are
         bit-identical to per-step dispatch.
+
+        Recompile elimination: the step count and the real staged-batch
+        count are DEVICE scalars (``n_steps``/``n_batches``), not trace-time
+        constants — changing either reuses one executable. Only ``steps_cap``
+        (the static per-step-output buffer size, a power-of-two bucket) and
+        the staged array shapes are baked into the program.
         """
         tx = self._tx
 
-        def run(params, opt_state, state, rng, xs, ys, xmasks, ymasks):
-            def body(carry, i):
-                params, opt, st, rng = carry
+        def run(params, opt_state, state, rng, n_steps, n_batches, xs, ys,
+                xmasks, ymasks):
+            from ..telemetry import device as _tdev  # noqa: PLC0415
+
+            losses0 = jnp.zeros((steps_cap,), jnp.float32)
+            mvecs0 = (jnp.zeros((steps_cap, _tdev.NUM_SLOTS), jnp.float32)
+                      if with_telemetry else None)
+
+            def body(i, carry):
+                params, opt, st, rng, losses, mvecs = carry
                 rng, step_key = jax.random.split(rng)
-                idx = i % num_batches
+                idx = i % n_batches
                 x = jax.lax.dynamic_index_in_dim(xs, idx, 0, keepdims=False)
                 y = jax.lax.dynamic_index_in_dim(ys, idx, 0, keepdims=False)
                 fm = (
@@ -364,33 +409,102 @@ class MultiLayerNetwork:
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt, params)
                 new_params = optax.apply_updates(params, updates)
+                losses = jax.lax.dynamic_update_index_in_dim(
+                    losses, loss.astype(jnp.float32), i, 0)
                 if with_telemetry:
-                    from ..telemetry import device as _tdev  # noqa: PLC0415
+                    # per-step metrics vector written into the window buffer —
+                    # the host fetches [steps, NUM_SLOTS] once, after dispatch
+                    mvecs = jax.lax.dynamic_update_index_in_dim(
+                        mvecs, _tdev.step_stats(loss, grads), i, 0)
+                return (new_params, new_opt, new_state, rng, losses, mvecs)
 
-                    # per-step metrics vector stacked by the scan — the host
-                    # fetches [steps, NUM_SLOTS] once, after the dispatch
-                    return ((new_params, new_opt, new_state, rng),
-                            (loss, _tdev.step_stats(loss, grads)))
-                return (new_params, new_opt, new_state, rng), loss
-
-            (params, opt_state, state, rng), out = jax.lax.scan(
-                body, (params, opt_state, state, rng), jnp.arange(num_steps)
-            )
+            (params, opt_state, state, rng, losses, mvecs) = jax.lax.fori_loop(
+                0, n_steps, body,
+                (params, opt_state, state, rng, losses0, mvecs0))
             if with_telemetry:
-                losses, mvecs = out
                 return params, opt_state, state, rng, losses, mvecs
-            return params, opt_state, state, rng, out
+            return params, opt_state, state, rng, losses
 
         donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
 
+    def _staged_executable(self, steps_cap: int, with_masks: bool,
+                           with_telemetry: bool, args):
+        """AOT-compiled multi-step executable from the process-wide compile
+        manager, keyed by the canonical abstract signature of ``args``."""
+        from ..runtime.compile_manager import get_compile_manager, signature
+
+        cm = get_compile_manager()
+        # token stays the key's FIRST element (drop_token matches on it)
+        key = (self._cm_token, "mln_multi_step",
+               signature(steps_cap, with_masks, with_telemetry, args))
+        return cm.aot(
+            key,
+            lambda: self._build_multi_step(steps_cap, with_masks,
+                                           with_telemetry),
+            args,
+        )
+
+    def _staged_args(self, xs, ys, steps, features_masks, labels_masks,
+                     real_batches):
+        """Shared fit_on_device/warmup plumbing: validate, canonicalize
+        scalars, and return ``(steps_cap, with_masks, n_steps, args)``."""
+        from ..runtime.compile_manager import next_pow2
+
+        num_slots = int(xs.shape[0])
+        if num_slots == 0:
+            raise ValueError("fit_on_device needs at least one staged batch")
+        _check_staged_counts(num_slots, (("ys", ys),
+                                         ("features_masks", features_masks),
+                                         ("labels_masks", labels_masks)))
+        n_real = num_slots if real_batches is None else int(real_batches)
+        if not 1 <= n_real <= num_slots:
+            raise ValueError(
+                f"real_batches={n_real} outside [1, {num_slots}]")
+        n_steps = int(steps) if steps is not None else n_real
+        # static loop/buffer bound: the staged window size, or the pow2
+        # bucket when cycling past it — so nearby step counts share programs
+        steps_cap = num_slots if n_steps <= num_slots else next_pow2(n_steps)
+        with_masks = features_masks is not None or labels_masks is not None
+        args = (self.params, self.opt_state, self.state, self._rng,
+                jnp.asarray(n_steps, jnp.int32),
+                jnp.asarray(n_real, jnp.int32),
+                xs, ys, features_masks, labels_masks)
+        return steps_cap, with_masks, n_steps, args
+
+    def warmup(self, xs, ys, steps: Optional[int] = None,
+               features_masks=None, labels_masks=None,
+               real_batches: Optional[int] = None) -> "MultiLayerNetwork":
+        """Compile-ahead: build the staged executable for this window shape
+        WITHOUT running a step, so the first training dispatch pays zero
+        compile latency. Arrays may be real data or ``jax.ShapeDtypeStruct``
+        shells — only shapes/dtypes matter. The compile lands in the same
+        cache (and telemetry counters) fit_on_device uses."""
+        self.init()
+        def _shell(a):
+            if a is None or isinstance(a, jax.ShapeDtypeStruct):
+                return a
+            a = np.asarray(a) if not hasattr(a, "dtype") else a
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+        steps_cap, with_masks, _, args = self._staged_args(
+            _shell(xs), _shell(ys), steps, _shell(features_masks),
+            _shell(labels_masks), real_batches)
+        self._staged_executable(steps_cap, with_masks,
+                                self.telemetry is not None, args)
+        return self
+
     def fit_on_device(self, xs, ys, steps: Optional[int] = None,
-                      features_masks=None, labels_masks=None) -> np.ndarray:
+                      features_masks=None, labels_masks=None,
+                      real_batches: Optional[int] = None) -> np.ndarray:
         """Run a whole training loop in ONE device dispatch (TPU-native fit).
 
         ``xs``/``ys``: stacked batches ``[K, B, ...]`` staged in HBM; step i
-        trains on batch ``i % K``. ``steps`` defaults to K (one pass). Returns
-        the per-step losses as a host array. Gradient-stats listeners are not
+        trains on batch ``i % real_batches``. ``real_batches`` (default K)
+        marks how many leading slots hold real data — trailing slots may be
+        dummy padding from the bucketed stager and are never indexed.
+        ``steps`` defaults to one pass over the real batches. Returns the
+        per-step losses as a host array. Gradient-stats listeners are not
         served by this path (use :meth:`fit`); ``iteration_done`` fires per
         step afterwards with the device-computed losses.
         """
@@ -399,45 +513,33 @@ class MultiLayerNetwork:
             raise ValueError("fit_on_device does not support TBPTT; use fit()")
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
-        num_batches = int(xs.shape[0])
-        if num_batches == 0:
-            raise ValueError("fit_on_device needs at least one staged batch")
-        _check_staged_counts(num_batches, (("ys", ys),
-                                           ("features_masks", features_masks),
-                                           ("labels_masks", labels_masks)))
-        n_steps = int(steps) if steps is not None else num_batches
-        with_masks = features_masks is not None or labels_masks is not None
+        fm = None if features_masks is None else jnp.asarray(features_masks)
+        lm = None if labels_masks is None else jnp.asarray(labels_masks)
         tel = self.telemetry
-        cache_key = (n_steps, num_batches,
-                     features_masks is not None, labels_masks is not None,
-                     tel is not None)
-        if getattr(self, "_multi_step_cache", None) is None:
-            self._multi_step_cache = {}
-        fn = self._multi_step_cache.get(cache_key)
-        if fn is None:
-            fn = self._build_multi_step(n_steps, num_batches, with_masks,
-                                        with_telemetry=tel is not None)
-            self._multi_step_cache[cache_key] = fn
+        steps_cap, with_masks, n_steps, args = self._staged_args(
+            xs, ys, steps, fm, lm, real_batches)
+        fn = self._staged_executable(steps_cap, with_masks, tel is not None,
+                                     args)
         t0 = time.perf_counter()
-        out = fn(
-            self.params, self.opt_state, self.state, self._rng, xs, ys,
-            None if features_masks is None else jnp.asarray(features_masks),
-            None if labels_masks is None else jnp.asarray(labels_masks),
-        )
+        out = fn(*args)
         mvecs = None
         if tel is not None:
             (self.params, self.opt_state, self.state, self._rng,
              losses, mvecs) = out
         else:
             self.params, self.opt_state, self.state, self._rng, losses = out
-        losses = np.asarray(losses)  # host fetch = the sync point
+        # host fetch = the sync point; the tail of the buffer (beyond
+        # n_steps) is sliced off HOST-side — a device-side slice would
+        # compile a tiny program per distinct step count
+        losses = np.asarray(losses)[:n_steps]
         elapsed = time.perf_counter() - t0
         if tel is not None:
-            # the scan stacked per-step metrics; ONE more (already-computed)
+            # the loop stacked per-step metrics; ONE more (already-computed)
             # fetch records the whole window — never a per-step sync
-            tel.on_staged(self.iteration + 1, mvecs,
+            tel.on_staged(self.iteration + 1, np.asarray(mvecs)[:n_steps],
                           per_step_time_s=elapsed / max(len(losses), 1))
         self.last_batch_size = int(xs.shape[1])
+        self.staged_steps_total += len(losses)
         # replayed callbacks arrive in a tight host loop; wall-clock deltas
         # between them measure nothing, so publish the dispatch's even
         # per-step share for throughput listeners (PerformanceListener)
@@ -452,26 +554,33 @@ class MultiLayerNetwork:
             self.staged_step_time = None
         return losses
 
-    def fit(self, data, epochs: int = 1,
-            stage_on_device: int = 0) -> "MultiLayerNetwork":
+    def fit(self, data, epochs: int = 1, stage_on_device: int = 0,
+            bucketing: bool = True) -> "MultiLayerNetwork":
         """Train (reference: MultiLayerNetwork.fit(DataSetIterator):917).
 
         ``data``: (x, y) tuple, a DataSet, or a DataSetIterator. Iterators are
         auto-wrapped in async prefetch (reference :920-924) unless already async.
 
-        ``stage_on_device=K`` (TPU fast path): buffer K equal-shape batches,
-        stack them in HBM, and run all K optimizer steps as ONE dispatch via
-        :meth:`fit_on_device`. Numerics are bit-identical to the default
-        per-batch path (same RNG chain); batches that can't join a full
-        uniform group (trailing stragglers, shape changes, mask-presence
-        changes) train per-batch, and gradient-stats listeners or TBPTT
-        disable staging since the scanned step can't serve them.
+        ``stage_on_device=K`` (TPU fast path): buffer K batches, stack them
+        in HBM, and run the whole window as ONE dispatch via
+        :meth:`fit_on_device`, double-buffered (window i+1's host→device
+        transfer overlaps window i's compute). With ``bucketing`` (default)
+        ragged batches stay on the staged path: trailing partial batches pad
+        up with masked zero rows, variable sequence lengths pad to
+        power-of-two time buckets, and a trailing partial window runs with a
+        device-scalar step count — all numerically equivalent on the real
+        elements (see datasets/bucketing.py; dropout draws differ in shape,
+        and models with BatchNormalization skip row padding because batch
+        statistics couple examples). ``bucketing=False`` restores the strict
+        legacy contract: only full uniform groups stage (bit-identical RNG
+        chain), everything ragged trains per-batch. Gradient-stats listeners
+        and TBPTT disable staging since the on-device loop can't serve them.
         """
         from ..datasets.iterators import DataSet, AsyncDataSetIterator, as_iterator
 
         self.init()
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._step_callable()
         stage = int(stage_on_device)
         if stage > 1 and (
             self.conf.backprop_type == "tbptt"
@@ -493,7 +602,7 @@ class MultiLayerNetwork:
             if getattr(it, "prefetch_supported", False):
                 it = AsyncDataSetIterator(it)
             if stage > 1:
-                self._fit_epoch_staged(it, stage)
+                self._fit_epoch_staged(it, stage, bucketing)
             else:
                 for ds in it:
                     self._fit_batch(ds)
@@ -505,51 +614,68 @@ class MultiLayerNetwork:
             self.telemetry.flush()  # drain a partial K-window at fit end
         return self
 
-    @staticmethod
-    def _stage_signature(ds):
-        """Batches may only share a staged group when shapes AND mask
-        presence match — otherwise np.stack would fail or mask semantics
-        would silently change."""
-        return (
-            np.shape(ds.features), np.shape(ds.labels),
-            getattr(ds, "features_mask", None) is not None,
-            getattr(ds, "labels_mask", None) is not None,
-        )
+    def _pad_examples_ok(self) -> bool:
+        """Row padding is exact only for per-example models; batch statistics
+        (BatchNormalization) couple rows, so such models keep exact batch
+        sizes (window padding with dummy slots stays on — never executed)."""
+        from .layers.normalization import BatchNormalization
 
-    def _fit_epoch_staged(self, it, stage: int) -> None:
-        """Group ``stage`` uniform batches per fit_on_device dispatch; any
-        batch that breaks uniformity (and the trailing partial group) trains
-        through the ordinary per-batch step, preserving order and numerics."""
-        group: list = []
-        sig = None
-        def flush_per_batch():
-            nonlocal group, sig
-            for ds in group:
-                self._fit_batch(ds)
-            group, sig = [], None
+        return not any(isinstance(l, BatchNormalization)
+                       for l in self.conf.layers)
 
-        def flush_staged():
-            nonlocal group, sig
-            xs = np.stack([np.asarray(d.features) for d in group])
-            ys = np.stack([np.asarray(d.labels) for d in group])
-            fm = (np.stack([np.asarray(d.features_mask) for d in group])
-                  if sig[2] else None)
-            lm = (np.stack([np.asarray(d.labels_mask) for d in group])
-                  if sig[3] else None)
-            self.fit_on_device(xs, ys, steps=stage,
-                               features_masks=fm, labels_masks=lm)
-            group, sig = [], None
+    def _fit_epoch_staged(self, it, stage: int, bucketing: bool = True) -> None:
+        """Stage windows of ``stage`` batches per fit_on_device dispatch via
+        the bucketed planner (datasets/bucketing.py), double-buffered: while
+        window i executes on device, window i+1 is host-stacked and
+        ``jax.device_put`` (async) so its H2D transfer overlaps compute.
+        Unstageable batches train through the ordinary per-batch step, in
+        stream order."""
+        from ..datasets.bucketing import BucketedStager
 
-        for ds in it:
-            s = self._stage_signature(ds)
-            if group and s != sig:
-                flush_per_batch()
-            sig = s
-            group.append(ds)
-            if len(group) == stage:
-                flush_staged()
-        if group:
-            flush_per_batch()
+        stager = BucketedStager(stage, bucketing=bucketing,
+                                pad_examples=self._pad_examples_ok())
+
+        def normalize(ds):
+            return ([np.asarray(ds.features)], [np.asarray(ds.labels)],
+                    [getattr(ds, "features_mask", None)],
+                    [getattr(ds, "labels_mask", None)])
+
+        def to_device(win):
+            put = jax.device_put  # async: overlaps the pending dispatch
+            win.features = [put(a) for a in win.features]
+            win.labels = [put(a) for a in win.labels]
+            if win.features_masks is not None:
+                win.features_masks = [None if m is None else put(m)
+                                      for m in win.features_masks]
+            if win.labels_masks is not None:
+                win.labels_masks = [None if m is None else put(m)
+                                    for m in win.labels_masks]
+            return win
+
+        def dispatch(win):
+            self.fit_on_device(
+                win.features[0], win.labels[0], steps=win.n_real,
+                features_masks=(None if win.features_masks is None
+                                else win.features_masks[0]),
+                labels_masks=(None if win.labels_masks is None
+                              else win.labels_masks[0]),
+                real_batches=win.n_real,
+            )
+
+        pending = None
+        for kind, payload in stager.plan(it, normalize):
+            if kind == "window":
+                staged = to_device(payload)
+                if pending is not None:
+                    dispatch(pending)
+                pending = staged
+            else:
+                if pending is not None:
+                    dispatch(pending)
+                    pending = None
+                self._fit_batch(payload)
+        if pending is not None:
+            dispatch(pending)
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])
@@ -572,7 +698,7 @@ class MultiLayerNetwork:
         mvec = None
         if self._wants_grad_stats():
             if self._grad_stats_step is None:
-                self._grad_stats_step = self._build_train_step(with_grad_stats=True)
+                self._grad_stats_step = self._step_callable("grad_stats")
             (self.params, self.opt_state, self.state, loss,
              self._last_grads, self._last_updates) = self._grad_stats_step(
                 self.params, self.opt_state, self.state, ds.features, ds.labels,
@@ -587,7 +713,7 @@ class MultiLayerNetwork:
                 mvec = _tdev.step_stats(loss, self._last_grads)
         elif tel is not None:
             if self._telemetry_step is None:
-                self._telemetry_step = self._build_train_step(with_telemetry=True)
+                self._telemetry_step = self._step_callable("telemetry")
             (self.params, self.opt_state, self.state, loss, mvec) = \
                 self._telemetry_step(
                     self.params, self.opt_state, self.state, ds.features,
@@ -825,7 +951,9 @@ class MultiLayerNetwork:
         params = list(self.params)
         params[layer_idx] = lp
         self.params = tuple(params)
-        self._train_step = None  # params object replaced; next fit re-traces
+        # params object replaced: retire the generation's executables so the
+        # next fit builds fresh ones (and the manager doesn't serve stale fns)
+        self._invalidate_compiled()
 
     # -------------------------------------------------------------- inference
     def output(self, x, train: bool = False, features_mask=None):

@@ -13,9 +13,17 @@ from .native_loader import (
     native_csv_read,
     native_idx_read,
 )
+from .compile_manager import (
+    CompileManager,
+    enable_persistent_cache,
+    get_compile_manager,
+)
 
 __all__ = [
+    "CompileManager",
     "NativeDataSetIterator",
+    "enable_persistent_cache",
+    "get_compile_manager",
     "native_available",
     "native_csv_read",
     "native_idx_read",

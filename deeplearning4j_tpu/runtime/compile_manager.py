@@ -1,0 +1,258 @@
+"""Recompile-elimination compile manager: one executable per abstract shape.
+
+The staged fit path (``fit_on_device``'s multi-step loop) used to bake the
+step count and staged-batch count into the traced program: every distinct
+``(steps, num_batches, masks, telemetry)`` tuple silently paid a fresh XLA
+compile — on a tunnel-attached TPU that is seconds of dead time per shape,
+and a ragged data stream produces many shapes. This module is the other half
+of the fix (``datasets/bucketing.py`` canonicalizes the *data* shapes):
+
+- **Canonical keys.** Executables are cached by the *abstract* signature of
+  their arguments (shape/dtype/pytree structure — ``signature()``), never by
+  Python values. Step and batch counts are passed as device ``int32`` scalars
+  (the jitted loop is a ``lax.fori_loop`` with a traced trip count), so
+  changing ``steps`` or the number of real staged batches reuses ONE
+  executable.
+- **AOT compile, measured.** Programs go through ``jax.jit(...).lower()
+  .compile()`` explicitly, so every compile is a visible, timed event:
+  ``dl4jtpu_compiles_total`` and the ``dl4jtpu_compile_seconds`` histogram
+  land in the PR 2 telemetry registry next to the step metrics they explain.
+- **Bounded.** The cache is an LRU with a hard entry bound and an eviction
+  counter (``dl4jtpu_compile_cache_evictions_total``) — a long-running job
+  cycling through shapes can no longer leak executables the way the old
+  per-net ``_multi_step_cache`` dicts did.
+- **Compile-ahead.** ``aot(..., execute=False)`` / the networks' ``warmup``
+  methods compile before the first optimizer step, moving compile latency
+  out of the training-time critical path.
+- **Persistent cache.** ``enable_persistent_cache()`` wires
+  ``jax_compilation_cache_dir`` (env knob ``DL4JTPU_XLA_CACHE_DIR``) so a
+  process restart pays disk-cache hits, not recompiles.
+
+Host-side only: nothing here touches device buffers; the manager stores the
+compiled callables and the telemetry counters that describe them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = [
+    "CompileManager",
+    "get_compile_manager",
+    "enable_persistent_cache",
+    "signature",
+    "next_pow2",
+]
+
+# env knob: set to a directory to enable jax's persistent compilation cache
+# for every manager-compiled program (see docs/performance.md)
+CACHE_DIR_ENV = "DL4JTPU_XLA_CACHE_DIR"
+
+# compile times span ~0.1s (tiny CPU programs) to minutes (ResNet on the
+# tunnel backend) — wider than the step-time default buckets
+COMPILE_TIME_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                       60.0, 120.0, 300.0)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1). The step/window bucket function:
+    padding loop bounds and staged-window sizes to powers of two keeps the
+    set of compiled programs logarithmic in the sizes actually seen."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def _leaf_sig(x: Any):
+    """One leaf's contribution to a canonical key. Arrays reduce to
+    (shape, dtype, weak_type) — exactly what decides whether an AOT
+    executable can be reused; everything else must be hashable."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("arr", tuple(x.shape), str(x.dtype),
+                bool(getattr(x, "weak_type", False)))
+    return x
+
+
+def signature(*parts) -> Tuple:
+    """Canonical cache key from arbitrary parts (hashables and/or pytrees of
+    arrays — ``jax.ShapeDtypeStruct``s count as arrays, so warmup and live
+    calls produce identical keys)."""
+    import jax  # noqa: PLC0415 - keep module import light
+
+    flat, treedef = jax.tree_util.tree_flatten(parts)
+    return (tuple(_leaf_sig(l) for l in flat), str(treedef))
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    the ``DL4JTPU_XLA_CACHE_DIR`` env var). Returns True when enabled. A
+    process restart then re-reads compiled programs from disk instead of
+    recompiling — the cross-process complement of the in-process LRU."""
+    cache_dir = cache_dir or os.environ.get(CACHE_DIR_ENV)
+    if not cache_dir:
+        return False
+    import jax  # noqa: PLC0415
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        return True
+    except Exception:
+        return False  # older jaxlib without the knob: in-process LRU only
+
+
+class CompileManager:
+    """Process-wide LRU of compiled/jitted programs, telemetry-instrumented.
+
+    Two entry kinds share one LRU:
+
+    - ``aot(key, build, args)``: ``build()`` returns a *jitted* callable; the
+      manager ``lower(*args).compile()``s it once per canonical key and
+      returns the compiled executable (counted + timed as a compile event).
+    - ``callable(key, build)``: ``build()`` returns a callable (typically a
+      ``jax.jit`` wrapper whose shapes vary per call, e.g. the per-batch
+      train step); the manager only deduplicates and bounds it.
+
+    Keys should start with a per-owner token (``new_token()``) so retiring an
+    owner (``drop_token``) evicts its entries eagerly instead of waiting for
+    LRU pressure.
+    """
+
+    def __init__(self, max_entries: int = 64, registry=None):
+        if int(max_entries) < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = int(max_entries)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._token_counter = 0
+        if registry is None:
+            from ..telemetry import get_registry  # noqa: PLC0415
+
+            registry = get_registry()
+        self.compiles = registry.counter(
+            "dl4jtpu_compiles_total",
+            "XLA programs compiled through the compile manager")
+        self.compile_time = registry.histogram(
+            "dl4jtpu_compile_seconds",
+            "wall time of manager-issued lower().compile() calls",
+            buckets=COMPILE_TIME_BUCKETS)
+        self.cache_hits = registry.counter(
+            "dl4jtpu_compile_cache_hits_total",
+            "executable lookups served from the in-process cache")
+        self.evictions = registry.counter(
+            "dl4jtpu_compile_cache_evictions_total",
+            "executables dropped by the LRU bound or owner retirement")
+        self.cache_size = registry.gauge(
+            "dl4jtpu_compile_cache_size",
+            "executables currently held by the compile manager")
+
+    # ------------------------------------------------------------- tokens
+    def new_token(self) -> Tuple[str, int]:
+        """Fresh owner token; prefix cache keys with it so ``drop_token``
+        can retire every executable built for one network generation."""
+        with self._lock:
+            self._token_counter += 1
+            return ("cm-token", self._token_counter)
+
+    def drop_token(self, token) -> int:
+        """Evict every entry whose key starts with ``token``; returns the
+        count. Called by the networks on re-init (new optimizer closure =
+        stale executables)."""
+        if token is None:
+            return 0
+        with self._lock:
+            stale = [k for k in self._entries
+                     if isinstance(k, tuple) and k and k[0] == token]
+            for k in stale:
+                del self._entries[k]
+            if stale:
+                self.evictions.inc(len(stale))
+            self.cache_size.set(len(self._entries))
+            return len(stale)
+
+    # -------------------------------------------------------------- cache
+    def _get(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.cache_hits.inc()
+            return entry
+
+    def _put(self, key, value):
+        with self._lock:
+            # a racing compile of the same key: keep the first, count ours
+            # as the loser (both compiles already happened and were counted)
+            existing = self._entries.get(key)
+            if existing is not None:
+                self._entries.move_to_end(key)
+                return existing
+            self._entries[key] = value
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions.inc()
+            self.cache_size.set(len(self._entries))
+            return value
+
+    def aot(self, key: Tuple, build: Callable[[], Any], args) -> Any:
+        """Compiled executable for ``key``; on miss, ``build()`` must return
+        a jitted callable which is AOT-lowered against ``args`` (concrete
+        arrays or ``ShapeDtypeStruct``s) and compiled — the compile is
+        counted and timed. The returned executable accepts exactly the
+        signature of ``args``."""
+        entry = self._get(key)
+        if entry is not None:
+            return entry
+        t0 = time.perf_counter()
+        compiled = build().lower(*args).compile()
+        self.compile_time.observe(time.perf_counter() - t0)
+        self.compiles.inc()
+        return self._put(key, compiled)
+
+    def callable(self, key: Tuple, build: Callable[[], Any]) -> Any:
+        """Deduplicated callable for ``key`` (no AOT compile here — the
+        callable is typically ``jax.jit``-wrapped and compiles lazily per
+        shape)."""
+        entry = self._get(key)
+        if entry is not None:
+            return entry
+        return self._put(key, build())
+
+    # -------------------------------------------------------------- stats
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        """Host-side snapshot for bench artifacts / debugging."""
+        with self._lock:
+            size = len(self._entries)
+        return {
+            "entries": size,
+            "max_entries": self.max_entries,
+            "compiles_total": self.compiles.value,
+            "cache_hits_total": self.cache_hits.value,
+            "evictions_total": self.evictions.value,
+            "compile_seconds": self.compile_time.summary(),
+        }
+
+
+_GLOBAL: Optional[CompileManager] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_compile_manager() -> CompileManager:
+    """The process-wide manager (both network classes and the bench share
+    it). First call also wires the persistent compilation cache when the
+    ``DL4JTPU_XLA_CACHE_DIR`` env knob is set."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            enable_persistent_cache()
+            _GLOBAL = CompileManager()
+        return _GLOBAL

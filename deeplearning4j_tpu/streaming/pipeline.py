@@ -140,20 +140,33 @@ class StreamingPipeline:
     homogeneous) and every route sees each micro-batch. ``linger`` bounds the
     wait before a short batch is flushed, keeping latency bounded like the
     reference's Camel aggregator timeouts.
+
+    ``device_prefetch``: stage each assembled micro-batch into device memory
+    (``jax.device_put`` — asynchronous) the moment it is built, BEFORE the
+    routes run. The H2D transfer of batch i then overlaps the routes'
+    device compute on batch i-1 (whose dispatches are still draining — the
+    fit/output steps never block the host), the same double-buffering the
+    staged fit path uses. Host-only routes still work: device arrays
+    np.asarray back transparently.
     """
 
     def __init__(self, source: RecordSource, routes: Sequence[Route],
-                 batch: int = 32, linger: float = 0.5, registry=None):
+                 batch: int = 32, linger: float = 0.5, registry=None,
+                 device_prefetch: bool = False):
         from ..telemetry import get_registry  # noqa: PLC0415
 
         self.source = source
         self.routes = list(routes)
         self.batch = int(batch)
         self.linger = float(linger)
+        self.device_prefetch = bool(device_prefetch)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         reg = registry if registry is not None else get_registry()
+        self._m_staged = reg.counter(
+            "dl4jtpu_streaming_device_staged_total",
+            "micro-batches device_put ahead of route dispatch")
         self._m_records = reg.counter(
             "dl4jtpu_streaming_records_total",
             "records consumed from the source")
@@ -229,6 +242,15 @@ class StreamingPipeline:
         labels = None
         if buf[0][1] is not None:
             labels = np.stack([l for _, l in buf])
+        if self.device_prefetch:
+            import jax  # noqa: PLC0415
+
+            # async H2D: overlaps the previous batch's still-draining route
+            # dispatches; routes receive committed device arrays
+            feats = jax.device_put(feats)
+            if labels is not None:
+                labels = jax.device_put(labels)
+            self._m_staged.inc()
         for route in self.routes:
             route.on_batch(feats, labels)
         self._m_records.inc(len(buf))

@@ -16,6 +16,16 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ 
 echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
 
+echo "== dl4jtpu-check: compile/bucketing modules held to --fail-on warning"
+env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
+    deeplearning4j_tpu/runtime/compile_manager.py \
+    deeplearning4j_tpu/datasets/bucketing.py \
+    --fail-on warning
+
+echo "== compile-count smoke: varying steps/tails must not recompile"
+env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_compile_manager.py::TestRecompileElimination
+
 echo "== /metrics smoke scrape (in-process UI server)"
 env JAX_PLATFORMS=cpu python - <<'PY'
 import urllib.request

@@ -183,6 +183,122 @@ class TestGraphRules:
 
 
 # --------------------------------------------------------------------------
+# DT009: cross-device transfer detection (graph half on live params, AST
+# half on device_put-in-jit — the line-anchored form pragmas can suppress)
+# --------------------------------------------------------------------------
+class TestDt009:
+    def _two_vertex_net(self):
+        from deeplearning4j_tpu.nn.graph.computation_graph import (
+            ComputationGraph,
+        )
+
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(16))
+            .add_layer("a", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("b", OutputLayer(n_out=8, activation="softmax"), "a")
+            .set_outputs("b")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    def test_clean_single_device_net_has_no_findings(self):
+        from deeplearning4j_tpu.analysis import check_shardings
+
+        assert check_shardings(self._two_vertex_net()) == []
+
+    def test_consecutive_vertices_on_different_devices_fire(self):
+        import jax
+
+        from deeplearning4j_tpu.analysis import check_shardings
+
+        devs = jax.devices()
+        assert len(devs) >= 2  # conftest forces an 8-device CPU mesh
+        net = self._two_vertex_net()
+        net.params = {
+            "a": jax.device_put(net.params["a"], devs[0]),
+            "b": jax.device_put(net.params["b"], devs[1]),
+        }
+        findings = check_shardings(net, source="nets/split.json")
+        hits = [f for f in findings if f.rule_id == "DT009"]
+        assert hits, findings
+        assert hits[0].severity == "warning"
+        assert "vertex 'a' -> vertex 'b'" in hits[0].context
+        assert hits[0].location == "nets/split.json:vertex 'a' -> vertex 'b'"
+
+    def test_vertex_with_mixed_internal_placement_fires(self):
+        import jax
+
+        from deeplearning4j_tpu.analysis import check_shardings
+
+        devs = jax.devices()
+        net = self._two_vertex_net()
+        mixed = dict(net.params["a"])
+        mixed["W"] = jax.device_put(mixed["W"], devs[1])
+        mixed["b"] = jax.device_put(mixed["b"], devs[0])
+        net.params = {"a": mixed, "b": net.params["b"]}
+        msgs = [f.message for f in check_shardings(net)
+                if f.rule_id == "DT009"]
+        assert any("span" in m for m in msgs), msgs
+
+    def test_multilayer_net_edges_checked(self):
+        import jax
+
+        from deeplearning4j_tpu import (
+            MultiLayerNetwork,
+        )
+        from deeplearning4j_tpu.analysis import check_shardings
+
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=16, activation="relu"),
+                    OutputLayer(n_out=8, activation="softmax")],
+            input_type=InputType.feed_forward(16),
+        )
+        net = MultiLayerNetwork(conf).init()
+        assert check_shardings(net) == []
+        devs = jax.devices()
+        net.params = (jax.device_put(net.params[0], devs[0]),
+                      jax.device_put(net.params[1], devs[1]))
+        hits = [f for f in check_shardings(net) if f.rule_id == "DT009"]
+        assert hits and "layer[0] -> layer[1]" in hits[0].context
+
+    def test_sharded_on_one_mesh_is_clean(self):
+        """GSPMD-sharded params over ONE mesh are the supported layout —
+        not a cross-device transfer."""
+        import jax
+
+        from deeplearning4j_tpu.analysis import check_shardings
+        from deeplearning4j_tpu.parallel import make_mesh
+        from deeplearning4j_tpu.parallel.sharding import shard_params
+
+        net = self._two_vertex_net()
+        mesh = make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+        shard_params(net, mesh, model_axis="model")
+        assert check_shardings(net) == []
+
+    def test_ast_device_put_in_jit_fires_and_pragma_suppresses(self):
+        src = (
+            "import jax\n@jax.jit\ndef step(x):\n"
+            "    return jax.device_put(x, jax.devices()[1])\n"
+        )
+        assert "DT009" in _ids(check_source(src, "t.py"))
+        suppressed = (
+            "import jax\n@jax.jit\ndef step(x):\n"
+            "    return jax.device_put(x, jax.devices()[1])"
+            "  # dl4jtpu: ignore[DT009]\n"
+        )
+        assert check_source(suppressed, "t.py") == []
+
+    def test_ast_device_put_outside_jit_is_clean(self):
+        src = (
+            "import jax\ndef stage(batch):\n"
+            "    return jax.device_put(batch)\n"
+        )
+        assert check_source(src, "t.py") == []
+
+
+# --------------------------------------------------------------------------
 # AST pass
 # --------------------------------------------------------------------------
 _CLEAN_SRC = textwrap.dedent("""
@@ -267,7 +383,7 @@ class TestAstRules:
     def test_every_shipped_graph_rule_has_a_fixture(self):
         graph_rules = {r for r, rule in RULES.items() if rule.scope == "graph"}
         assert graph_rules == {"DT001", "DT002", "DT003", "DT004", "DT005",
-                               "DT006", "DT007"}
+                               "DT006", "DT007", "DT009"}
 
     def test_wrap_call_marks_jit_body(self):
         src = (

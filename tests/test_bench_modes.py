@@ -22,6 +22,23 @@ def test_word2vec_mode_row():
     assert np.isfinite(r["value"])
 
 
+def test_ragged_mode_row():
+    r = bench.bench_ragged(batch=32, tail=13, full_batches=3, stage=2,
+                           epochs=2, hidden=64)
+    assert r["metric"] == "ragged_epoch_bucketed_train_samples_per_sec"
+    assert r["value"] > 0 and r["unbucketed"]["samples_per_sec"] > 0
+    # the acceptance bar: >= 95% of steps staged with bucketing (100% here),
+    # and the warm epochs pay zero new compiles
+    assert r["bucketed"]["staged_fraction"] >= 0.95
+    assert r["bucketed"]["warm_epoch_compiles"] == 0
+    # without bucketing the ragged tail falls back per-batch every epoch
+    assert r["unbucketed"]["staged_fraction"] < 1.0
+    tel = r["telemetry"]
+    assert tel["bench_compiles_total"] >= 1
+    assert "compile" in tel and tel["compile"]["compiles_total"] >= 1
+    assert tel["compile"]["compile_seconds"]["count"] >= 1
+
+
 def test_real_text_corpus_is_real_english():
     sents = bench._real_text_sequences(min_words=5000)
     words = [w for s in sents for w in s]

@@ -1,0 +1,347 @@
+"""Bucketed padded staging (ISSUE 3): ragged data stays on the staged path.
+
+Acceptance pins:
+- a ragged epoch (trailing partial batch every epoch) runs >= 95% of its
+  optimizer steps through fit_on_device (it's 100% here), with ZERO new
+  compiles after the first epoch;
+- padded/bucketed training matches unpadded per-batch training on the real
+  elements to float32 tolerance, for dense AND recurrent (masked-timestep)
+  models, on both MultiLayerNetwork and ComputationGraph.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (
+    BatchNormalization,
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    RnnOutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.bucketing import (
+    BucketedStager,
+    pad_batch_arrays,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+
+def _tree_allclose(a, b, atol=2e-5, rtol=1e-4):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=atol, rtol=rtol)
+
+
+def _mlp_conf(seed=41):
+    return MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(5),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )
+
+
+def _ragged_batches(n_full=7, b=8, tail=5, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def mk(rows):
+        return DataSet(
+            rng.normal(size=(rows, 5)).astype(np.float32),
+            np.eye(3, dtype=np.float32)[rng.integers(0, 3, rows)],
+        )
+
+    return [mk(b) for m in range(n_full)] + [mk(tail)]
+
+
+def _rnn_conf(seed=11):
+    return MultiLayerConfiguration(
+        layers=[
+            GravesLSTM(n_out=8, activation="tanh"),
+            RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(4),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )
+
+
+def _ragged_seq_batches(seed=3):
+    """Sequence batches with ragged time lengths AND a ragged tail batch."""
+    rng = np.random.default_rng(seed)
+    batches = []
+    for b, t in [(6, 7), (6, 7), (6, 5), (6, 5), (4, 5)]:
+        x = rng.normal(size=(b, t, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (b, t))]
+        batches.append(DataSet(x, y))
+    return batches
+
+
+# --------------------------------------------------------------------------
+# padding primitives
+# --------------------------------------------------------------------------
+class TestPadBatchArrays:
+    def test_dense_row_padding_masks_and_dtypes(self):
+        x = np.ones((3, 5), np.float32)
+        y = np.ones((3, 2), np.float32)
+        xf, yf, fm, lm = pad_batch_arrays(x, y, None, None, target_b=8)
+        assert xf.shape == (8, 5) and yf.shape == (8, 2)
+        assert xf.dtype == np.float32 and yf.dtype == np.float32
+        assert fm is None  # dense features carry no features mask
+        np.testing.assert_array_equal(lm, [1, 1, 1, 0, 0, 0, 0, 0])
+        assert not xf[3:].any()
+
+    def test_no_padding_no_masks(self):
+        x, y = np.ones((4, 5)), np.ones((4, 2))
+        xf, yf, fm, lm = pad_batch_arrays(x, y, None, None, target_b=4)
+        assert fm is None and lm is None
+
+    def test_sequence_row_and_time_padding(self):
+        x = np.ones((2, 5, 4), np.float32)
+        y = np.ones((2, 5, 3), np.float32)
+        xf, yf, fm, lm = pad_batch_arrays(x, y, None, None, target_b=4,
+                                          target_t=8)
+        assert xf.shape == (4, 8, 4) and yf.shape == (4, 8, 3)
+        assert fm.shape == (4, 8) and lm.shape == (4, 8)
+        assert fm[:2, :5].all() and not fm[2:].any() and not fm[:, 5:].any()
+
+    def test_existing_mask_extends_with_zeros(self):
+        x = np.ones((2, 5, 4), np.float32)
+        y = np.ones((2, 5, 3), np.float32)
+        m = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        _, _, fm, lm = pad_batch_arrays(x, y, m, m, target_b=3, target_t=8)
+        np.testing.assert_array_equal(fm[:2, :5], m)
+        assert not fm[2].any() and not fm[:, 5:].any()
+        np.testing.assert_array_equal(fm, lm)
+
+
+class TestStagerPlan:
+    def test_ragged_stream_is_fully_staged(self):
+        stager = BucketedStager(3)
+        norm = lambda ds: ([ds.features], [ds.labels],  # noqa: E731
+                           [ds.features_mask], [ds.labels_mask])
+        events = list(stager.plan(_ragged_batches(), norm))
+        kinds = [k for k, _ in events]
+        assert kinds == ["window", "window", "window"]
+        n_reals = [w.n_real for _, w in events]
+        assert n_reals == [3, 3, 2]
+        tail = events[-1][1]
+        # tail window: 2 real batches (one row-padded), labels mask present
+        assert tail.features[0].shape[0] == 2
+        assert tail.labels_masks is not None
+
+    def test_legacy_mode_matches_old_contract(self):
+        stager = BucketedStager(3, bucketing=False)
+        norm = lambda ds: ([ds.features], [ds.labels],  # noqa: E731
+                           [ds.features_mask], [ds.labels_mask])
+        events = list(stager.plan(_ragged_batches(), norm))
+        kinds = [k for k, _ in events]
+        # 7 full + 1 ragged: two full windows, then the straggler group
+        # (incl. the odd-size tail) falls back per batch
+        assert kinds == ["window", "window", "batch", "batch"]
+
+    def test_oversize_batch_starts_new_group(self):
+        stager = BucketedStager(2)
+        rng = np.random.default_rng(1)
+
+        def mk(rows):
+            return DataSet(rng.normal(size=(rows, 5)).astype(np.float32),
+                           np.eye(3, dtype=np.float32)[rng.integers(0, 3, rows)])
+
+        events = list(stager.plan(
+            [mk(4), mk(8), mk(8)],
+            lambda ds: ([ds.features], [ds.labels],
+                        [ds.features_mask], [ds.labels_mask])))
+        # the 4-row leader can't absorb an 8-row batch: [4] then [8, 8]
+        assert [(k, w.n_real if k == "window" else None)
+                for k, w in events] == [("window", 1), ("window", 2)]
+
+
+# --------------------------------------------------------------------------
+# acceptance: parity + staged fraction + compile stability
+# --------------------------------------------------------------------------
+class TestRaggedEpochAcceptance:
+    def test_mln_ragged_epochs_fully_staged_no_recompiles(self):
+        batches = _ragged_batches()
+        plain = MultiLayerNetwork(_mlp_conf()).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=3)
+
+        cm = get_compile_manager()
+        staged = MultiLayerNetwork(_mlp_conf()).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=1,
+                   stage_on_device=3)
+        after_first = cm.compiles.value
+        staged.fit(ListDataSetIterator(list(batches)), epochs=2,
+                   stage_on_device=3)
+        assert cm.compiles.value == after_first  # warm epochs: 0 compiles
+
+        assert staged.iteration == plain.iteration == 24
+        # the ragged-epoch acceptance bar is >= 95%; bucketing stages all
+        assert staged.staged_steps_total / staged.iteration >= 0.95
+        assert staged.staged_steps_total == staged.iteration
+        _tree_allclose(staged.params, plain.params)
+        _tree_allclose(staged.opt_state, plain.opt_state)
+
+    def test_mln_recurrent_ragged_lengths_parity(self):
+        batches = _ragged_seq_batches()
+        plain = MultiLayerNetwork(_rnn_conf()).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+
+        staged = MultiLayerNetwork(_rnn_conf()).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=2,
+                   stage_on_device=2)
+        assert staged.iteration == plain.iteration
+        assert staged.staged_steps_total == staged.iteration
+        _tree_allclose(staged.params, plain.params, atol=5e-5)
+
+    def test_mln_premasked_sequences_parity(self):
+        """Batches that already carry masks compose with synthesized padding
+        masks (extension, not replacement)."""
+        rng = np.random.default_rng(8)
+        batches = []
+        for b in (6, 6, 3):
+            x = rng.normal(size=(b, 7, 4)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (b, 7))]
+            m = (rng.random((b, 7)) > 0.25).astype(np.float32)
+            m[:, 0] = 1.0  # at least one real step per row
+            batches.append(DataSet(x, y, features_mask=m, labels_mask=m))
+        plain = MultiLayerNetwork(_rnn_conf(seed=5)).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+        staged = MultiLayerNetwork(_rnn_conf(seed=5)).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=2,
+                   stage_on_device=3)
+        assert staged.staged_steps_total == staged.iteration == 6
+        _tree_allclose(staged.params, plain.params, atol=5e-5)
+
+    def test_graph_ragged_epochs_parity_and_staging(self):
+        from deeplearning4j_tpu.nn.conf.computation_graph import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph.computation_graph import (
+            ComputationGraph,
+        )
+
+        def conf():
+            return (
+                ComputationGraphConfiguration.builder()
+                .seed(43)
+                .updater(UpdaterConfig(updater="adam", learning_rate=1e-2))
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=12, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5))
+                .build()
+            )
+
+        batches = _ragged_batches(n_full=4, tail=3, seed=9)
+        plain = ComputationGraph(conf()).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+
+        cm = get_compile_manager()
+        staged = ComputationGraph(conf()).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=1,
+                   stage_on_device=2)
+        after_first = cm.compiles.value
+        staged.fit(ListDataSetIterator(list(batches)), epochs=1,
+                   stage_on_device=2)
+        assert cm.compiles.value == after_first
+        assert staged.iteration == plain.iteration == 10
+        assert staged.staged_steps_total == staged.iteration
+        _tree_allclose(staged.params, plain.params)
+        _tree_allclose(staged.opt_state, plain.opt_state)
+
+    def test_graph_recurrent_masked_staged_parity(self):
+        from deeplearning4j_tpu.nn.conf.computation_graph import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph.computation_graph import (
+            ComputationGraph,
+        )
+
+        def conf():
+            return (
+                ComputationGraphConfiguration.builder()
+                .seed(6)
+                .updater(UpdaterConfig(updater="adam", learning_rate=1e-2))
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"),
+                           "in")
+                .add_layer("out", RnnOutputLayer(n_out=3,
+                                                 activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4))
+                .build()
+            )
+
+        batches = _ragged_seq_batches(seed=12)
+        plain = ComputationGraph(conf()).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=2)
+        staged = ComputationGraph(conf()).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=2,
+                   stage_on_device=2)
+        assert staged.iteration == plain.iteration
+        assert staged.staged_steps_total == staged.iteration
+        _tree_allclose(staged.params, plain.params, atol=5e-5)
+
+    def test_batchnorm_model_skips_row_padding(self):
+        """BN couples examples through batch stats: ragged batches must NOT
+        be row-padded (they'd train on different statistics). The odd-size
+        tail batch still stages — as its own window at its own exact batch
+        size — so numerics match the plain path exactly."""
+        conf = MultiLayerConfiguration(
+            layers=[
+                DenseLayer(n_out=16, activation="relu"),
+                BatchNormalization(),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.feed_forward(5),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=2,
+        )
+        batches = _ragged_batches(n_full=4, tail=5, seed=4)
+        plain = MultiLayerNetwork(
+            MultiLayerConfiguration.from_dict(conf.to_dict())).init()
+        plain.fit(ListDataSetIterator(list(batches)), epochs=1)
+
+        staged = MultiLayerNetwork(conf).init()
+        staged.fit(ListDataSetIterator(list(batches)), epochs=1,
+                   stage_on_device=2)
+        assert staged.iteration == 5
+        # 2 full windows + the 5-row tail as its own unpadded window: no
+        # batch was ever row-padded, yet everything stayed on-device
+        assert staged.staged_steps_total == 5
+        tail_events = [
+            (k, w.n_real if k == "window" else None)
+            for k, w in BucketedStager(2, pad_examples=False).plan(
+                list(batches),
+                lambda ds: ([np.asarray(ds.features)],
+                            [np.asarray(ds.labels)], [None], [None]))
+        ]
+        assert tail_events == [("window", 2), ("window", 2), ("window", 1)]
+        _tree_allclose(staged.params, plain.params)
+
+    def test_bucketing_off_restores_legacy_numerics(self):
+        """fit(..., bucketing=False) must reproduce the pre-bucketing
+        behavior bit-for-bit (same RNG chain, stragglers per-batch)."""
+        batches = _ragged_batches()
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit(ListDataSetIterator(list(batches)), epochs=2)
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit(ListDataSetIterator(list(batches)), epochs=2,
+              stage_on_device=3, bucketing=False)
+        assert b.staged_steps_total == 12  # 2 epochs x 2 full windows x 3
+        _tree_allclose(b.params, a.params, atol=1e-6, rtol=1e-5)

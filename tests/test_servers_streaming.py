@@ -168,6 +168,39 @@ def test_streaming_train_and_serve_routes():
     assert net.score(DataSet(feats, labels)) < s0
 
 
+def test_streaming_device_prefetch_stages_batches():
+    """device_prefetch=True hands routes COMMITTED device arrays (the H2D
+    transfer was issued before route dispatch, overlapping the previous
+    batch's compute) and counts staged batches in the registry."""
+    import jax
+
+    from deeplearning4j_tpu.streaming import QueueSource, Route, StreamingPipeline
+    from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+    class Collect(Route):
+        def __init__(self):
+            self.batches = []
+
+        def on_batch(self, features, labels):
+            self.batches.append((features, labels))
+
+    reg = MetricsRegistry()
+    source = QueueSource()
+    route = Collect()
+    with StreamingPipeline(source, [route], batch=4, linger=0.1,
+                           registry=reg, device_prefetch=True):
+        for i in range(8):
+            source.put(np.ones(3, np.float32), np.ones(2, np.float32))
+        deadline = time.time() + 15
+        while len(route.batches) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    assert len(route.batches) >= 2
+    feats, labels = route.batches[0]
+    assert isinstance(feats, jax.Array) and isinstance(labels, jax.Array)
+    np.testing.assert_array_equal(np.asarray(feats), np.ones((4, 3)))
+    assert reg.get("dl4jtpu_streaming_device_staged_total").value >= 2
+
+
 def test_streaming_linger_flushes_short_batch():
     from deeplearning4j_tpu.streaming import QueueSource, StreamingPipeline, Route
 
