@@ -84,6 +84,36 @@ def _telemetry_block(step_times_s, mfu_pct=None, extra_gauges=None) -> dict:
     return block
 
 
+def _memory_block(net=None, example=None) -> dict:
+    """Per-mode HBM accounting for the BENCH_* artifact: executable bytes
+    from the compile cache's XLA memory_analysis records, live device
+    stats, and — when a net is at hand — the projected peak vs the live
+    peak plus the top-3 layer consumers (telemetry/memory.py). Defensive:
+    a broken collector yields an {"error": ...} block, never a lost metric
+    line."""
+    try:
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            get_compile_manager,
+        )
+        from deeplearning4j_tpu.telemetry import memory as tmem
+
+        block: dict = {
+            "executables": get_compile_manager().stats()["memory"],
+            "devices": tmem.device_memory_stats(),
+        }
+        live_peaks = [d.get("peak_bytes_in_use") for d in block["devices"]
+                      if d.get("peak_bytes_in_use")]
+        block["live_peak_bytes"] = max(live_peaks) if live_peaks else None
+        if net is not None:
+            rep = tmem.memory_report(net, example)
+            block["projected_peak_bytes"] = \
+                rep["totals"]["projected_peak_bytes"]
+            block["top_layers"] = rep["top_consumers"]
+        return block
+    except Exception as e:  # noqa: BLE001 - the metric line must survive
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     """ResNet-50 training throughput + step breakdown + XLA-reported MFU.
 
@@ -178,6 +208,7 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     result["telemetry"] = _telemetry_block(
         [step_s], mfu_pct=result.get("mfu_pct"),
         extra_gauges={"bench_images_per_sec": result["value"]})
+    result["memory"] = _memory_block(net, batch)
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # optional deep dive: xplane trace of one scanned run
         with profiler.trace(trace_dir):
@@ -272,6 +303,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     result["telemetry"] = _telemetry_block(
         [t / steps for t in times], mfu_pct=result.get("mfu_pct"),
         extra_gauges={"bench_chars_per_sec": result["value"]})
+    result["memory"] = _memory_block(net, np.zeros((batch, seq, vocab),
+                                                   np.float32))
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
         with profiler.trace(trace_dir):
@@ -369,6 +402,7 @@ def bench_word2vec(layer_size: int = 128, negative: int = 5,
             [dt / max(n_calls, 1)],
             extra_gauges={"bench_words_per_sec": round(n_words / dt, 1),
                           "bench_pairs_per_sec": round(n_pairs / dt, 1)}),
+        "memory": _memory_block(),  # no layered net: cache + live stats only
     }
 
 
@@ -434,6 +468,7 @@ def bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
         "telemetry": _telemetry_block(
             [dt_flash / steps],
             extra_gauges={"bench_tokens_per_sec": round(tokens / dt_flash, 1)}),
+        "memory": _memory_block(),  # raw-kernel mode: cache + live stats only
     }
 
 
@@ -492,6 +527,7 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
             [dt / steps],
             extra_gauges={"bench_samples_per_sec": round(steps * batch / dt, 1),
                           "bench_last_grad_norm": round(grad_norm.value, 6)}),
+        "memory": _memory_block(net, batch),
     }
     return result
 
@@ -587,6 +623,7 @@ def bench_ragged(batch: int = 512, tail: int = 196, full_batches: int = 10,
             "bench_compile_seconds_sum": cm_stats["compile_seconds"]["sum"],
         })
     result["telemetry"]["compile"] = cm_stats
+    result["memory"] = _memory_block(make_net(), batch)
     return result
 
 

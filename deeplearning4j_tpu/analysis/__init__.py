@@ -27,6 +27,7 @@ from .graph_checks import (
     check_multi_layer,
     check_graph,
     check_config,
+    check_partition_specs,
     check_shardings,
 )
 from .ast_checks import check_source, check_file
@@ -43,6 +44,7 @@ __all__ = [
     "check_multi_layer",
     "check_graph",
     "check_config",
+    "check_partition_specs",
     "check_shardings",
     "check_source",
     "check_file",

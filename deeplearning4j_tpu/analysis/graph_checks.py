@@ -263,6 +263,106 @@ def check_graph(conf, *, batch: int = DEFAULT_BATCH,
     return findings
 
 
+# ------------------------------------------------------------ DT008 check
+def _spec_axis_names(spec) -> List[str]:
+    """Axis names referenced by a PartitionSpec, flattened (an entry may be
+    None, one name, or a tuple of names)."""
+    names: List[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.extend(str(n) for n in entry)
+        else:
+            names.append(str(entry))
+    return names
+
+
+def check_partition_specs(shardings, mesh, params=None, *,
+                          source: str = "<shardings>") -> List[Finding]:
+    """DT008: validate declared PartitionSpecs against the mesh axes
+    actually present — BEFORE the first ``device_put`` fails (or, worse,
+    GSPMD silently replicates).
+
+    ``shardings``: a pytree whose leaves are ``PartitionSpec``s or
+    ``NamedSharding``s (e.g. the output of
+    ``parallel.sharding.tree_shardings``, or hand-written specs).
+    ``mesh``: the mesh the specs will be applied on. ``params`` (optional,
+    same tree structure): enables the shape checks — a spec longer than the
+    array rank, or a sharded dimension the axis size does not divide.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
+
+    rule = get_rule("DT008")
+    findings: List[Finding] = []
+    mesh_axes = {str(a): int(s) for a, s in mesh.shape.items()}
+    is_leaf = lambda x: isinstance(x, (NamedSharding, PartitionSpec))  # noqa: E731
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        shardings, is_leaf=is_leaf)
+    param_leaves = None
+    if params is not None:
+        leaves = jax.tree_util.tree_leaves(params)
+        if len(leaves) == len(flat):
+            param_leaves = leaves
+
+    for i, (path, leaf) in enumerate(flat):
+        label = jax.tree_util.keystr(path) or f"leaf[{i}]"
+        ctx = {"file": source, "context": label}
+        if isinstance(leaf, NamedSharding):
+            spec = leaf.spec
+            own_axes = {str(a) for a in leaf.mesh.axis_names}
+            if own_axes != set(mesh_axes):
+                findings.append(rule.finding(
+                    f"NamedSharding was built on a mesh with axes "
+                    f"{sorted(own_axes)} but will be applied on a mesh with "
+                    f"axes {sorted(mesh_axes)}", **ctx))
+                continue
+        elif isinstance(leaf, PartitionSpec):
+            spec = leaf
+        else:
+            continue
+        names = _spec_axis_names(spec)
+        unknown = [n for n in names if n not in mesh_axes]
+        if unknown:
+            findings.append(rule.finding(
+                f"PartitionSpec{tuple(spec)} references "
+                f"{'axes' if len(unknown) > 1 else 'axis'} "
+                f"{sorted(set(unknown))} absent from the mesh (axes "
+                f"present: {sorted(mesh_axes)})", **ctx))
+            continue
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            findings.append(rule.finding(
+                f"PartitionSpec{tuple(spec)} uses mesh "
+                f"{'axes' if len(dupes) > 1 else 'axis'} {dupes} for more "
+                "than one dimension", **ctx))
+            continue
+        if param_leaves is None:
+            continue
+        shape = getattr(param_leaves[i], "shape", None)
+        if shape is None:
+            continue
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            findings.append(rule.finding(
+                f"PartitionSpec{entries} has {len(entries)} entries but the "
+                f"array is rank {len(shape)} ({tuple(shape)})", **ctx))
+            continue
+        for dim, (size, entry) in enumerate(zip(shape, entries)):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            factor = 1
+            for a in axes:
+                factor *= mesh_axes[str(a)]
+            if factor > 1 and int(size) % factor != 0:
+                findings.append(rule.finding(
+                    f"dim {dim} of shape {tuple(shape)} is {size}, not "
+                    f"divisible by the {factor}-way sharding of "
+                    f"PartitionSpec{entries}", severity="warning", **ctx))
+    return findings
+
+
 # ------------------------------------------------------------ DT009 check
 def _leaf_shardings(params_subtree):
     """Distinct (device-set, spec) placements of a param subtree's leaves.

@@ -102,6 +102,19 @@ register_rule(Rule(
     "keep float64 for offline gradient checks only.",
 ))
 register_rule(Rule(
+    "DT008", "sharding spec disagrees with the mesh", "error", "graph",
+    "A declared PartitionSpec references a mesh axis that does not exist on "
+    "the mesh it will be applied to (or uses one axis for two dimensions, "
+    "exceeds the array rank, or shards a dimension the axis size does not "
+    "divide): device_put/jit rejects it at dispatch time — or GSPMD "
+    "silently falls back to full replication, training slower with no "
+    "error.",
+    "Create meshes and specs from one source of truth (parallel.make_mesh "
+    "+ parallel.sharding.tree_shardings); validate hand-written specs with "
+    "analysis.check_partition_specs(specs, mesh, params) before the first "
+    "device_put.",
+))
+register_rule(Rule(
     "DT009", "cross-device transfer between consecutive vertices", "warning",
     "graph",
     "Consecutive layers/vertices are pinned to different device sets or "

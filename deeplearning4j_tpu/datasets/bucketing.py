@@ -190,6 +190,23 @@ class BucketedStager:
         self.bucketing = bool(bucketing)
         self.pad_examples = bool(pad_examples) and self.bucketing
         self.time_boundaries = time_boundaries
+        self._last_window_sig = None  # flight-recorder transition tracking
+
+    def _note_transition(self, sig, n_real: int) -> None:
+        """Ring a ``bucket_shape`` event into the flight recorder when the
+        staged window shape changes — every transition is a potential fresh
+        XLA program, exactly the trail a post-mortem wants."""
+        if sig == self._last_window_sig:
+            return
+        self._last_window_sig = sig
+        try:
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            get_flight_recorder().record(
+                "bucket_shape", batch=sig[0], time_bucket=sig[1],
+                signature=repr(sig[2:]), n_real=int(n_real))
+        except Exception:  # observability must never break staging
+            pass
 
     # ---------------------------------------------------------- signatures
     def _time_bucket(self, member: _Member) -> Optional[int]:
@@ -320,6 +337,7 @@ class BucketedStager:
             if not group:
                 return []
             if self.bucketing or len(group) == self.stage:
+                self._note_transition(sig, len(group))
                 events = [("window", self._build_window(group, sig[0],
                                                         sig[1]))]
             else:

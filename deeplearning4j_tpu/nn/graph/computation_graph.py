@@ -136,6 +136,23 @@ class ComputationGraph:
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 
+    def memory_report(self, batch_or_struct=None) -> dict:
+        """Per-vertex HBM attribution at a batch size or example shapes
+        (a list for multi-input graphs) — pure ``jax.eval_shape``. See
+        :func:`deeplearning4j_tpu.telemetry.memory_report`."""
+        from ...telemetry.memory import memory_report
+
+        return memory_report(self, batch_or_struct)
+
+    def preflight(self, batch_or_struct=None, **kw) -> dict:
+        """Will this graph + batch fit in HBM? Raises
+        :class:`~deeplearning4j_tpu.telemetry.MemoryPreflightError` naming
+        the biggest consumers before any dispatch; returns the annotated
+        memory report when it fits."""
+        from ...telemetry.memory import preflight
+
+        return preflight(self, batch_or_struct, **kw)
+
     def summary(self) -> str:
         """Vertex table in topological order: name, type, inputs, out type,
         param count (reference: ComputationGraph.summary())."""
@@ -518,6 +535,14 @@ class ComputationGraph:
         losses = np.asarray(losses)[:n_steps]
         elapsed = time.perf_counter() - t0
         if tel is not None:
+            if tel.flight is not None:
+                # dispatch event rings BEFORE the fetch: an anomaly found at
+                # fetch time auto-dumps with the dispatch already on record
+                tel.flight.record(
+                    "staged_dispatch", net="graph", steps=int(n_steps),
+                    slots=int(xs_list[0].shape[0]),
+                    batch=int(xs_list[0].shape[1]),
+                    seconds=round(elapsed, 6))
             tel.on_staged(self.iteration + 1, np.asarray(mvecs)[:n_steps],
                           per_step_time_s=elapsed / max(len(losses), 1))
         self.last_batch_size = int(xs_list[0].shape[1])

@@ -222,6 +222,23 @@ class MultiLayerNetwork:
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 
+    def memory_report(self, batch_or_struct=None) -> dict:
+        """Per-layer HBM attribution (param/grad/optimizer/activation bytes)
+        at a batch size or example shape — pure ``jax.eval_shape``, nothing
+        allocates. See :func:`deeplearning4j_tpu.telemetry.memory_report`."""
+        from ..telemetry.memory import memory_report
+
+        return memory_report(self, batch_or_struct)
+
+    def preflight(self, batch_or_struct=None, **kw) -> dict:
+        """Will this net + batch fit in HBM? Raises
+        :class:`~deeplearning4j_tpu.telemetry.MemoryPreflightError` naming
+        the biggest consumers BEFORE fit/warmup pays a doomed compile;
+        returns the annotated memory report when it fits."""
+        from ..telemetry.memory import preflight
+
+        return preflight(self, batch_or_struct, **kw)
+
     def summary(self) -> str:
         """Layer table: name, in/out types, param count (reference:
         MultiLayerNetwork.summary())."""
@@ -534,6 +551,14 @@ class MultiLayerNetwork:
         losses = np.asarray(losses)[:n_steps]
         elapsed = time.perf_counter() - t0
         if tel is not None:
+            if tel.flight is not None:
+                # ring the dispatch BEFORE the fetch below — an anomaly
+                # found at fetch time auto-dumps, and the bundle should
+                # already show what was dispatched
+                tel.flight.record(
+                    "staged_dispatch", net="mln", steps=int(n_steps),
+                    slots=int(xs.shape[0]), batch=int(xs.shape[1]),
+                    seconds=round(elapsed, 6))
             # the loop stacked per-step metrics; ONE more (already-computed)
             # fetch records the whole window — never a per-step sync
             tel.on_staged(self.iteration + 1, np.asarray(mvecs)[:n_steps],

@@ -65,6 +65,18 @@ def param_shardings(params, mesh, model_axis: str | None = "model",
     return tree_shardings(params, mesh, model_axis, expert_axis)
 
 
+def validate_shardings(shardings, mesh, params=None, *,
+                       source: str = "<shardings>"):
+    """DT008 pre-dispatch validation of declared PartitionSpecs /
+    NamedShardings against the mesh axes actually present (plus shape
+    divisibility when ``params`` is given). Returns analysis findings —
+    empty means every spec is applicable on this mesh. Delegates to
+    :func:`deeplearning4j_tpu.analysis.check_partition_specs`."""
+    from ..analysis import check_partition_specs  # noqa: PLC0415
+
+    return check_partition_specs(shardings, mesh, params, source=source)
+
+
 def shard_params(net, mesh, model_axis: str | None = "model",
                  expert_axis: str | None = None):
     """device_put the net's params (and existing optimizer state) with

@@ -207,27 +207,14 @@ class ProfilingListener(TrainingListener):
 
 
 def device_memory_stats() -> List[dict]:
-    """PJRT per-device memory stats; the single implementation shared by
-    :class:`SystemInfoSampler` and the UI StatsListener."""
-    out: List[dict] = []
-    try:
-        import jax
+    """PJRT per-device memory stats. Compatibility wrapper: the single
+    implementation now lives in :mod:`telemetry.memory` (where it also
+    feeds the registry gauges and the flight recorder's watermark trail);
+    :class:`SystemInfoSampler` and the UI StatsListener read through here
+    unchanged."""
+    from .telemetry.memory import device_memory_stats as _impl
 
-        for d in jax.devices():
-            try:
-                ms = d.memory_stats()
-            except Exception:
-                ms = None
-            if ms:
-                out.append({
-                    "device": int(d.id),
-                    "bytes_in_use": ms.get("bytes_in_use"),
-                    "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
-                    "bytes_limit": ms.get("bytes_limit"),
-                })
-    except Exception:  # pragma: no cover
-        pass
-    return out
+    return _impl()
 
 
 class SystemInfoSampler:

@@ -129,6 +129,7 @@ class CompileManager:
         self.max_entries = int(max_entries)
         self._lock = threading.RLock()
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._memory: "OrderedDict[Tuple, dict]" = OrderedDict()
         self._token_counter = 0
         if registry is None:
             from ..telemetry import get_registry  # noqa: PLC0415
@@ -150,6 +151,72 @@ class CompileManager:
         self.cache_size = registry.gauge(
             "dl4jtpu_compile_cache_size",
             "executables currently held by the compile manager")
+        # static HBM accounting from XLA itself: every admitted AOT
+        # executable's memory_analysis() lands here, kind = byte category
+        self.hbm_bytes = registry.gauge(
+            "dl4jtpu_executable_hbm_bytes",
+            "bytes of live cached executables by XLA memory_analysis "
+            "category (argument/output/temp/generated_code)",
+            labelnames=("kind",))
+        self.hbm_total = registry.gauge(
+            "dl4jtpu_executable_hbm_total_bytes",
+            "cache-wide total HBM footprint of live cached executables")
+
+    # -------------------------------------------------------- observability
+    @staticmethod
+    def _flight():
+        """The process flight recorder; compiles/evictions are rare, so the
+        lazy import costs nothing on the hot lookup path."""
+        from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+        return get_flight_recorder()
+
+    @staticmethod
+    def _key_kind(key) -> str:
+        """Human label of a cache key: the entry-kind string that follows
+        the owner token (e.g. ``mln_multi_step``)."""
+        if isinstance(key, tuple):
+            for part in key:
+                if isinstance(part, str):
+                    return part
+        return "aot"
+
+    def _refresh_memory_gauges(self) -> None:
+        with self._lock:
+            records = list(self._memory.values())
+        totals = {"argument": 0, "output": 0, "temp": 0, "generated_code": 0}
+        grand = 0
+        for rec in records:
+            if not rec.get("available"):
+                continue
+            for kind in totals:
+                totals[kind] += int(rec.get(f"{kind}_bytes", 0))
+            grand += int(rec.get("total_bytes", 0))
+        for kind, v in totals.items():
+            self.hbm_bytes.labels(kind=kind).set(v)
+        self.hbm_total.set(grand)
+
+    def memory_records(self) -> dict:
+        """{key label: memory_analysis record} for every live AOT entry."""
+        with self._lock:
+            return {f"{self._key_kind(k)}#{i}": dict(rec)
+                    for i, (k, rec) in enumerate(self._memory.items())}
+
+    def _memory_summary(self) -> dict:
+        with self._lock:
+            records = list(self._memory.values())
+        out = {"measured_entries": 0, "unavailable_entries": 0,
+               "argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+               "generated_code_bytes": 0, "total_bytes": 0}
+        for rec in records:
+            if rec.get("available"):
+                out["measured_entries"] += 1
+                for kind in ("argument", "output", "temp", "generated_code",
+                             "total"):
+                    out[f"{kind}_bytes"] += int(rec.get(f"{kind}_bytes", 0))
+            else:
+                out["unavailable_entries"] += 1
+        return out
 
     # ------------------------------------------------------------- tokens
     def new_token(self) -> Tuple[str, int]:
@@ -170,10 +237,18 @@ class CompileManager:
                      if isinstance(k, tuple) and k and k[0] == token]
             for k in stale:
                 del self._entries[k]
+                self._memory.pop(k, None)
             if stale:
                 self.evictions.inc(len(stale))
             self.cache_size.set(len(self._entries))
-            return len(stale)
+        if stale:
+            self._refresh_memory_gauges()
+            try:
+                self._flight().record("eviction", cause="drop_token",
+                                      count=len(stale))
+            except Exception:  # observability must not break retirement
+                pass
+        return len(stale)
 
     # -------------------------------------------------------------- cache
     def _get(self, key):
@@ -184,7 +259,8 @@ class CompileManager:
                 self.cache_hits.inc()
             return entry
 
-    def _put(self, key, value):
+    def _put(self, key, value, memory: Optional[dict] = None):
+        evicted = 0
         with self._lock:
             # a racing compile of the same key: keep the first, count ours
             # as the loser (both compiles already happened and were counted)
@@ -193,11 +269,22 @@ class CompileManager:
                 self._entries.move_to_end(key)
                 return existing
             self._entries[key] = value
+            if memory is not None:
+                self._memory[key] = memory
             while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+                old_key, _ = self._entries.popitem(last=False)
+                self._memory.pop(old_key, None)
                 self.evictions.inc()
+                evicted += 1
             self.cache_size.set(len(self._entries))
-            return value
+        if memory is not None or evicted:
+            self._refresh_memory_gauges()
+        if evicted:
+            try:
+                self._flight().record("eviction", cause="lru", count=evicted)
+            except Exception:
+                pass
+        return value
 
     def aot(self, key: Tuple, build: Callable[[], Any], args) -> Any:
         """Compiled executable for ``key``; on miss, ``build()`` must return
@@ -210,9 +297,23 @@ class CompileManager:
             return entry
         t0 = time.perf_counter()
         compiled = build().lower(*args).compile()
-        self.compile_time.observe(time.perf_counter() - t0)
+        seconds = time.perf_counter() - t0
+        self.compile_time.observe(seconds)
         self.compiles.inc()
-        return self._put(key, compiled)
+        # static HBM accounting from the compiler itself — every admitted
+        # executable carries a memory_analysis record (or an explicit
+        # "unavailable on this backend" flag), see telemetry/memory.py
+        from ..telemetry.memory import executable_memory  # noqa: PLC0415
+
+        record = executable_memory(compiled)
+        record["kind"] = self._key_kind(key)
+        try:
+            self._flight().record(
+                "compile", entry=record["kind"], seconds=round(seconds, 6),
+                hbm_total_bytes=record.get("total_bytes"))
+        except Exception:
+            pass
+        return self._put(key, compiled, memory=record)
 
     def callable(self, key: Tuple, build: Callable[[], Any]) -> Any:
         """Deduplicated callable for ``key`` (no AOT compile here — the
@@ -239,6 +340,7 @@ class CompileManager:
             "cache_hits_total": self.cache_hits.value,
             "evictions_total": self.evictions.value,
             "compile_seconds": self.compile_time.summary(),
+            "memory": self._memory_summary(),
         }
 
 

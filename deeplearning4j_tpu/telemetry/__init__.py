@@ -16,8 +16,27 @@ syncs; see docs/observability.md):
   call.
 - :mod:`watchdog` — structured anomaly events (nan-loss,
   exploding-grad-norm, stalled-step-time) with pluggable sinks.
+- :mod:`memory` — HBM accounting: XLA ``memory_analysis`` of cached
+  executables, per-layer attribution via ``jax.eval_shape``
+  (:func:`memory_report`), the :func:`preflight` will-it-fit check, and
+  the single live ``device_memory_stats`` source.
+- :mod:`flight_recorder` — bounded event ring + post-mortem JSON dump
+  bundles, auto-triggered by watchdog anomalies.
 """
 
+from .flight_recorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    install_crash_hook,
+)
+from .memory import (
+    MemoryPreflightError,
+    device_memory_stats,
+    executable_memory,
+    memory_report,
+    preflight,
+    sample_device_memory,
+)
 from .registry import (
     DEFAULT_BUCKETS,
     MetricFamily,
@@ -51,4 +70,13 @@ __all__ = [
     "NAN_LOSS",
     "EXPLODING_GRAD_NORM",
     "STALLED_STEP_TIME",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "install_crash_hook",
+    "MemoryPreflightError",
+    "device_memory_stats",
+    "executable_memory",
+    "memory_report",
+    "preflight",
+    "sample_device_memory",
 ]

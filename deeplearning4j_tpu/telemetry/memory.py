@@ -1,0 +1,408 @@
+"""HBM memory accounting: where the bytes go, before and while they go there.
+
+The reference's only memory visibility was StatsListener's JVM heap sections
+(SURVEY.md §5.1) — numbers with no connection to what the model allocates.
+On TPU the blind spot is HBM: the failure mode is an OOM at compile or
+dispatch time with no attribution. This module is the missing accounting
+layer, three sources feeding one registry:
+
+- **Static, from XLA itself.** :func:`executable_memory` reads
+  ``compiled.memory_analysis()`` off an AOT executable — argument/output/
+  temp/generated-code bytes as the compiler laid them out. The compile
+  manager records this for every executable it admits
+  (``dl4jtpu_executable_hbm_bytes{kind=...}`` + a cache-wide total).
+- **Projected, from the model.** :func:`memory_report` walks a net's
+  layers/vertices with ``jax.eval_shape`` (no FLOPs, no allocation) and
+  attributes param + gradient + optimizer-state + activation bytes per
+  layer, for both ``MultiLayerNetwork`` and ``ComputationGraph``.
+  :func:`preflight` compares the projected peak against the live limit and
+  raises a "will not fit, biggest consumers are X/Y/Z" error BEFORE the
+  first fit/warmup dispatch pays a doomed compile.
+- **Live, from PJRT.** :func:`device_memory_stats` is the single
+  implementation of per-device ``memory_stats()`` collection (profiler's
+  old function is now a thin wrapper); :func:`sample_device_memory`
+  additionally records registry gauges + a peak watermark and is called on
+  every telemetry fetch — live HBM rides the same K-step cadence as the
+  training metrics, never a per-step sync.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+# env knob: explicit per-device HBM budget for preflight when PJRT exposes
+# no memory_stats (see docs/observability.md)
+HBM_LIMIT_ENV = "DL4JTPU_HBM_LIMIT_BYTES"
+
+# timesteps probe substituted for variable-length recurrent inputs (the
+# same convention as analysis/graph_checks.DEFAULT_TIMESTEPS_PROBE)
+DEFAULT_TIMESTEPS_PROBE = 16
+
+_MA_FIELDS = {
+    "argument": "argument_size_in_bytes",
+    "output": "output_size_in_bytes",
+    "temp": "temp_size_in_bytes",
+    "generated_code": "generated_code_size_in_bytes",
+    "alias": "alias_size_in_bytes",
+}
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+# --------------------------------------------------------- static (from XLA)
+def executable_memory(compiled) -> dict:
+    """Byte accounting of one AOT executable from XLA's own
+    ``memory_analysis()``. Always returns a record: when the backend
+    doesn't expose the analysis the record carries ``available: False``
+    and a reason instead of silently reading zero."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:
+        return {"available": False,
+                "reason": f"{type(e).__name__}: {e}"[:200]}
+    if ma is None:
+        return {"available": False,
+                "reason": "memory_analysis unavailable on this backend"}
+    out: dict = {"available": True}
+    for kind, attr in _MA_FIELDS.items():
+        out[f"{kind}_bytes"] = int(getattr(ma, attr, 0) or 0)
+    # peak working set of one execution: inputs + outputs + scratch + code,
+    # minus input/output buffers the compiler aliased (donation)
+    out["total_bytes"] = max(
+        0,
+        out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        + out["generated_code_bytes"] - out["alias_bytes"],
+    )
+    return out
+
+
+# ----------------------------------------------------------- live (from PJRT)
+def device_memory_stats(registry: Optional[MetricsRegistry] = None) -> List[dict]:
+    """Per-device PJRT memory stats — THE live-HBM source (the UI
+    StatsListener, ``profiler.device_memory_stats`` and the telemetry fetch
+    all read through here). With ``registry`` the rows also land as
+    ``dl4jtpu_device_hbm_bytes{device,kind}`` gauges."""
+    out: List[dict] = []
+    try:
+        import jax  # noqa: PLC0415 - keep module import light
+
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms:
+                out.append({
+                    "device": int(d.id),
+                    "bytes_in_use": ms.get("bytes_in_use"),
+                    "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                    "bytes_limit": ms.get("bytes_limit"),
+                })
+    except Exception:  # pragma: no cover - no jax / broken backend
+        pass
+    if registry is not None and out:
+        fam = registry.gauge(
+            "dl4jtpu_device_hbm_bytes",
+            "live per-device HBM (PJRT memory_stats)",
+            labelnames=("device", "kind"),
+        )
+        for row in out:
+            for kind in ("bytes_in_use", "bytes_limit"):
+                if row.get(kind) is not None:
+                    fam.labels(device=row["device"],
+                               kind=kind.replace("bytes_", "").replace(
+                                   "_bytes", "")).set(row[kind])
+    return out
+
+
+def sample_device_memory(registry: Optional[MetricsRegistry] = None,
+                         flight=None) -> List[dict]:
+    """Record live HBM gauges + a sticky peak watermark; called on every
+    telemetry fetch (K-step cadence — never per step). ``flight``: a
+    :class:`~.flight_recorder.FlightRecorder` to drop a ``memory`` event
+    into (the post-mortem trail of watermarks)."""
+    reg = registry if registry is not None else get_registry()
+    rows = device_memory_stats(reg)
+    if not rows:
+        return rows
+    peak_fam = reg.gauge(
+        "dl4jtpu_device_hbm_peak_bytes",
+        "peak HBM watermark per device (sticky max of PJRT peaks)",
+        labelnames=("device",),
+    )
+    for row in rows:
+        peak = row.get("peak_bytes_in_use") or row.get("bytes_in_use") or 0
+        child = peak_fam.labels(device=row["device"])
+        if peak > child.value:
+            child.set(peak)
+    if flight is not None:
+        try:
+            flight.record("memory", devices=[
+                {k: row.get(k) for k in ("device", "bytes_in_use",
+                                         "peak_bytes_in_use")}
+                for row in rows
+            ])
+        except Exception:  # observability must never kill the train loop
+            pass
+    return rows
+
+
+# ----------------------------------------------- projected (from the model)
+def _bytes_of(tree) -> int:
+    """Exact byte count of a pytree of arrays / ShapeDtypeStructs."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += int(np.prod(leaf.shape, dtype=np.int64)) * \
+                np.dtype(leaf.dtype).itemsize
+    return int(total)
+
+
+def _input_structs(net, batch_or_struct):
+    """Input ShapeDtypeStructs for a net: an int batch size builds them from
+    the declared input types; arrays/structs (or a list for multi-input
+    graphs) are shelled to shape/dtype only."""
+    import jax
+    import numpy as np
+
+    conf = net.conf
+
+    def shell(a):
+        if isinstance(a, jax.ShapeDtypeStruct):
+            return a
+        a = np.asarray(a) if not hasattr(a, "dtype") else a
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+    if batch_or_struct is None:
+        batch_or_struct = 32
+    if isinstance(batch_or_struct, (int, np.integer)):
+        b = int(batch_or_struct)
+        if hasattr(conf, "vertices"):
+            its = conf.input_types
+        else:
+            if conf.input_type is None:
+                raise ValueError(
+                    "memory_report needs conf.input_type (or pass example "
+                    "arrays/ShapeDtypeStructs instead of a batch size)")
+            its = [conf.input_type]
+        structs = []
+        for it in its:
+            if getattr(it, "kind", None) == "rnn" and it.timesteps is None:
+                shape = (DEFAULT_TIMESTEPS_PROBE, it.size)
+            else:
+                shape = it.example_shape()
+            structs.append(jax.ShapeDtypeStruct((b,) + tuple(shape),
+                                                np.float32))
+        return structs
+    if isinstance(batch_or_struct, (list, tuple)):
+        return [shell(a) for a in batch_or_struct]
+    return [shell(batch_or_struct)]
+
+
+def _opt_state_struct(tx, params_subtree):
+    """Shape-only optimizer state for one layer's params. Elementwise optax
+    transforms (sgd/adam/rmsprop/...) build per-leaf state, so initializing
+    on the subtree attributes exactly that layer's share; scalar bookkeeping
+    (step counts) double-counts by a few bytes per layer."""
+    import jax
+
+    try:
+        return _bytes_of(jax.eval_shape(tx.init, params_subtree))
+    except Exception:
+        return 0
+
+
+def memory_report(net, batch_or_struct=None) -> dict:
+    """Per-layer/vertex HBM attribution for a ``MultiLayerNetwork`` or
+    ``ComputationGraph`` — pure ``jax.eval_shape``, nothing allocates.
+
+    ``batch_or_struct``: an int batch size (default 32), example arrays, or
+    ``jax.ShapeDtypeStruct`` shells (a list for multi-input graphs).
+
+    Returns ``{"layers": [...], "totals": {...}, "top_consumers": [...]}``.
+    Param and optimizer totals are exact (counted off the live pytrees);
+    activation bytes are the traced layer outputs at the given batch; the
+    projected peak models one training step's working set::
+
+        params + gradients(= params) + optimizer state + activations + inputs
+
+    XLA's buffer reuse can beat this and ``remat`` shrinks the activation
+    term — treat it as the planning number, not a measurement. The measured
+    twin is the compile cache's ``memory_analysis`` records.
+    """
+    import jax
+
+    net.init()
+    conf = net.conf
+    inputs = _input_structs(net, batch_or_struct)
+    is_graph = hasattr(conf, "vertices")
+    tx = net._tx
+
+    if is_graph:
+        acts, _, _ = jax.eval_shape(
+            lambda xs: net._activations(net.params, xs, net.state, False,
+                                        None, None),
+            inputs,
+        )
+        names = list(net._topo)
+        params_of = lambda n: net.params[n]  # noqa: E731
+        act_of = lambda n: acts.get(n)  # noqa: E731
+        label_of = lambda n: n  # noqa: E731
+        type_of = lambda n: (  # noqa: E731
+            type(getattr(conf.vertices[n], "layer", None)).__name__
+            if getattr(conf.vertices[n], "layer", None) is not None
+            else type(conf.vertices[n]).__name__)
+    else:
+        acts = jax.eval_shape(lambda x: net.feed_forward(x), inputs[0])
+        names = list(range(len(conf.layers)))
+        params_of = lambda i: net.params[i]  # noqa: E731
+        act_of = lambda i: acts[i]  # noqa: E731
+        label_of = lambda i: f"layer[{i}]"  # noqa: E731
+        type_of = lambda i: type(conf.layers[i]).__name__  # noqa: E731
+
+    rows = []
+    for n in names:
+        p_bytes = _bytes_of(params_of(n))
+        a = act_of(n)
+        a_bytes = _bytes_of(a)
+        o_bytes = _opt_state_struct(tx, params_of(n)) if p_bytes else 0
+        rows.append({
+            "name": label_of(n),
+            "type": type_of(n),
+            "param_bytes": p_bytes,
+            "grad_bytes": p_bytes,  # autodiff mirrors the param pytree
+            "opt_state_bytes": o_bytes,
+            "activation_bytes": a_bytes,
+            "activation_shape": (list(a.shape)
+                                 if hasattr(a, "shape") else None),
+            "total_bytes": 2 * p_bytes + o_bytes + a_bytes,
+        })
+
+    param_total = _bytes_of(net.params)
+    opt_total = _bytes_of(net.opt_state)
+    act_total = sum(r["activation_bytes"] for r in rows)
+    input_total = _bytes_of(inputs)
+    projected = 2 * param_total + opt_total + act_total + input_total
+    report = {
+        "model": type(net).__name__,
+        "dtype": conf.dtype,
+        "remat": bool(getattr(conf, "remat", False)),
+        "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype),
+                    "bytes": _bytes_of(s)} for s in inputs],
+        "layers": rows,
+        "totals": {
+            "param_bytes": param_total,
+            "grad_bytes": param_total,
+            "opt_state_bytes": opt_total,
+            "activation_bytes": act_total,
+            "input_bytes": input_total,
+            "projected_peak_bytes": projected,
+        },
+        "top_consumers": [
+            {"name": r["name"], "type": r["type"],
+             "total_bytes": r["total_bytes"],
+             "human": _fmt_bytes(r["total_bytes"])}
+            for r in sorted(rows, key=lambda r: -r["total_bytes"])[:3]
+        ],
+    }
+    return report
+
+
+# ----------------------------------------------------------------- preflight
+class MemoryPreflightError(RuntimeError):
+    """Raised when the projected peak will not fit the HBM budget; carries
+    the full :func:`memory_report` as ``.report``."""
+
+    def __init__(self, message: str, report: dict,
+                 projected_bytes: int, limit_bytes: int):
+        super().__init__(message)
+        self.report = report
+        self.projected_bytes = projected_bytes
+        self.limit_bytes = limit_bytes
+
+
+def _hbm_limit() -> tuple:
+    """(limit_bytes, source) — live PJRT limit, the env override, or host
+    MemAvailable as the CPU stand-in; (None, reason) when nothing knows."""
+    rows = device_memory_stats()
+    for row in rows:
+        if row.get("bytes_limit"):
+            return int(row["bytes_limit"]), f"device {row['device']} memory_stats"
+    env = os.environ.get(HBM_LIMIT_ENV)
+    if env:
+        try:
+            return int(env), f"env {HBM_LIMIT_ENV}"
+        except ValueError:
+            pass
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024, \
+                        "host MemAvailable (cpu stand-in)"
+    except OSError:
+        pass
+    return None, "no memory_stats, env override, or /proc/meminfo"
+
+
+def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
+              headroom: float = 0.9, registry: Optional[MetricsRegistry] = None,
+              flight: Optional[Any] = None) -> dict:
+    """Will this net + batch fit? Raises :class:`MemoryPreflightError` with
+    the biggest consumers named BEFORE any fit/warmup dispatch pays a doomed
+    compile; returns the annotated :func:`memory_report` when it fits (or
+    when no limit source exists — ``report["preflight"]["checked"]`` says
+    which). ``headroom`` reserves a fraction of the limit for XLA scratch
+    and fragmentation."""
+    report = memory_report(net, batch_or_struct)
+    source = "explicit limit_bytes"
+    if limit_bytes is None:
+        limit_bytes, source = _hbm_limit()
+    if flight is not None:
+        try:
+            flight.attach_memory_report(report)
+        except Exception:
+            pass
+    if limit_bytes is None:
+        report["preflight"] = {"checked": False, "reason": source}
+        return report
+    projected = report["totals"]["projected_peak_bytes"]
+    budget = int(limit_bytes * headroom)
+    report["preflight"] = {
+        "checked": True,
+        "fits": projected <= budget,
+        "projected_peak_bytes": projected,
+        "limit_bytes": int(limit_bytes),
+        "headroom": headroom,
+        "limit_source": source,
+    }
+    if registry is not None:
+        registry.gauge(
+            "dl4jtpu_projected_peak_hbm_bytes",
+            "memory_report projected training peak of the last preflight",
+        ).set(projected)
+    if projected > budget:
+        top = ", ".join(
+            f"{c['name']} ({c['type']}, {c['human']})"
+            for c in report["top_consumers"])
+        raise MemoryPreflightError(
+            f"projected training peak {_fmt_bytes(projected)} exceeds "
+            f"{_fmt_bytes(budget)} ({headroom:.0%} of "
+            f"{_fmt_bytes(limit_bytes)} from {source}); "
+            f"biggest consumers: {top}",
+            report, projected, int(limit_bytes),
+        )
+    return report

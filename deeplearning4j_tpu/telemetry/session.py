@@ -41,10 +41,24 @@ class Telemetry:
         fetch_every: int = 10,
         watchdog: Optional[Watchdog] = None,
         prefix: str = "dl4jtpu_train",
+        flight_recorder=None,
+        sample_memory: bool = True,
     ):
+        from .flight_recorder import get_flight_recorder  # noqa: PLC0415
+
         self.registry = registry if registry is not None else get_registry()
         self.fetch_every = max(1, int(fetch_every))
         self.watchdog = watchdog
+        # black box: step rows ring into the flight recorder at fetch time,
+        # and the recorder rides the watchdog as a sink so an anomaly dumps
+        # a post-mortem bundle (telemetry/flight_recorder.py)
+        self.flight = (flight_recorder if flight_recorder is not None
+                       else get_flight_recorder())
+        self.sample_memory = bool(sample_memory)
+        if self.watchdog is not None and self.flight is not None:
+            if not any(getattr(s, "__self__", None) is self.flight
+                       for s in self.watchdog.sinks):
+                self.watchdog.add_sink(self.flight.watchdog_sink)
         self.fetch_count = 0
         self._pending: List[Tuple[int, object, Optional[float]]] = []
         self._last_step_t: Optional[float] = None
@@ -108,6 +122,7 @@ class Telemetry:
         self.fetches.inc()
         for (iteration, _, step_time_s), row in zip(pending, rows):
             self._record_row(iteration, row, step_time_s)
+        self._sample_memory()
 
     # -------------------------------------------------------------- staged
     def on_staged(self, first_iteration: int, mvecs,
@@ -128,8 +143,18 @@ class Telemetry:
             if per_step_time_s is not None:
                 self.step_time_hist.observe(per_step_time_s)
             self._record_row(first_iteration + j, row, per_step_time_s)
+        self._sample_memory()
 
     # ------------------------------------------------------------- shared
+    def _sample_memory(self) -> None:
+        """Live HBM gauges + peak watermark, once per fetch (never per
+        step); the watermark also rings into the flight recorder."""
+        if not self.sample_memory:
+            return
+        from . import memory as _tmem  # noqa: PLC0415
+
+        _tmem.sample_device_memory(self.registry, flight=self.flight)
+
     def _record_row(self, iteration: int, row,
                     step_time_s: Optional[float]) -> None:
         loss = float(row[device_stats.LOSS])
@@ -141,6 +166,14 @@ class Telemetry:
             self.grad_norm_hist.observe(gnorm)
         if nonfinite > 0:
             self.nonfinite_steps.inc()
+        if self.flight is not None:
+            # the step's row rings into the black box AT FETCH TIME — the
+            # steady-state cost is K dict appends per host sync, not per step
+            self.flight.record(
+                "step", iteration=int(iteration), loss=loss, grad_norm=gnorm,
+                nonfinite=nonfinite,
+                step_time_s=(None if step_time_s is None
+                             else float(step_time_s)))
         if self.watchdog is not None:
             self.watchdog.observe(iteration, loss, gnorm, nonfinite,
                                   step_time_s)

@@ -20,6 +20,11 @@ Pages:
 - ``/metrics``        — Prometheus text exposition of the telemetry registry
   (scrape target); ``/api/telemetry`` is its JSON twin plus a system
   snapshot (host RSS, device memory).
+- ``/api/memory``     — HBM accounting: live PJRT device stats, the compile
+  cache's per-executable XLA ``memory_analysis`` records, and the latest
+  per-layer ``memory_report``.
+- ``/api/flightrecorder`` — the anomaly flight recorder's event ring
+  (``?last=N``) and the dump bundles written so far.
 """
 
 from __future__ import annotations
@@ -410,6 +415,29 @@ class _Handler(BaseHTTPRequestHandler):
                 "metrics": self._registry().snapshot(),
                 "system": SystemInfoSampler.sample(),
             }).encode())
+        if path == "/api/memory":
+            # HBM accounting: live PJRT stats, the compile cache's XLA
+            # memory_analysis records, and the latest per-layer report
+            from ..runtime.compile_manager import get_compile_manager  # noqa: PLC0415
+            from ..telemetry import memory as _tmem  # noqa: PLC0415
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            cm = get_compile_manager()
+            return self._send(200, json.dumps({
+                "devices": _tmem.device_memory_stats(self._registry()),
+                "compile_cache": cm.stats(),
+                "executables": cm.memory_records(),
+                "report": get_flight_recorder().last_memory_report,
+            }, default=str).encode())
+        if path == "/api/flightrecorder":
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            try:
+                last = int(self._query().get("last", "256"))
+            except ValueError:
+                last = 256
+            return self._send(200, json.dumps(
+                get_flight_recorder().snapshot(last), default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]

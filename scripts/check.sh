@@ -26,6 +26,46 @@ echo "== compile-count smoke: varying steps/tails must not recompile"
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_compile_manager.py::TestRecompileElimination
 
+echo "== flight-recorder smoke: induced NaN loss must leave a parseable dump"
+env JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                          Telemetry, Watchdog)
+
+conf = MultiLayerConfiguration(
+    layers=[DenseLayer(n_out=8, activation="relu"),
+            OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+    input_type=InputType.feed_forward(6),
+    updater=UpdaterConfig(updater="sgd", learning_rate=0.1))
+net = MultiLayerNetwork(conf).init()
+reg = MetricsRegistry()
+fr = FlightRecorder(dump_dir=tempfile.mkdtemp(prefix="dl4jtpu_flight_"),
+                    registry=reg)
+fr.attach_memory_report(net.memory_report(8))
+net.set_telemetry(Telemetry(registry=reg, fetch_every=4,
+                            watchdog=Watchdog(sinks=[], registry=reg),
+                            flight_recorder=fr))
+rng = np.random.default_rng(0)
+xs = rng.normal(size=(2, 8, 6)).astype(np.float32)
+ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, 8))]
+xs[0, 0, 0] = np.nan  # induce the NaN loss
+net.fit_on_device(xs, ys, steps=3)
+assert fr.dumps, "NaN loss produced no flight-recorder dump"
+bundle = json.loads(open(fr.dumps[0]).read())
+assert bundle["schema"] == "dl4jtpu-flight-v1"
+kinds = {e["kind"] for e in bundle["events"]}
+assert {"step", "anomaly", "staged_dispatch"} <= kinds, kinds
+assert bundle["memory"]["report"]["totals"]["param_bytes"] > 0
+assert "dl4jtpu_train_steps_total" in bundle["registry"]
+print(f"flight dump OK: {fr.dumps[0]} ({len(bundle['events'])} events)")
+PY
+
 echo "== /metrics smoke scrape (in-process UI server)"
 env JAX_PLATFORMS=cpu python - <<'PY'
 import urllib.request

@@ -183,6 +183,99 @@ class TestGraphRules:
 
 
 # --------------------------------------------------------------------------
+# DT008: sharding-spec validation — declared PartitionSpecs vs the mesh
+# axes actually present (the deferred rule from PR 1, now shipped)
+# --------------------------------------------------------------------------
+class TestDt008:
+    def _mesh(self):
+        from deeplearning4j_tpu.parallel import make_mesh
+
+        return make_mesh(8, axis_names=("data", "model"), shape=(4, 2))
+
+    def test_tree_shardings_against_own_mesh_is_clean(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+        from deeplearning4j_tpu.parallel.sharding import tree_shardings
+
+        mesh = self._mesh()
+        params = {"W": np.zeros((8, 16)), "b": np.zeros((16,))}
+        specs = tree_shardings(params, mesh)
+        assert check_partition_specs(specs, mesh, params) == []
+
+    def test_unknown_axis_fires_with_path_context(self):
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+
+        specs = {"W": P(None, "modle"), "b": P()}  # typo'd axis
+        findings = check_partition_specs(
+            specs, self._mesh(), {"W": np.zeros((8, 16)),
+                                  "b": np.zeros((16,))},
+            source="nets/specs.json")
+        hits = [f for f in findings if f.rule_id == "DT008"]
+        assert hits and hits[0].severity == "error"
+        assert "'modle'" in hits[0].message and "'W'" in hits[0].context
+        assert hits[0].location.startswith("nets/specs.json:")
+
+    def test_duplicate_axis_fires(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+
+        findings = check_partition_specs({"W": P("model", "model")},
+                                         self._mesh())
+        assert [f.rule_id for f in findings] == ["DT008"]
+        assert "more than one dimension" in findings[0].message
+
+    def test_non_divisible_dim_warns(self):
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+
+        findings = check_partition_specs(
+            {"W": P(None, "model")}, self._mesh(),
+            {"W": np.zeros((8, 15))})  # 15 % 2 != 0
+        assert findings and findings[0].severity == "warning"
+        assert "not divisible" in findings[0].message
+
+    def test_spec_longer_than_rank_fires(self):
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+
+        findings = check_partition_specs(
+            {"b": P("data", "model")}, self._mesh(),
+            {"b": np.zeros((16,))})
+        assert findings and "rank 1" in findings[0].message
+
+    def test_namedsharding_built_on_other_mesh_fires(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.analysis import check_partition_specs
+        from deeplearning4j_tpu.parallel import make_mesh
+
+        other = make_mesh(8, axis_names=("x",), shape=(8,))
+        findings = check_partition_specs(
+            {"W": NamedSharding(other, P("x"))}, self._mesh())
+        assert findings and "different" not in findings[0].rule_id
+        assert "built on a mesh with axes ['x']" in findings[0].message
+
+    def test_validate_shardings_convenience(self):
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.sharding import validate_shardings
+
+        findings = validate_shardings({"W": P("nope")}, self._mesh(),
+                                      {"W": np.zeros((8, 8))})
+        assert [f.rule_id for f in findings] == ["DT008"]
+
+
+# --------------------------------------------------------------------------
 # DT009: cross-device transfer detection (graph half on live params, AST
 # half on device_put-in-jit — the line-anchored form pragmas can suppress)
 # --------------------------------------------------------------------------
@@ -383,7 +476,7 @@ class TestAstRules:
     def test_every_shipped_graph_rule_has_a_fixture(self):
         graph_rules = {r for r, rule in RULES.items() if rule.scope == "graph"}
         assert graph_rules == {"DT001", "DT002", "DT003", "DT004", "DT005",
-                               "DT006", "DT007", "DT009"}
+                               "DT006", "DT007", "DT008", "DT009"}
 
     def test_wrap_call_marks_jit_body(self):
         src = (

@@ -1,0 +1,392 @@
+"""dl4jtpu-blackbox (ISSUE 4): HBM memory accounting and the anomaly
+flight recorder.
+
+Acceptance pins (on the CPU backend):
+- ``memory_report`` param bytes are EXACT — machine-checked against
+  ``sum(p.size * p.dtype.itemsize)`` over the live param pytree, for
+  dense, recurrent and graph models;
+- every warm compile-cache entry carries a nonzero ``memory_analysis``
+  record (or an explicit "unavailable on this backend" flag);
+- ``preflight`` raises on an absurd batch and passes on a tier-1 one;
+- an injected nan-loss anomaly produces a JSON dump bundle (round-trips
+  through ``json.loads``) containing step history, the memory report and
+  a registry snapshot; the ring buffer stays bounded under 10k events.
+"""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.nn.conf.computation_graph import (
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+from deeplearning4j_tpu.telemetry import (
+    FlightRecorder,
+    MemoryPreflightError,
+    MetricsRegistry,
+    Telemetry,
+    Watchdog,
+    get_registry,
+    memory_report,
+    preflight,
+)
+from deeplearning4j_tpu.telemetry import memory as tmem
+
+
+def _dense_net(seed: int = 7) -> MultiLayerNetwork:
+    conf = MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=16, activation="relu"),
+            OutputLayer(n_out=4, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="adam", learning_rate=0.1),
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _recurrent_net() -> MultiLayerNetwork:
+    conf = MultiLayerConfiguration(
+        layers=[
+            GravesLSTM(n_out=12, activation="tanh"),
+            RnnOutputLayer(n_out=4, activation="softmax"),
+        ],
+        input_type=InputType.recurrent(6, 5),
+        updater=UpdaterConfig(updater="sgd", learning_rate=0.1),
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph_net() -> ComputationGraph:
+    conf = (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(8))
+        .add_layer("h", DenseLayer(n_out=16, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_out=4, activation="softmax"), "h")
+        .set_outputs("out")
+        .build()
+    )
+    return ComputationGraph(conf).init()
+
+
+def _exact_bytes(tree) -> int:
+    return sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _staged_data(num_batches: int = 3, batch: int = 10, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(num_batches, batch, 8)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (num_batches, batch))]
+    return xs, ys
+
+
+# --------------------------------------------------------------------------
+# memory_report: exact attribution
+# --------------------------------------------------------------------------
+class TestMemoryReport:
+    @pytest.mark.parametrize("make_net", [_dense_net, _recurrent_net,
+                                          _graph_net],
+                             ids=["dense", "recurrent", "graph"])
+    def test_param_bytes_exact(self, make_net):
+        """Acceptance: param bytes match sum(p.size * p.dtype.itemsize)
+        EXACTLY — totals and the per-layer rows both."""
+        net = make_net()
+        rep = memory_report(net, 16)
+        assert rep["totals"]["param_bytes"] == _exact_bytes(net.params)
+        assert sum(r["param_bytes"] for r in rep["layers"]) == \
+            _exact_bytes(net.params)
+        assert rep["totals"]["grad_bytes"] == rep["totals"]["param_bytes"]
+
+    @pytest.mark.parametrize("make_net", [_dense_net, _graph_net],
+                             ids=["dense", "graph"])
+    def test_opt_state_total_exact_and_attributed(self, make_net):
+        net = make_net()
+        rep = memory_report(net, 16)
+        assert rep["totals"]["opt_state_bytes"] == _exact_bytes(net.opt_state)
+        # every param-bearing layer gets an optimizer share
+        for row in rep["layers"]:
+            if row["param_bytes"]:
+                assert row["opt_state_bytes"] > 0
+
+    def test_activations_and_projection(self):
+        net = _dense_net()
+        rep = memory_report(net, 32)
+        # 32x16 hidden + 32x4 output, in the params' float width (the x64
+        # test env initializes f64 params; compute follows them)
+        item = np.dtype(jax.tree_util.tree_leaves(net.params)[0].dtype).itemsize
+        acts = [r["activation_bytes"] for r in rep["layers"]]
+        assert acts[0] == 32 * 16 * item and acts[1] == 32 * 4 * item
+        t = rep["totals"]
+        assert t["projected_peak_bytes"] == (
+            2 * t["param_bytes"] + t["opt_state_bytes"]
+            + t["activation_bytes"] + t["input_bytes"]
+        )
+        assert rep["top_consumers"][0]["total_bytes"] == max(
+            r["total_bytes"] for r in rep["layers"])
+
+    def test_net_methods_and_example_input(self):
+        net = _graph_net()
+        rep = net.memory_report([np.zeros((4, 8), np.float32)])
+        assert rep["model"] == "ComputationGraph"
+        assert [r["name"] for r in rep["layers"]] == ["h", "out"]
+        assert rep["inputs"][0]["shape"] == [4, 8]
+
+
+# --------------------------------------------------------------------------
+# preflight
+# --------------------------------------------------------------------------
+class TestPreflight:
+    def test_raises_on_absurd_batch_naming_consumers(self):
+        net = _dense_net()
+        with pytest.raises(MemoryPreflightError) as exc:
+            preflight(net, 1 << 22, limit_bytes=1 << 20)
+        assert "biggest consumers" in str(exc.value)
+        assert "layer[0]" in str(exc.value)
+        assert exc.value.report["totals"]["projected_peak_bytes"] == \
+            exc.value.projected_bytes
+        assert exc.value.limit_bytes == 1 << 20
+
+    def test_passes_on_tier1_batch(self):
+        """A tier-1-sized batch passes — against an explicit budget and
+        against the live fallback limit source (CPU: host MemAvailable)."""
+        net = _dense_net()
+        rep = preflight(net, 32, limit_bytes=1 << 40)
+        assert rep["preflight"]["fits"] is True
+        rep2 = net.preflight(32)
+        pf = rep2["preflight"]
+        assert pf["checked"] is False or pf["fits"] is True
+
+    def test_env_limit_source(self, monkeypatch):
+        monkeypatch.setenv(tmem.HBM_LIMIT_ENV, str(1 << 19))
+        # CPU memory_stats is None, so the env knob is the limit source
+        if tmem.device_memory_stats():
+            pytest.skip("backend exposes memory_stats; env knob not reached")
+        net = _dense_net()
+        with pytest.raises(MemoryPreflightError):
+            preflight(net, 1 << 22)
+
+
+# --------------------------------------------------------------------------
+# executable HBM accounting (compile manager x memory_analysis)
+# --------------------------------------------------------------------------
+class TestExecutableMemory:
+    def test_warm_cache_entries_carry_memory_records(self):
+        """Acceptance: every warm AOT entry has a nonzero memory_analysis
+        record, or an explicit unavailable flag — never silence."""
+        net = _dense_net()
+        xs, ys = _staged_data()
+        net.fit_on_device(xs, ys, steps=3)
+        cm = get_compile_manager()
+        records = cm.memory_records()
+        assert records, "warm cache has no memory records"
+        for rec in records.values():
+            if rec["available"]:
+                assert rec["total_bytes"] > 0, rec
+            else:
+                assert rec["reason"], rec
+        summary = cm.stats()["memory"]
+        assert summary["measured_entries"] + summary["unavailable_entries"] \
+            == len(records)
+        # CPU's PJRT implements memory_analysis: the total must be real
+        assert summary["total_bytes"] > 0
+        snap = get_registry().snapshot()
+        assert snap["dl4jtpu_executable_hbm_total_bytes"]["values"][0][
+            "value"] > 0
+        kinds = {v["labels"]["kind"]
+                 for v in snap["dl4jtpu_executable_hbm_bytes"]["values"]}
+        assert {"argument", "output", "temp", "generated_code"} <= kinds
+
+    def test_eviction_retires_memory_accounting(self):
+        net = _dense_net()
+        xs, ys = _staged_data()
+        net.fit_on_device(xs, ys, steps=3)
+        cm = get_compile_manager()
+        before = len(cm.memory_records())
+        assert before >= 1
+        net.init(force=True)  # drop_token retires the generation
+        assert len(cm.memory_records()) < before
+
+    def test_executable_memory_unavailable_is_flagged(self):
+        class NoAnalysis:
+            def memory_analysis(self):
+                return None
+
+        rec = tmem.executable_memory(NoAnalysis())
+        assert rec == {"available": False,
+                       "reason": "memory_analysis unavailable on this "
+                                 "backend"}
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_stays_bounded_under_10k_events(self):
+        fr = FlightRecorder(capacity=512, registry=MetricsRegistry())
+        for i in range(10_000):
+            fr.record("step", iteration=i)
+        assert len(fr) == 512
+        assert fr.dropped == 10_000 - 512
+        events = fr.events
+        assert events[-1]["iteration"] == 9_999  # newest kept, oldest gone
+        snap = fr.snapshot(last=10)
+        assert snap["recorded"] == 10 and snap["dropped"] == fr.dropped
+
+    def test_injected_nan_loss_dumps_a_bundle(self, tmp_path):
+        """Acceptance: NaN features -> NaN loss inside the jitted scan ->
+        watchdog anomaly -> the recorder (wired as a sink by Telemetry)
+        writes a self-contained JSON bundle with step history, the memory
+        report and a registry snapshot."""
+        reg = MetricsRegistry()
+        fr = FlightRecorder(dump_dir=str(tmp_path), registry=reg,
+                            min_dump_interval_s=3600)
+        net = _dense_net()
+        fr.attach_memory_report(net.memory_report(10))
+        tel = Telemetry(registry=reg, fetch_every=4,
+                        watchdog=Watchdog(sinks=[], registry=reg),
+                        flight_recorder=fr)
+        net.set_telemetry(tel)
+        xs, ys = _staged_data()
+        xs[1, 0, 0] = np.nan  # poison one staged batch
+        net.fit_on_device(xs, ys, steps=5)
+        assert len(fr.dumps) == 1  # rate limit: one bundle per NaN storm
+        bundle = json.loads(open(fr.dumps[0]).read())  # round-trips
+        assert bundle["schema"] == "dl4jtpu-flight-v1"
+        assert bundle["reason"] == "nan-loss"
+        kinds = [e["kind"] for e in bundle["events"]]
+        assert "step" in kinds and "anomaly" in kinds
+        assert "staged_dispatch" in kinds
+        anomaly = next(e for e in bundle["events"] if e["kind"] == "anomaly")
+        assert anomaly["anomaly"] == "nan-loss"
+        steps = [e for e in bundle["events"] if e["kind"] == "step"]
+        assert len(steps) >= 1 and all("loss" in e for e in steps)
+        assert bundle["memory"]["report"]["totals"]["param_bytes"] == \
+            _exact_bytes(net.params)
+        assert "dl4jtpu_train_steps_total" in bundle["registry"]
+        assert "compiles_total" in bundle["compile_cache"]
+        assert bundle["environment"]["jax"]
+
+    def test_explicit_dump_and_compile_events(self, tmp_path):
+        reg = MetricsRegistry()
+        fr = FlightRecorder(dump_dir=str(tmp_path), registry=reg)
+        net = _dense_net().set_telemetry(
+            Telemetry(registry=reg, fetch_every=4, flight_recorder=fr))
+        xs, ys = _staged_data()
+        net.fit_on_device(xs, ys, steps=3)
+        path = fr.dump(reason="manual")
+        bundle = json.loads(open(path).read())
+        assert bundle["reason"] == "manual"
+        assert fr.dumps == [path]
+        # compiles ring into the GLOBAL recorder (the compile manager's box)
+        from deeplearning4j_tpu.telemetry import get_flight_recorder
+
+        kinds = {e["kind"] for e in get_flight_recorder().events}
+        assert "compile" in kinds
+
+    def test_watchdog_auto_dump_rate_limited(self, tmp_path):
+        from deeplearning4j_tpu.telemetry.watchdog import AnomalyEvent
+
+        fr = FlightRecorder(dump_dir=str(tmp_path),
+                            registry=MetricsRegistry(),
+                            min_dump_interval_s=3600)
+        for i in range(5):
+            fr.watchdog_sink(AnomalyEvent(
+                kind="nan-loss", iteration=i, value=float("nan"),
+                threshold=0.0, message="boom"))
+        assert len(fr.dumps) == 1
+        assert sum(1 for e in fr.events if e["kind"] == "anomaly") == 5
+
+    def test_stall_anomaly_does_not_auto_dump_when_excluded(self, tmp_path):
+        from deeplearning4j_tpu.telemetry.watchdog import AnomalyEvent
+
+        fr = FlightRecorder(dump_dir=str(tmp_path),
+                            registry=MetricsRegistry(),
+                            auto_dump_kinds=("nan-loss",))
+        fr.watchdog_sink(AnomalyEvent(
+            kind="stalled-step-time", iteration=1, value=9.0, threshold=1.0,
+            message="slow"))
+        assert fr.dumps == []
+        assert fr.events[-1]["kind"] == "anomaly"
+
+
+# --------------------------------------------------------------------------
+# UI endpoints + live-HBM single source
+# --------------------------------------------------------------------------
+class TestMemoryEndpoints:
+    def test_api_memory_and_flightrecorder(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        net = _dense_net()
+        xs, ys = _staged_data()
+        net.set_telemetry(Telemetry(registry=MetricsRegistry(),
+                                    fetch_every=4))
+        net.fit_on_device(xs, ys, steps=3)
+        server = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            mem = json.loads(urllib.request.urlopen(
+                base + "/api/memory", timeout=10).read())
+            assert set(mem) >= {"devices", "compile_cache", "executables",
+                                "report"}
+            assert mem["compile_cache"]["memory"]["measured_entries"] >= 1
+            fl = json.loads(urllib.request.urlopen(
+                base + "/api/flightrecorder?last=32", timeout=10).read())
+            assert set(fl) >= {"events", "dropped", "dumps", "capacity"}
+            assert len(fl["events"]) <= 32
+        finally:
+            server.stop()
+
+    def test_profiler_wrapper_delegates(self, monkeypatch):
+        """Satellite: profiler.device_memory_stats is a thin wrapper over
+        the telemetry.memory single source."""
+        from deeplearning4j_tpu import profiler
+
+        rows = [{"device": 0, "bytes_in_use": 1, "peak_bytes_in_use": 2,
+                 "bytes_limit": 3}]
+        monkeypatch.setattr(tmem, "device_memory_stats",
+                            lambda registry=None: rows)
+        assert profiler.device_memory_stats() == rows
+
+    def test_sample_device_memory_sets_watermark(self, monkeypatch):
+        reg = MetricsRegistry()
+        fr = FlightRecorder(registry=MetricsRegistry())
+        seq = iter([500, 900, 300])
+
+        class Dev:
+            id = 0
+            platform = "cpu"
+
+            def memory_stats(self):
+                v = next(seq)
+                return {"bytes_in_use": v, "peak_bytes_in_use": v,
+                        "bytes_limit": 1000}
+
+        monkeypatch.setattr(jax, "devices", lambda *a, **k: [Dev()])
+        for _ in range(3):
+            tmem.sample_device_memory(reg, flight=fr)
+        snap = reg.snapshot()
+        peak = snap["dl4jtpu_device_hbm_peak_bytes"]["values"][0]["value"]
+        assert peak == 900  # sticky max, not the last sample
+        kinds = {(v["labels"]["device"], v["labels"]["kind"])
+                 for v in snap["dl4jtpu_device_hbm_bytes"]["values"]}
+        assert ("0", "in_use") in kinds and ("0", "limit") in kinds
+        assert sum(1 for e in fr.events if e["kind"] == "memory") == 3
