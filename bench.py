@@ -114,6 +114,38 @@ def _memory_block(net=None, example=None) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def _static_cost_block(net, example, measured_step_s=None) -> dict:
+    """Per-mode ``static_cost`` block: the roofline model's predicted
+    FLOPs/bytes/step and — when a measured step time is at hand — the
+    predicted-vs-measured ratio, so BENCH_*.json tracks model-vs-reality
+    drift round over round (ratio drifting from its historical band means
+    either the model or the machine changed). Defensive like
+    :func:`_memory_block`: collector failures emit {"error": ...}."""
+    try:
+        rep = net.analyze_ir(example)
+        cost = rep["static_cost"]
+        rl = cost["roofline"]
+        block = {
+            "flops_per_step": cost["flops"],
+            "hbm_bytes_per_step": cost["hbm_bytes"],
+            "arithmetic_intensity": round(cost["arithmetic_intensity"], 4),
+            "predicted_step_seconds": rl["predicted_step_seconds"],
+            "bound": rl["bound"],
+            "roofline": {"peak_flops": rl["peak_flops"],
+                         "hbm_gbps": rl["hbm_gbps"],
+                         "ridge_flops_per_byte":
+                             round(rl["ridge_flops_per_byte"], 2)},
+            "findings": sorted(f.rule_id for f in rep["findings"]),
+        }
+        if measured_step_s:
+            block["measured_step_seconds"] = float(measured_step_s)
+            block["predicted_vs_measured"] = round(
+                rl["predicted_step_seconds"] / float(measured_step_s), 6)
+        return block
+    except Exception as e:  # noqa: BLE001 - the metric line must survive
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
     """ResNet-50 training throughput + step breakdown + XLA-reported MFU.
 
@@ -209,6 +241,7 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
         [step_s], mfu_pct=result.get("mfu_pct"),
         extra_gauges={"bench_images_per_sec": result["value"]})
     result["memory"] = _memory_block(net, batch)
+    result["static_cost"] = _static_cost_block(net, batch, step_s)
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # optional deep dive: xplane trace of one scanned run
         with profiler.trace(trace_dir):
@@ -305,6 +338,8 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
         extra_gauges={"bench_chars_per_sec": result["value"]})
     result["memory"] = _memory_block(net, np.zeros((batch, seq, vocab),
                                                    np.float32))
+    result["static_cost"] = _static_cost_block(
+        net, np.zeros((batch, seq, vocab), np.float32), step_s)
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
         with profiler.trace(trace_dir):
@@ -528,6 +563,7 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
             extra_gauges={"bench_samples_per_sec": round(steps * batch / dt, 1),
                           "bench_last_grad_norm": round(grad_norm.value, 6)}),
         "memory": _memory_block(net, batch),
+        "static_cost": _static_cost_block(net, batch, dt / steps),
     }
     return result
 
@@ -624,6 +660,9 @@ def bench_ragged(batch: int = 512, tail: int = 196, full_batches: int = 10,
         })
     result["telemetry"]["compile"] = cm_stats
     result["memory"] = _memory_block(make_net(), batch)
+    result["static_cost"] = _static_cost_block(
+        make_net(), batch,
+        bucketed["seconds"] / max(epochs * (full_batches + 1), 1))
     return result
 
 

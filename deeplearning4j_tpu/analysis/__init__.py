@@ -13,14 +13,24 @@ Two passes, run before anything compiles:
 - **AST pass** (`ast_checks`): lint Python sources for the classic JAX
   footguns — ``np.*`` under ``jit``, host syncs in hot paths, PRNG key
   reuse, Python control flow on traced values, captured-state mutation.
+- **IR pass** (`ir_checks` + `cost_model`): trace the *real* train step
+  with ``jax.make_jaxpr`` (zero dispatches) and lint what the compiler
+  will actually build — f64 promotion, host callbacks, dropped buffer
+  donation, materialization blow-ups, traced gather/scatter indices,
+  padding waste, collectives — plus a static roofline cost model
+  (FLOPs/bytes/arithmetic intensity, predicted step time). Entry points:
+  ``net.analyze_ir(batch)``, ``conf.analyze(ir=True)``, the CLI ``--ir``
+  flag, and the compile manager's automatic admission scan.
 
-Each finding carries a rule id (``DT0xx``), severity, location and fix
-hint; rules live in a registry (`rules`) so later PRs add checks
-cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress findings
-(`pragmas`). CLI: ``python -m deeplearning4j_tpu.analysis``.
+Each finding carries a rule id (``DT0xx``/``DT1xx``/``DT2xx``), severity,
+location and fix hint; rules live in a registry (`rules`) so later PRs add
+checks cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress AST
+findings (`pragmas`); IR findings (no source line) suppress via
+``ignore=(...)`` / CLI ``--ignore``. CLI:
+``python -m deeplearning4j_tpu.analysis``.
 """
 
-from .findings import Finding, Severity, SEVERITY_ORDER
+from .findings import Finding, Severity, SEVERITY_ORDER, merge_findings
 from .rules import Rule, RULES, get_rule, register_rule
 from .pragmas import filter_findings
 from .graph_checks import (
@@ -31,6 +41,14 @@ from .graph_checks import (
     check_shardings,
 )
 from .ast_checks import check_source, check_file
+from .cost_model import jaxpr_cost, roofline_params, static_cost
+from .ir_checks import (
+    audit_donation,
+    analyze_config_ir,
+    check_jaxpr_ir,
+    check_network_ir,
+    check_padding_waste,
+)
 
 __all__ = [
     "Finding",
@@ -41,6 +59,7 @@ __all__ = [
     "get_rule",
     "register_rule",
     "filter_findings",
+    "merge_findings",
     "check_multi_layer",
     "check_graph",
     "check_config",
@@ -48,4 +67,12 @@ __all__ = [
     "check_shardings",
     "check_source",
     "check_file",
+    "jaxpr_cost",
+    "roofline_params",
+    "static_cost",
+    "audit_donation",
+    "analyze_config_ir",
+    "check_jaxpr_ir",
+    "check_network_ir",
+    "check_padding_waste",
 ]

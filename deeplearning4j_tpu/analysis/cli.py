@@ -3,11 +3,19 @@
 - ``.py`` files (and directories, walked recursively) get the AST pass.
 - ``.json`` files are parsed as serialized configs (``to_json`` output of
   MultiLayerConfiguration / ComputationGraphConfiguration) and get the
-  graph pass.
+  graph pass — plus the jaxpr-level DT2xx IR pass with ``--ir`` (the config
+  is instantiated into its network class and the real train step is traced;
+  the per-config ``static_cost`` roofline report lands in the JSON output).
 
 ``--fail-on`` picks the exit-code threshold: exit 1 when any finding at
 or above that severity survives pragmas, else 0. ``--json`` emits a
-machine-readable report on stdout.
+machine-readable report on stdout. ``--ignore DT204,DT206`` drops rule ids
+from the report (IR findings carry no source line, so this is their
+suppression mechanism — the headless twin of the inline pragma).
+
+Findings from all passes are merged, deduplicated and stable-sorted, so
+analyzing the same artifact twice (or a fact two passes both discover)
+reports once, in a deterministic order.
 """
 
 from __future__ import annotations
@@ -18,7 +26,7 @@ import os
 import sys
 from typing import List
 
-from .findings import Finding, SEVERITY_ORDER, count_by_severity, sort_findings
+from .findings import Finding, SEVERITY_ORDER, count_by_severity, merge_findings
 from .rules import RULES
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
@@ -37,12 +45,26 @@ def _iter_py_files(root: str):
                 yield os.path.join(dirpath, fn)
 
 
-def _analyze_json_config(path: str, batch: int, timesteps: int) -> List[Finding]:
+def _analyze_json_config(path: str, batch: int, timesteps: int,
+                         ir: bool, costs: list) -> List[Finding]:
     from .graph_checks import check_config
 
     with open(path, "r", encoding="utf-8") as fh:
         d = json.load(fh)
-    return check_config(d, batch=batch, timesteps_probe=timesteps, source=path)
+    findings = check_config(d, batch=batch, timesteps_probe=timesteps,
+                            source=path)
+    if ir:
+        from ..nn.conf.computation_graph import ComputationGraphConfiguration
+        from ..nn.conf.multi_layer import MultiLayerConfiguration
+        from .ir_checks import analyze_config_ir
+
+        conf = (ComputationGraphConfiguration.from_dict(d)
+                if "vertices" in d else MultiLayerConfiguration.from_dict(d))
+        ir_findings, cost = analyze_config_ir(
+            conf, batch=batch, timesteps_probe=timesteps, source=path)
+        findings += ir_findings
+        costs.append({"source": path, **cost})
+    return findings
 
 
 def _list_rules() -> str:
@@ -57,7 +79,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deeplearning4j_tpu.analysis",
         description="dl4jtpu-check: static analysis for model configs (.json) "
-                    "and JAX/TPU pitfalls (.py).",
+                    "and JAX/TPU pitfalls (.py); --ir adds the jaxpr-level "
+                    "DT2xx pass + static roofline cost model on configs.",
     )
     ap.add_argument("paths", nargs="*", help=".py files, directories, or "
                     "serialized config .json files")
@@ -68,9 +91,16 @@ def main(argv=None) -> int:
                     help="exit 1 when a finding at/above this severity "
                     "survives (default: error)")
     ap.add_argument("--batch", type=int, default=4,
-                    help="batch size for the eval_shape probe (default 4)")
+                    help="batch size for the eval_shape/IR probe (default 4)")
     ap.add_argument("--timesteps", type=int, default=16,
                     help="probe length substituted for variable timesteps")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the DT2xx jaxpr/IR pass + static cost model on "
+                    "each .json config (traces the real train step)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to drop from the report "
+                    "(e.g. DT204,DT206 — the suppression mechanism for IR "
+                    "findings, which carry no source line for pragmas)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -80,8 +110,15 @@ def main(argv=None) -> int:
         return 0
     if not args.paths:
         ap.error("no paths given (or use --list-rules)")
+    ignored = {r.strip() for r in args.ignore.split(",") if r.strip()}
+    unknown = ignored - set(RULES)
+    if unknown:
+        print(f"error: --ignore names unknown rule(s): "
+              f"{', '.join(sorted(unknown))}", file=sys.stderr)
+        return 2
 
     findings: List[Finding] = []
+    costs: list = []
     n_files = 0
     for path in args.paths:
         if not os.path.exists(path):
@@ -90,7 +127,9 @@ def main(argv=None) -> int:
         if path.endswith(".json"):
             n_files += 1
             try:
-                findings += _analyze_json_config(path, args.batch, args.timesteps)
+                findings += _analyze_json_config(path, args.batch,
+                                                 args.timesteps, args.ir,
+                                                 costs)
             except Exception as e:
                 print(f"error: could not analyze config {path}: {e}",
                       file=sys.stderr)
@@ -102,18 +141,30 @@ def main(argv=None) -> int:
                 n_files += 1
                 findings += check_file(py)
 
-    findings = sort_findings(findings)
+    findings = merge_findings(f for f in findings
+                              if f.rule_id not in ignored)
     counts = count_by_severity(findings)
     if args.as_json:
-        print(json.dumps({
+        report = {
             "version": 1,
             "files_analyzed": n_files,
             "counts": counts,
             "findings": [f.to_dict() for f in findings],
-        }, indent=2))
+        }
+        if args.ir:
+            report["static_cost"] = costs
+        print(json.dumps(report, indent=2))
     else:
         for f in findings:
             print(f.format_human())
+        for cost in costs:
+            rl = cost["roofline"]
+            print(f"{cost['source']}: static_cost: "
+                  f"{cost['flops']:,} FLOPs/step, "
+                  f"{cost['hbm_bytes']:,} HBM bytes/step, "
+                  f"AI {cost['arithmetic_intensity']:.2f} FLOPs/byte, "
+                  f"predicted {rl['predicted_step_seconds']:.3g}s/step "
+                  f"({rl['bound']}-bound)")
         print(f"{len(findings)} finding(s) ({counts['error']} error, "
               f"{counts['warning']} warning, {counts['info']} info) "
               f"across {n_files} file(s)")

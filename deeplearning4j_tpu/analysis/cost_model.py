@@ -1,0 +1,302 @@
+"""Static roofline cost model: FLOPs/bytes/intensity of a jaxpr, no devices.
+
+Walks the eqns of a ``jax.make_jaxpr`` trace with a per-primitive cost table
+and emits a ``static_cost`` report — total FLOPs, HBM bytes touched,
+arithmetic intensity, and a predicted step time from a configurable roofline
+(Williams et al., "Roofline: an insightful visual performance model"). The
+whole pass is host-side shape algebra: no compile, no dispatch, no profiler
+run — cheap enough to gate CI on.
+
+Counting conventions (deliberately simple, deliberately stated):
+
+- ``dot_general``: exact ``2 * batch * M * N * K``; ``conv_general_dilated``:
+  exact ``2 * out_elements * kernel_spatial * C_in / feature_groups``. These
+  two dominate real models and are bit-exact against the closed forms
+  (tests/test_ir_cost.py holds them to equality).
+- reductions count one FLOP per input element; every other arithmetic eqn
+  counts one FLOP per output element (a transcendental is 1 FLOP — the MXU
+  doesn't run it anyway, the VPU cost model is not the bottleneck we chase).
+- pure data movement (reshape/transpose/slice/broadcast/convert/...) is
+  0 FLOPs but still moves bytes.
+- bytes per eqn = operand bytes + result bytes. No fusion modeling: XLA will
+  beat this number, so arithmetic intensity is a *lower bound* and the
+  predicted step time an *upper bound* — the right polarity for a gate.
+- ``scan`` multiplies its body by the static trip count; ``while`` (dynamic
+  trip count) counts ONE iteration and sets ``dynamic_loop`` — per-step cost
+  is what the report means, and the staged ``fori_loop`` runs one optimizer
+  step per iteration.
+- ``cond`` takes the most expensive branch (upper bound again).
+
+Collectives (``psum``/``all_gather``/``ppermute``/...) are tallied
+separately — count and payload bytes per step — feeding the DT207 check.
+
+Roofline knobs: ``DL4JTPU_PEAK_FLOPS`` (peak FLOP/s) and ``DL4JTPU_HBM_GBPS``
+(HBM GB/s); defaults model one TPU v4 core (275 Tf/s bf16, 1228 GB/s).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "PEAK_FLOPS_ENV",
+    "HBM_GBPS_ENV",
+    "roofline_params",
+    "jaxpr_cost",
+    "static_cost",
+    "subjaxprs",
+]
+
+PEAK_FLOPS_ENV = "DL4JTPU_PEAK_FLOPS"
+HBM_GBPS_ENV = "DL4JTPU_HBM_GBPS"
+DEFAULT_PEAK_FLOPS = 2.75e14  # one TPU v4 core, bf16 MXU
+DEFAULT_HBM_GBPS = 1228.0  # TPU v4 HBM2 bandwidth
+
+# pure data movement: 0 FLOPs, bytes only
+_ZERO_FLOP = frozenset({
+    "reshape", "broadcast_in_dim", "transpose", "squeeze", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "convert_element_type", "bitcast_convert_type", "copy", "rev", "iota",
+    "stop_gradient", "gather", "scatter", "select_n", "split",
+    "device_put",
+})
+
+# one FLOP per INPUT element (tree reductions)
+_REDUCE = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumprod", "cummax", "cummin",
+})
+
+# cross-device data movement, tallied separately for DT207
+_COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "psum_scatter", "pbroadcast",
+})
+
+
+def roofline_params() -> dict:
+    """The configured roofline: peak FLOP/s, HBM GB/s, and the ridge point
+    (FLOPs/byte above which a kernel is compute-bound)."""
+    def _env_float(name: str, default: float) -> float:
+        raw = os.environ.get(name)
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        return default
+
+    peak = _env_float(PEAK_FLOPS_ENV, DEFAULT_PEAK_FLOPS)
+    gbps = _env_float(HBM_GBPS_ENV, DEFAULT_HBM_GBPS)
+    return {
+        "peak_flops": peak,
+        "hbm_gbps": gbps,
+        "ridge_flops_per_byte": peak / (gbps * 1e9),
+    }
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0  # abstract tokens / effects
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        itemsize = int(np.dtype(dtype).itemsize)
+    except TypeError:
+        # extended dtypes (PRNG key<fry> etc.): negligible, count the
+        # elements at 4 bytes rather than crashing the whole report
+        itemsize = int(getattr(dtype, "itemsize", 4) or 4)
+    return n * itemsize
+
+
+def _aval_elems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _dot_general_flops(eqn) -> int:
+    """Exact 2*batch*M*N*K from the dimension numbers."""
+    (lhs_c, rhs_c), (lhs_b, _rhs_b) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = 1
+    for d in lhs_b:
+        batch *= int(lhs[d])
+    k = 1
+    for d in lhs_c:
+        k *= int(lhs[d])
+    m = 1
+    for i, d in enumerate(lhs):
+        if i not in lhs_c and i not in lhs_b:
+            m *= int(d)
+    n = 1
+    rhs_b = eqn.params["dimension_numbers"][1][1]
+    for i, d in enumerate(rhs):
+        if i not in rhs_c and i not in rhs_b:
+            n *= int(d)
+    return 2 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    """Exact 2 * out_elements * kernel_spatial * C_in / feature_groups."""
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = dn.rhs_spec  # (out_chan, in_chan, *spatial)
+    kernel = eqn.invars[1].aval.shape
+    c_in = int(kernel[rhs_spec[1]])  # the kernel dim is already C_in/groups
+    spatial = 1
+    for d in rhs_spec[2:]:
+        spatial *= int(kernel[d])
+    out_elems = _aval_elems(eqn.outvars[0].aval)
+    return 2 * out_elems * spatial * c_in  # c_in is already per-group
+
+
+def subjaxprs(eqn) -> List[Tuple[Any, int]]:
+    """(closed_jaxpr, multiplier) pairs nested inside one eqn.
+
+    ``scan`` multiplies by its static trip count; ``while`` counts one
+    iteration (dynamic trip count — the caller flags it); ``cond`` returns
+    every branch (the cost walker takes the max). The generic fallback scans
+    params for jaxpr-shaped values so new wrapper primitives (remat, custom
+    derivatives, pjit) keep being walked without a registry update.
+    """
+    from jax import core  # noqa: PLC0415
+
+    def closed(j):
+        if isinstance(j, core.ClosedJaxpr):
+            return j
+        if isinstance(j, core.Jaxpr):
+            return core.ClosedJaxpr(j, ())
+        return None
+
+    name = eqn.primitive.name
+    if name == "scan":
+        body = closed(eqn.params["jaxpr"])
+        return [(body, int(eqn.params.get("length", 1)))] if body else []
+    if name == "while":
+        out = []
+        for key in ("cond_jaxpr", "body_jaxpr"):
+            j = closed(eqn.params.get(key))
+            if j is not None:
+                out.append((j, 1))
+        return out
+    if name == "cond":
+        return [(b, 1) for b in map(closed, eqn.params.get("branches", ()))
+                if b is not None]
+    out = []
+    for v in eqn.params.values():
+        j = closed(v)
+        if j is not None:
+            out.append((j, 1))
+        elif isinstance(v, (tuple, list)):
+            out.extend((closed(x), 1) for x in v if closed(x) is not None)
+    return out
+
+
+def _eqn_cost(eqn) -> Tuple[int, int]:
+    """(flops, bytes) of one leaf eqn (no nested jaxpr)."""
+    name = eqn.primitive.name
+    in_bytes = sum(_aval_bytes(v.aval) for v in eqn.invars)
+    out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    if name == "dot_general":
+        flops = _dot_general_flops(eqn)
+    elif name == "conv_general_dilated":
+        flops = _conv_flops(eqn)
+    elif name in _ZERO_FLOP:
+        flops = 0
+    elif name in _REDUCE or name.startswith("reduce_"):
+        flops = sum(_aval_elems(v.aval) for v in eqn.invars)
+    else:
+        flops = sum(_aval_elems(v.aval) for v in eqn.outvars)
+    return flops, in_bytes + out_bytes
+
+
+def jaxpr_cost(closed_jaxpr) -> dict:
+    """Cost report of a (closed) jaxpr: FLOPs, HBM bytes, per-primitive
+    breakdown, collective tally, roofline projection. Pure host arithmetic.
+    """
+    acc = {
+        "flops": 0, "hbm_bytes": 0, "eqns": 0, "dynamic_loop": False,
+        "by_primitive": {},
+        "collectives": {"count": 0, "bytes": 0, "by_primitive": {}},
+    }
+
+    def walk(closed, mult: int) -> Tuple[int, int]:
+        flops_here = 0
+        bytes_here = 0
+        for eqn in closed.jaxpr.eqns:
+            name = eqn.primitive.name
+            nested = subjaxprs(eqn)
+            if name == "while":
+                acc["dynamic_loop"] = True
+            if nested:
+                if name == "cond":
+                    best = (0, 0)
+                    for sub, m in nested:
+                        best = max(best, walk(sub, mult * m))
+                    f, b = best
+                else:
+                    f = b = 0
+                    for sub, m in nested:
+                        sf, sb = walk(sub, mult * m)
+                        f += sf
+                        b += sb
+                flops_here += f
+                bytes_here += b
+                continue
+            f, b = _eqn_cost(eqn)
+            f *= mult
+            b *= mult
+            flops_here += f
+            bytes_here += b
+            acc["eqns"] += mult
+            row = acc["by_primitive"].setdefault(
+                name, {"count": 0, "flops": 0, "bytes": 0})
+            row["count"] += mult
+            row["flops"] += f
+            row["bytes"] += b
+            if name in _COLLECTIVES:
+                payload = mult * sum(_aval_bytes(v.aval) for v in eqn.invars)
+                acc["collectives"]["count"] += mult
+                acc["collectives"]["bytes"] += payload
+                crow = acc["collectives"]["by_primitive"].setdefault(
+                    name, {"count": 0, "bytes": 0})
+                crow["count"] += mult
+                crow["bytes"] += payload
+        return flops_here, bytes_here
+
+    flops, nbytes = walk(closed_jaxpr, 1)
+    acc["flops"] = int(flops)
+    acc["hbm_bytes"] = int(nbytes)
+    acc["arithmetic_intensity"] = (
+        flops / nbytes if nbytes else 0.0)
+    rl = roofline_params()
+    compute_s = flops / rl["peak_flops"] if rl["peak_flops"] else 0.0
+    memory_s = (nbytes / (rl["hbm_gbps"] * 1e9)) if rl["hbm_gbps"] else 0.0
+    rl["predicted_step_seconds"] = max(compute_s, memory_s)
+    rl["compute_seconds"] = compute_s
+    rl["memory_seconds"] = memory_s
+    rl["bound"] = ("compute" if acc["arithmetic_intensity"]
+                   >= rl["ridge_flops_per_byte"] else "memory")
+    acc["roofline"] = rl
+    return acc
+
+
+def static_cost(fn, *example_args, **make_jaxpr_kw) -> dict:
+    """Trace ``fn`` at ``example_args`` (arrays or ``ShapeDtypeStruct``
+    shells — nothing executes) and cost the resulting jaxpr. ``fn`` may be
+    ``jax.jit``-wrapped; the walker recurses through the pjit eqn."""
+    import jax  # noqa: PLC0415
+
+    closed = jax.make_jaxpr(fn, **make_jaxpr_kw)(*example_args)
+    return jaxpr_cost(closed)

@@ -56,10 +56,32 @@ class Finding:
 
 
 def sort_findings(findings) -> list:
+    # message/severity participate so equal-location findings order
+    # deterministically across passes and repeated runs
     return sorted(
         findings,
-        key=lambda f: (f.file, f.line, f.col, f.rule_id, f.context),
+        key=lambda f: (f.file, f.line, f.col, f.rule_id, f.context,
+                       f.severity, f.message),
     )
+
+
+def merge_findings(*finding_groups) -> list:
+    """Stable-sorted union of finding lists with exact duplicates dropped.
+
+    The three passes (graph/AST/IR) can legitimately rediscover the same
+    fact (e.g. ``conf.analyze(ir=True)`` run twice, or a config passed to
+    the CLI twice); identity is the full finding tuple, so two findings
+    that differ in any user-visible field both survive.
+    """
+    seen = set()
+    out = []
+    for f in sort_findings([f for g in finding_groups for f in g]):
+        key = (f.rule_id, f.severity, f.message, f.file, f.line, f.col,
+               f.context)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
 
 
 def count_by_severity(findings) -> dict:

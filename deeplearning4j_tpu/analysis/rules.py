@@ -1,6 +1,7 @@
-"""Rule registry: every check has a DT0xx id, default severity and fix hint.
+"""Rule registry: every check has a DTxxx id, default severity and fix hint.
 
-DT0xx = graph/config rules (pass 1), DT1xx = AST lint rules (pass 2).
+DT0xx = graph/config rules (pass 1), DT1xx = AST lint rules (pass 2),
+DT2xx = jaxpr/HLO IR rules (pass 3 — what the compiler actually built).
 Register new rules with :func:`register_rule`; the catalog drives
 ``--list-rules``, docs/static_analysis.md, and pragma validation.
 """
@@ -207,4 +208,83 @@ register_rule(Rule(
     "The carry must be loop-invariant in shape AND dtype.",
     "Seed carry components as typed arrays: jnp.zeros((), dtype=x.dtype) / "
     "jnp.asarray(0.0, jnp.float32) instead of 0 / 0.0.",
+))
+
+# ------------------------------------------------------------------ IR rules
+# Pass 3 operates on the traced jaxpr / lowered artifacts, so these findings
+# carry no source line; suppress them with the ``ignore=`` argument of
+# ``analyze_ir``/``conf.analyze(ir=True)`` or the CLI ``--ignore`` flag
+# instead of line pragmas.
+register_rule(Rule(
+    "DT200", "silent float64 promotion in a traced step", "warning", "ir",
+    "An eqn in the traced step produces a strongly-typed float64 result "
+    "from non-float64 inputs (a NumPy f64 scalar constant, an explicit "
+    "astype, or x64-mode promotion): from that point on the whole dataflow "
+    "cone runs in software-emulated f64 on TPU.",
+    "Keep constants weakly typed (Python floats / jnp scalars), never "
+    "np.float64; derive casts from x.dtype. jax.config.jax_enable_x64 "
+    "belongs in offline gradient checks only.",
+))
+register_rule(Rule(
+    "DT201", "host callback inside a jitted step", "warning", "ir",
+    "io_callback/pure_callback/debug_callback (incl. jax.debug.print) "
+    "traced into the step function: every execution round-trips to the "
+    "Python host, serializing the device queue — the per-step sync the "
+    "whole staged path exists to avoid.",
+    "Move host I/O outside the step (telemetry's K-step fetch pattern); "
+    "keep jax.debug.* for debugging sessions, not training code.",
+))
+register_rule(Rule(
+    "DT202", "requested donation dropped by the compiler", "warning", "ir",
+    "An argument was donated (donate_argnums) but no output matches its "
+    "shape/dtype, so the donation is silently dropped: params/optimizer "
+    "state stay double-buffered and the step pays peak HBM for two copies.",
+    "Make the donated argument's update an OUTPUT with identical "
+    "shape/dtype (thread it through the step), or stop donating it; "
+    "audit with analysis.audit_donation(fn, args, donate_argnums=...).",
+))
+register_rule(Rule(
+    "DT203", "materialization blow-up", "warning", "ir",
+    "An eqn materializes an output orders of magnitude larger than its "
+    "operands (broadcast/outer-product/one-hot style): if XLA fails to "
+    "fuse it, the temporary alone can blow the HBM budget.",
+    "Reformulate to keep the big intermediate virtual (e.g. einsum the "
+    "factors directly, use jnp.take instead of one-hot @ table), or remat "
+    "the region; check memory_report()/the executable's temp bytes.",
+))
+register_rule(Rule(
+    "DT204", "gather/scatter with traced indices", "warning", "ir",
+    "A gather/scatter eqn consumes indices that are traced values: dynamic "
+    "addressing defeats TPU vectorization — XLA serializes it through "
+    "scalar cores or worse, one DMA per row.",
+    "Prefer dense formulations (one-hot matmul for small vocabularies, "
+    "masked select_n), sort indices host-side, or accept it knowingly "
+    "(embedding lookups) via ignore=(\"DT204\",).",
+))
+register_rule(Rule(
+    "DT205", "padding waste above threshold", "warning", "ir",
+    "The BucketedStager's power-of-two buckets padded this epoch far past "
+    "the real data: more than the threshold fraction of staged elements "
+    "(hence FLOPs) were padding.",
+    "Pick bucket boundaries closer to the real length distribution "
+    "(BucketedStager(time_boundaries=...)), sort/batch by length upstream, "
+    "or reduce the stage window so partial tails pad less.",
+))
+register_rule(Rule(
+    "DT206", "step projected memory-bound", "info", "ir",
+    "The step's arithmetic intensity (FLOPs/HBM byte, un-fused upper-bound "
+    "traffic) sits below the configured roofline ridge point: the MXU will "
+    "stall on HBM no matter how the schedule shakes out.",
+    "Raise intensity: bigger batch, bf16 compute/params, fuse more steps "
+    "per dispatch (fit_on_device), remat instead of materializing. Tune "
+    "the roofline via DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS.",
+))
+register_rule(Rule(
+    "DT207", "per-step collective volume", "info", "ir",
+    "The step contains cross-device collectives (psum/all_gather/"
+    "ppermute/...); the estimated payload moves over ICI/DCN on EVERY "
+    "optimizer step and scales with the mesh, not the batch.",
+    "Expected for data-parallel gradients — verify the volume matches "
+    "2*param_bytes; anything larger suggests resharding inside the step "
+    "(check DT009 and with_sharding_constraint placement).",
 ))

@@ -191,6 +191,23 @@ class BucketedStager:
         self.pad_examples = bool(pad_examples) and self.bucketing
         self.time_boundaries = time_boundaries
         self._last_window_sig = None  # flight-recorder transition tracking
+        # real-vs-staged byte accounting across every window built: the
+        # ground truth the DT205 padding-waste check compares the pow2
+        # bucket shapes against (analysis/ir_checks.check_padding_waste)
+        self._padding = {"windows": 0, "batches": 0,
+                         "real_bytes": 0, "staged_bytes": 0}
+
+    def padding_stats(self) -> dict:
+        """Cumulative padding accounting: staged bytes (what the device
+        loop will touch, dummy window slots excluded — they never execute)
+        vs real data bytes, and the resulting padding fraction. FLOPs scale
+        with elements for the dense/recurrent layers the stager serves, so
+        the byte fraction is the FLOP-waste fraction DT205 reports."""
+        out = dict(self._padding)
+        out["padding_fraction"] = (
+            1.0 - out["real_bytes"] / out["staged_bytes"]
+            if out["staged_bytes"] else 0.0)
+        return out
 
     def _note_transition(self, sig, n_real: int) -> None:
         """Ring a ``bucket_shape`` event into the flight recorder when the
@@ -294,6 +311,16 @@ class BucketedStager:
             lmasks.append(m)
 
         n_real = len(group)
+        # padding accounting for DT205: staged = what the loop will execute
+        # (real slots only — dummy window slots are never indexed), real =
+        # the data as the stream delivered it
+        self._padding["windows"] += 1
+        self._padding["batches"] += n_real
+        self._padding["staged_bytes"] += sum(
+            int(a.nbytes) for a in feats + labs)
+        self._padding["real_bytes"] += sum(
+            int(np.asarray(a).nbytes)
+            for m in group for a in m.features + m.labels)
         window = self.stage if n_real == self.stage else min(
             self.stage, next_pow2(n_real))
 

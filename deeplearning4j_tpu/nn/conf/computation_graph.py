@@ -94,14 +94,24 @@ class ComputationGraphConfiguration:
             known[name] = self.vertices[name].get_output_type(*ins)
         return result
 
-    def analyze(self, **kw):
-        """Run the dl4jtpu-check graph pass over this DAG; returns a list of
+    def analyze(self, ir: bool = False, **kw):
+        """Run the dl4jtpu-check graph pass over this DAG; returns a merged,
+        deduplicated, stable-sorted list of
         :class:`~deeplearning4j_tpu.analysis.Finding` with per-vertex
-        diagnostics (empty = clean). See docs/static_analysis.md; keywords
-        forward to :func:`deeplearning4j_tpu.analysis.check_graph`."""
-        from ...analysis import check_graph  # local: analysis is optional at runtime
+        diagnostics (empty = clean). ``ir=True`` additionally builds the
+        graph and runs the DT2xx jaxpr/IR pass over its real train step.
+        See docs/static_analysis.md; keywords forward to
+        :func:`deeplearning4j_tpu.analysis.check_graph` /
+        :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
+        from ...analysis import check_graph, merge_findings  # local: analysis is optional at runtime
 
-        return check_graph(self, **kw)
+        ignore = frozenset(kw.pop("ignore", ()))
+        findings = check_graph(self, **kw)
+        if ir:
+            from ...analysis.ir_checks import analyze_config_ir
+
+            findings += analyze_config_ir(self, **kw)[0]
+        return merge_findings(f for f in findings if f.rule_id not in ignore)
 
     def output_types(self) -> List[InputType]:
         known: Dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))

@@ -72,14 +72,24 @@ class MultiLayerConfiguration:
         return self.layers[-1].get_output_type(its[-1])
 
     # ---- static analysis ----------------------------------------------------
-    def analyze(self, **kw):
-        """Run the dl4jtpu-check graph pass over this config; returns a list
-        of :class:`~deeplearning4j_tpu.analysis.Finding` (empty = clean).
-        See docs/static_analysis.md; keywords forward to
-        :func:`deeplearning4j_tpu.analysis.check_multi_layer`."""
-        from ...analysis import check_multi_layer  # local: analysis is optional at runtime
+    def analyze(self, ir: bool = False, **kw):
+        """Run the dl4jtpu-check graph pass over this config; returns a
+        merged, deduplicated, stable-sorted list of
+        :class:`~deeplearning4j_tpu.analysis.Finding` (empty = clean).
+        ``ir=True`` additionally builds the network and runs the DT2xx
+        jaxpr/IR pass over its real train step (see
+        docs/static_analysis.md); keywords forward to
+        :func:`deeplearning4j_tpu.analysis.check_multi_layer` /
+        :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
+        from ...analysis import check_multi_layer, merge_findings  # local: analysis is optional at runtime
 
-        return check_multi_layer(self, **kw)
+        ignore = frozenset(kw.pop("ignore", ()))
+        findings = check_multi_layer(self, **kw)
+        if ir:
+            from ...analysis.ir_checks import analyze_config_ir
+
+            findings += analyze_config_ir(self, **kw)[0]
+        return merge_findings(f for f in findings if f.rule_id not in ignore)
 
     # ---- JSON ---------------------------------------------------------------
     def to_dict(self) -> dict:

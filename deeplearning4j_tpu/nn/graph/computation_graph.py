@@ -153,6 +153,17 @@ class ComputationGraph:
 
         return preflight(self, batch_or_struct, **kw)
 
+    def analyze_ir(self, batch_or_struct=None, **kw) -> dict:
+        """DT2xx IR lint + static roofline cost model over this graph's real
+        train step — ``jax.make_jaxpr`` over ShapeDtypeStruct shells, zero
+        device dispatches. Returns ``{"findings": [...], "static_cost":
+        {...}}``; suppress rules with ``ignore=("DT204", ...)``. See
+        docs/static_analysis.md (DT2xx) and docs/performance.md (roofline).
+        """
+        from ...analysis.ir_checks import check_network_ir
+
+        return check_network_ir(self, batch_or_struct, **kw)
+
     def summary(self) -> str:
         """Vertex table in topological order: name, type, inputs, out type,
         param count (reference: ComputationGraph.summary())."""
@@ -675,6 +686,22 @@ class ComputationGraph:
                 self._fit_batch(self._as_multi(payload))
         if pending is not None:
             dispatch(pending)
+        self._check_padding_waste(stager)
+
+    def _check_padding_waste(self, stager) -> None:
+        """DT205 epoch hook (see MultiLayerNetwork._check_padding_waste)."""
+        try:
+            from ...analysis.ir_checks import (check_padding_waste,
+                                               record_findings)
+
+            findings = check_padding_waste(
+                stager.padding_stats(),
+                source=f"<{type(self).__name__} epoch {self.epoch}>")
+            registry = (self.telemetry.registry
+                        if self.telemetry is not None else None)
+            record_findings(findings, registry=registry)
+        except Exception:  # observability must never break fit
+            pass
 
     @staticmethod
     def _as_multi(ds):

@@ -234,10 +234,22 @@ class MultiLayerNetwork:
         """Will this net + batch fit in HBM? Raises
         :class:`~deeplearning4j_tpu.telemetry.MemoryPreflightError` naming
         the biggest consumers BEFORE fit/warmup pays a doomed compile;
-        returns the annotated memory report when it fits."""
+        returns the annotated memory report (including the DT2xx IR scan +
+        static cost model) when it fits."""
         from ..telemetry.memory import preflight
 
         return preflight(self, batch_or_struct, **kw)
+
+    def analyze_ir(self, batch_or_struct=None, **kw) -> dict:
+        """DT2xx IR lint + static roofline cost model over this net's real
+        train step — ``jax.make_jaxpr`` over ShapeDtypeStruct shells, zero
+        device dispatches. Returns ``{"findings": [...], "static_cost":
+        {...}}``; suppress rules with ``ignore=("DT204", ...)``. See
+        docs/static_analysis.md (DT2xx) and docs/performance.md (roofline).
+        """
+        from ..analysis.ir_checks import check_network_ir
+
+        return check_network_ir(self, batch_or_struct, **kw)
 
     def summary(self) -> str:
         """Layer table: name, in/out types, param count (reference:
@@ -701,6 +713,25 @@ class MultiLayerNetwork:
                 self._fit_batch(payload)
         if pending is not None:
             dispatch(pending)
+        self._check_padding_waste(stager)
+
+    def _check_padding_waste(self, stager) -> None:
+        """DT205 epoch hook: compare the stager's bucket shapes against the
+        real batch statistics it just staged; findings land in
+        dl4jtpu_ir_findings_total{rule} + the flight recorder. Advisory —
+        never interrupts training."""
+        try:
+            from ..analysis.ir_checks import (check_padding_waste,
+                                              record_findings)
+
+            findings = check_padding_waste(
+                stager.padding_stats(),
+                source=f"<{type(self).__name__} epoch {self.epoch}>")
+            registry = (self.telemetry.registry
+                        if self.telemetry is not None else None)
+            record_findings(findings, registry=registry)
+        except Exception:  # observability must never break fit
+            pass
 
     def _fit_batch(self, ds) -> None:
         self.last_batch_size = int(ds.features.shape[0])

@@ -52,6 +52,10 @@ __all__ = [
 # for every manager-compiled program (see docs/performance.md)
 CACHE_DIR_ENV = "DL4JTPU_XLA_CACHE_DIR"
 
+# env knob: "0" disables the DT2xx IR scan + static cost model run at
+# admission time (see docs/static_analysis.md)
+IR_CHECKS_ENV = "DL4JTPU_IR_CHECKS"
+
 # compile times span ~0.1s (tiny CPU programs) to minutes (ResNet on the
 # tunnel backend) — wider than the step-time default buckets
 COMPILE_TIME_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
@@ -130,6 +134,7 @@ class CompileManager:
         self._lock = threading.RLock()
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._memory: "OrderedDict[Tuple, dict]" = OrderedDict()
+        self._costs: "OrderedDict[Tuple, dict]" = OrderedDict()
         self._token_counter = 0
         if registry is None:
             from ..telemetry import get_registry  # noqa: PLC0415
@@ -161,6 +166,10 @@ class CompileManager:
         self.hbm_total = registry.gauge(
             "dl4jtpu_executable_hbm_total_bytes",
             "cache-wide total HBM footprint of live cached executables")
+        self.ir_findings = registry.counter(
+            "dl4jtpu_ir_findings_total",
+            "IR-lint (DT2xx) findings from admission/preflight/epoch scans",
+            labelnames=("rule",))
 
     # -------------------------------------------------------- observability
     @staticmethod
@@ -202,6 +211,33 @@ class CompileManager:
             return {f"{self._key_kind(k)}#{i}": dict(rec)
                     for i, (k, rec) in enumerate(self._memory.items())}
 
+    def cost_records(self) -> dict:
+        """{key label: static_cost report} for every live AOT entry — the
+        roofline twin of :meth:`memory_records` (same labeling scheme)."""
+        with self._lock:
+            return {f"{self._key_kind(k)}#{i}": dict(rec)
+                    for i, (k, rec) in enumerate(self._costs.items())}
+
+    def _cost_summary(self) -> dict:
+        """Compact static-cost view for ``stats()``: per-entry FLOPs don't
+        sum meaningfully across different programs, so expose the count and
+        the most recently admitted report's headline numbers."""
+        with self._lock:
+            records = list(self._costs.values())
+        out = {"entries_with_cost": len(records)}
+        if records:
+            last = records[-1]
+            rl = last.get("roofline", {})
+            out["last"] = {
+                "kind": last.get("kind"),
+                "flops": last.get("flops"),
+                "hbm_bytes": last.get("hbm_bytes"),
+                "arithmetic_intensity": last.get("arithmetic_intensity"),
+                "predicted_step_seconds": rl.get("predicted_step_seconds"),
+                "bound": rl.get("bound"),
+            }
+        return out
+
     def _memory_summary(self) -> dict:
         with self._lock:
             records = list(self._memory.values())
@@ -238,6 +274,7 @@ class CompileManager:
             for k in stale:
                 del self._entries[k]
                 self._memory.pop(k, None)
+                self._costs.pop(k, None)
             if stale:
                 self.evictions.inc(len(stale))
             self.cache_size.set(len(self._entries))
@@ -259,7 +296,8 @@ class CompileManager:
                 self.cache_hits.inc()
             return entry
 
-    def _put(self, key, value, memory: Optional[dict] = None):
+    def _put(self, key, value, memory: Optional[dict] = None,
+             cost: Optional[dict] = None):
         evicted = 0
         with self._lock:
             # a racing compile of the same key: keep the first, count ours
@@ -271,9 +309,12 @@ class CompileManager:
             self._entries[key] = value
             if memory is not None:
                 self._memory[key] = memory
+            if cost is not None:
+                self._costs[key] = cost
             while len(self._entries) > self.max_entries:
                 old_key, _ = self._entries.popitem(last=False)
                 self._memory.pop(old_key, None)
+                self._costs.pop(old_key, None)
                 self.evictions.inc()
                 evicted += 1
             self.cache_size.set(len(self._entries))
@@ -295,8 +336,9 @@ class CompileManager:
         entry = self._get(key)
         if entry is not None:
             return entry
+        jitted = build()
         t0 = time.perf_counter()
-        compiled = build().lower(*args).compile()
+        compiled = jitted.lower(*args).compile()
         seconds = time.perf_counter() - t0
         self.compile_time.observe(seconds)
         self.compiles.inc()
@@ -307,13 +349,40 @@ class CompileManager:
 
         record = executable_memory(compiled)
         record["kind"] = self._key_kind(key)
+        # DT2xx IR scan + static roofline cost at admission: re-traces the
+        # program host-side (dwarfed by the XLA compile it just paid);
+        # findings land in dl4jtpu_ir_findings_total{rule} + the flight
+        # recorder, the cost report next to the memory record in stats().
+        # Disable with DL4JTPU_IR_CHECKS=0; analysis must never break
+        # compilation, so any failure degrades to cost=None.
+        cost = None
+        if os.environ.get(IR_CHECKS_ENV, "1") != "0":
+            try:
+                from ..analysis.ir_checks import (  # noqa: PLC0415
+                    admission_check, record_findings)
+
+                findings, cost = admission_check(
+                    jitted, compiled, args, kind=self._key_kind(key))
+                cost["kind"] = self._key_kind(key)
+                for f in findings:
+                    self.ir_findings.labels(rule=f.rule_id).inc()
+                if findings:
+                    # counter handled above (the manager may own a private
+                    # registry); record_findings only rings the flight ring
+                    record_findings(findings, registry=False,
+                                    flight=self._flight())
+            except Exception:
+                cost = None
         try:
             self._flight().record(
                 "compile", entry=record["kind"], seconds=round(seconds, 6),
-                hbm_total_bytes=record.get("total_bytes"))
+                hbm_total_bytes=record.get("total_bytes"),
+                static_flops=(cost or {}).get("flops"),
+                predicted_step_seconds=(cost or {}).get(
+                    "roofline", {}).get("predicted_step_seconds"))
         except Exception:
             pass
-        return self._put(key, compiled, memory=record)
+        return self._put(key, compiled, memory=record, cost=cost)
 
     def callable(self, key: Tuple, build: Callable[[], Any]) -> Any:
         """Deduplicated callable for ``key`` (no AOT compile here — the
@@ -341,6 +410,7 @@ class CompileManager:
             "evictions_total": self.evictions.value,
             "compile_seconds": self.compile_time.summary(),
             "memory": self._memory_summary(),
+            "static_cost": self._cost_summary(),
         }
 
 

@@ -371,6 +371,20 @@ def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
     source = "explicit limit_bytes"
     if limit_bytes is None:
         limit_bytes, source = _hbm_limit()
+    # fold in the DT2xx IR scan + static roofline cost: "donation dropped,
+    # step predicted HBM-bound" belongs in the same pre-dispatch report as
+    # "will not fit". Advisory — a failed scan never blocks preflight.
+    try:
+        ir = net.analyze_ir(batch_or_struct)
+        report["ir"] = {
+            "findings": [f.to_dict() for f in ir["findings"]],
+            "static_cost": ir["static_cost"],
+        }
+        from ..analysis.ir_checks import record_findings  # noqa: PLC0415
+
+        record_findings(ir["findings"], registry=registry, flight=flight)
+    except Exception as e:  # no input type / exotic net: note and move on
+        report["ir"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if flight is not None:
         try:
             flight.attach_memory_report(report)

@@ -25,6 +25,9 @@ Pages:
   per-layer ``memory_report``.
 - ``/api/flightrecorder`` — the anomaly flight recorder's event ring
   (``?last=N``) and the dump bundles written so far.
+- ``/api/ircost``     — the IR lint / static roofline view: per-executable
+  ``static_cost`` reports from the compile cache, DT2xx finding counters,
+  and the configured roofline (DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS).
 """
 
 from __future__ import annotations
@@ -428,6 +431,25 @@ class _Handler(BaseHTTPRequestHandler):
                 "compile_cache": cm.stats(),
                 "executables": cm.memory_records(),
                 "report": get_flight_recorder().last_memory_report,
+            }, default=str).encode())
+        if path == "/api/ircost":
+            # IR lint + static roofline: per-executable cost reports from
+            # the compile cache, the DT2xx finding counters, and the
+            # roofline the predictions were made against
+            from ..analysis.cost_model import roofline_params  # noqa: PLC0415
+            from ..runtime.compile_manager import get_compile_manager  # noqa: PLC0415
+
+            cm = get_compile_manager()
+            fam = self._registry().get("dl4jtpu_ir_findings_total")
+            counts = {}
+            if fam is not None:
+                for key, child in fam._items():
+                    counts[key[0] if key else ""] = child.value
+            return self._send(200, json.dumps({
+                "roofline": roofline_params(),
+                "cost_records": cm.cost_records(),
+                "summary": cm.stats()["static_cost"],
+                "findings_total": counts,
             }, default=str).encode())
         if path == "/api/flightrecorder":
             from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415

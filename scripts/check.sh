@@ -22,6 +22,69 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/datasets/bucketing.py \
     --fail-on warning
 
+echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# DT2xx over the real train steps of both network classes (dense MLP and a
+# graph twin) — the jaxpr-level analog of the analyzer self-check above.
+# Must be clean at warning level (DT206 "memory-bound" is info by design).
+from deeplearning4j_tpu import (ComputationGraph, ComputationGraphConfiguration,
+                                DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.analysis import SEVERITY_ORDER
+
+mln = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[DenseLayer(n_out=128, activation="relu"),
+            DenseLayer(n_out=128, activation="relu"),
+            OutputLayer(n_out=16, activation="softmax", loss="mcxent")],
+    input_type=InputType.feed_forward(128),
+    updater=UpdaterConfig(updater="adam", learning_rate=1e-3)))
+graph = ComputationGraph(
+    ComputationGraphConfiguration.builder()
+    .add_inputs("in")
+    .add_layer("h", DenseLayer(n_out=64, activation="relu"), "in")
+    .add_layer("out", OutputLayer(n_out=8, activation="softmax",
+                                  loss="mcxent"), "h")
+    .set_outputs("out")
+    .set_input_types(InputType.feed_forward(32))
+    .build())
+bad = []
+for net in (mln, graph):
+    rep = net.analyze_ir(64)
+    assert rep["static_cost"]["flops"] > 0
+    bad += [f for f in rep["findings"]
+            if SEVERITY_ORDER[f.severity] >= SEVERITY_ORDER["warning"]]
+for f in bad:
+    print(f.format_human())
+assert not bad, f"{len(bad)} DT2xx warning+ finding(s) in the repo's own steps"
+print("IR self-scan clean (both net classes, warning threshold)")
+PY
+
+echo "== roofline smoke: static cost model on the bench MLP"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# the bench MLP's predicted FLOPs must match the closed form and the
+# roofline must produce a finite, positive step-time prediction
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+
+B, H = 512, 1000
+net = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[DenseLayer(n_out=H, activation="relu"),
+            OutputLayer(n_out=10, activation="softmax", loss="mcxent")],
+    input_type=InputType.feed_forward(784),
+    updater=UpdaterConfig(updater="sgd", learning_rate=0.1)))
+cost = net.analyze_ir(B)["static_cost"]
+# fwd+bwd matmul floor: first layer pays fwd + dL/dW (no dL/dx — inputs
+# are not differentiated), the head pays fwd + dL/dW + dL/dh
+floor = 2 * (2 * B * 784 * H) + 3 * (2 * B * H * 10)
+assert cost["flops"] >= floor, (cost["flops"], floor)
+rl = cost["roofline"]
+assert rl["predicted_step_seconds"] > 0 and rl["ridge_flops_per_byte"] > 0
+assert cost["arithmetic_intensity"] > 0
+print(f"roofline smoke OK: {cost['flops']:,} FLOPs/step "
+      f"(floor {floor:,}), AI {cost['arithmetic_intensity']:.2f}, "
+      f"predicted {rl['predicted_step_seconds']:.3g}s/step ({rl['bound']})")
+PY
+
 echo "== compile-count smoke: varying steps/tails must not recompile"
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_compile_manager.py::TestRecompileElimination
