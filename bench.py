@@ -114,7 +114,25 @@ def _memory_block(net=None, example=None) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
-def _static_cost_block(net, example, measured_step_s=None) -> dict:
+def _kernels_block(extra: dict | None = None) -> dict:
+    """Per-mode kernel-selection view for the BENCH_* artifact: which
+    variant every fusable site resolved to this run (ops.kernel_select),
+    plus any measured auto-vs-reference ratio the mode computed. Defensive
+    like the other collectors."""
+    try:
+        from deeplearning4j_tpu.ops import kernel_select as ks
+
+        block = ks.stats()
+        block.pop("recent", None)  # the per-mode artifact wants the summary
+        if extra:
+            block.update(extra)
+        return block
+    except Exception as e:  # noqa: BLE001 - the metric line must survive
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def _static_cost_block(net, example, measured_step_s=None, *,
+                       calibration_key=None) -> dict:
     """Per-mode ``static_cost`` block: the roofline model's predicted
     FLOPs/bytes/step and — when a measured step time is at hand — the
     predicted-vs-measured ratio, so BENCH_*.json tracks model-vs-reality
@@ -141,6 +159,19 @@ def _static_cost_block(net, example, measured_step_s=None) -> dict:
             block["measured_step_seconds"] = float(measured_step_s)
             block["predicted_vs_measured"] = round(
                 rl["predicted_step_seconds"] / float(measured_step_s), 6)
+            if calibration_key:
+                # calibration loop: the measured ratio tightens the cost
+                # model's un-fused byte counts for future kernel selections
+                # (KERNEL_CALIBRATION.json — ops.kernel_select). TPU-class
+                # backends only: a CPU-fallback ratio compares a TPU
+                # roofline against CPU wall time and would poison the store.
+                import jax
+
+                if jax.default_backend() in ("tpu", "axon"):
+                    from deeplearning4j_tpu.ops import kernel_select as ks
+
+                    block["calibration_recorded"] = ks.update_calibration(
+                        calibration_key, block["predicted_vs_measured"])
         return block
     except Exception as e:  # noqa: BLE001 - the metric line must survive
         return {"error": f"{type(e).__name__}: {e}"[:300]}
@@ -241,7 +272,9 @@ def bench_resnet50(batch: int = 128, steps: int = 120) -> dict:
         [step_s], mfu_pct=result.get("mfu_pct"),
         extra_gauges={"bench_images_per_sec": result["value"]})
     result["memory"] = _memory_block(net, batch)
-    result["static_cost"] = _static_cost_block(net, batch, step_s)
+    result["static_cost"] = _static_cost_block(net, batch, step_s,
+                                               calibration_key="resnet50")
+    result["kernels"] = _kernels_block()
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # optional deep dive: xplane trace of one scanned run
         with profiler.trace(trace_dir):
@@ -339,7 +372,40 @@ def bench_char_rnn(batch: int = 64, seq: int = 256, vocab: int = 96,
     result["memory"] = _memory_block(net, np.zeros((batch, seq, vocab),
                                                    np.float32))
     result["static_cost"] = _static_cost_block(
-        net, np.zeros((batch, seq, vocab), np.float32), step_s)
+        net, np.zeros((batch, seq, vocab), np.float32), step_s,
+        calibration_key="charrnn")
+    # Kernel-selection A/B (ISSUE 6 acceptance): re-run the same config with
+    # every site pinned to the XLA reference path and report the measured
+    # auto-vs-reference chars/sec ratio next to the variants auto picked.
+    # One compile + one timed scan — cheap next to the main median-of-3.
+    kernels_extra = {}
+    try:
+        from deeplearning4j_tpu.ops import kernel_select as ks
+
+        compare = (os.environ.get("BENCH_KERNELS_COMPARE", "1") == "1"
+                   and ks.mode() == "auto"
+                   and (jax.default_backend() in ("tpu", "axon")
+                        or os.environ.get("BENCH_KERNELS_COMPARE") == "1"))
+        if compare:
+            with ks.forced_mode("reference"):
+                net_r = MultiLayerNetwork(conf).init()
+                multi_r = net_r._build_multi_step(steps)
+                pr, orr, sr = net_r.params, net_r.opt_state, net_r.state
+                pr, orr, sr, key, losses_r = multi_r(
+                    pr, orr, sr, key, n1, k1, xs, ys, None, None)  # warmup
+                np.asarray(losses_r)
+                t0 = time.perf_counter()
+                pr, orr, sr, key, losses_r = multi_r(
+                    pr, orr, sr, key, n1, k1, xs, ys, None, None)
+                np.asarray(losses_r)  # host fetch = sync
+                dt_ref = time.perf_counter() - t0
+            kernels_extra = {
+                "reference_chars_per_sec": round(steps * batch * seq / dt_ref, 1),
+                "auto_vs_reference": round(dt_ref / dt, 3),
+            }
+    except Exception as e:  # noqa: BLE001 - the metric line must survive
+        kernels_extra = {"compare_error": f"{type(e).__name__}: {e}"[:300]}
+    result["kernels"] = _kernels_block(kernels_extra)
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:  # xplane capture AFTER the timed region (same as resnet)
         with profiler.trace(trace_dir):
@@ -491,6 +557,15 @@ def bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
         q, k, v, causal=True))
     dt_xla = timed("xla", lambda q, k, v: attention_xla(q, k, v, causal=True))
     tokens = steps * batch * seq
+    # record what the selection layer resolves for this exact shape, so the
+    # artifact shows the auto pick next to the measured flash-vs-xla ratio
+    try:
+        from deeplearning4j_tpu.ops import select_attention_variant
+
+        auto_pick = select_attention_variant(batch, heads, seq, dim,
+                                             2, causal=True)  # bf16 inputs
+    except Exception:  # noqa: BLE001
+        auto_pick = None
     return {
         "metric": "flash_attention_train_tokens_per_sec",
         "value": round(tokens / dt_flash, 1),
@@ -504,6 +579,11 @@ def bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
             [dt_flash / steps],
             extra_gauges={"bench_tokens_per_sec": round(tokens / dt_flash, 1)}),
         "memory": _memory_block(),  # raw-kernel mode: cache + live stats only
+        # raw-kernel A/B already measures flash vs xla directly; the block
+        # records what auto WOULD pick for this shape alongside
+        "kernels": _kernels_block({
+            "flash_vs_xla_measured": round(dt_xla / dt_flash, 2),
+            "auto_pick": auto_pick}),
     }
 
 
@@ -563,7 +643,9 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
             extra_gauges={"bench_samples_per_sec": round(steps * batch / dt, 1),
                           "bench_last_grad_norm": round(grad_norm.value, 6)}),
         "memory": _memory_block(net, batch),
-        "static_cost": _static_cost_block(net, batch, dt / steps),
+        "static_cost": _static_cost_block(net, batch, dt / steps,
+                                          calibration_key="mlp"),
+        "kernels": _kernels_block(),
     }
     return result
 
@@ -662,7 +744,9 @@ def bench_ragged(batch: int = 512, tail: int = 196, full_batches: int = 10,
     result["memory"] = _memory_block(make_net(), batch)
     result["static_cost"] = _static_cost_block(
         make_net(), batch,
-        bucketed["seconds"] / max(epochs * (full_batches + 1), 1))
+        bucketed["seconds"] / max(epochs * (full_batches + 1), 1),
+        calibration_key="ragged")
+    result["kernels"] = _kernels_block()
     return result
 
 

@@ -11,7 +11,9 @@ counting-tracer test) and walks the eqns:
 - **DT202** requested buffer donation the compiler will drop (audited by
   replaying jax's own shape/dtype output-matching over the donated avals)
 - **DT203** materialization blow-ups (output ≫ operands)
-- **DT204** gather/scatter with traced (non-constant) indices
+- **DT204** gather/scatter with traced (non-constant) indices — constness
+  is propagated forward AND across nested-jaxpr boundaries (a baked numpy
+  index array threaded into a scanned/pjit sub-jaxpr stays constant)
 - **DT205** padding waste from the BucketedStager's pow2 buckets vs the
   real batch statistics of an epoch
 - **DT206** arithmetic intensity below the roofline ridge (memory-bound)
@@ -84,6 +86,71 @@ def _is_f64(aval) -> bool:
     return dt is not None and dt == np.dtype("float64")
 
 
+def _nested_const_invars(eqn, nested, const_flags):
+    """Map constness across a call boundary: for each ``(sub, mult)`` in
+    ``nested`` (the :func:`subjaxprs` output for ``eqn``), the set of the
+    sub-jaxpr's invars that receive a trace-time constant.
+
+    ``const_flags[i]`` says whether ``eqn.invars[i]`` is constant in the
+    enclosing jaxpr. Primitive-specific layouts:
+
+    - ``scan``: invars are ``[*consts, *carry, *xs]``; consts map 1:1 and a
+      constant stacked ``xs`` array stays constant per-slice, but the carry
+      mutates across iterations and is never propagated.
+    - ``while``: ``[*cond_consts, *body_consts, *carry]``; each sub-jaxpr
+      sees its own consts followed by the (non-const) carry.
+    - ``cond``: ``[pred, *operands]``; every branch sees the operands.
+    - generic wrappers (pjit/remat/custom_*): 1:1 when the arities match,
+      conservatively nothing otherwise.
+    """
+    name = eqn.primitive.name
+    out = []
+    if name == "scan":
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        for sub, _ in nested:
+            iv = sub.jaxpr.invars
+            cs = set()
+            for j in range(min(n_consts, len(iv), len(const_flags))):
+                if const_flags[j]:
+                    cs.add(iv[j])
+            base = n_consts + n_carry
+            for k in range(base, min(len(iv), len(const_flags))):
+                if const_flags[k]:
+                    cs.add(iv[k])
+            out.append(cs)
+        return out
+    if name == "while":
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        offsets = []
+        if eqn.params.get("cond_jaxpr") is not None:
+            offsets.append((0, cn))
+        if eqn.params.get("body_jaxpr") is not None:
+            offsets.append((cn, bn))
+        for (off, n), (sub, _) in zip(offsets, nested):
+            iv = sub.jaxpr.invars
+            cs = set()
+            for j in range(min(n, len(iv))):
+                if off + j < len(const_flags) and const_flags[off + j]:
+                    cs.add(iv[j])
+            out.append(cs)
+        return out
+    if name == "cond":
+        for sub, _ in nested:
+            iv = sub.jaxpr.invars
+            cs = {v for j, v in enumerate(iv)
+                  if 1 + j < len(const_flags) and const_flags[1 + j]}
+            out.append(cs)
+        return out
+    for sub, _ in nested:
+        iv = sub.jaxpr.invars
+        cs = ({v for v, flag in zip(iv, const_flags) if flag}
+              if len(iv) == len(const_flags) else set())
+        out.append(cs)
+    return out
+
+
 def _iter_leaf_eqns(closed):
     """Yield ``(eqn, const_derived)`` for every leaf eqn (no nested jaxpr),
     recursing through pjit/scan/while/cond/remat wrappers.
@@ -92,23 +159,30 @@ def _iter_leaf_eqns(closed):
     are trace-time constants — the constvars plus anything computed from
     constants alone (forward const propagation, so indices that pass
     through a ``convert_element_type`` of a baked numpy array still read as
-    static). Best-effort: a constant threaded *into* a nested jaxpr as an
-    argument loses its constness at the boundary.
+    static). Constness crosses nested-jaxpr boundaries: a baked index array
+    threaded into a scanned/cond/pjit sub-jaxpr as an argument arrives there
+    as a constant (:func:`_nested_const_invars` maps the positions), closing
+    the DT204 per-jaxpr limitation PR 5 shipped with.
     """
     from jax import core  # noqa: PLC0415
 
-    stack = [closed]
+    stack = [(closed, frozenset())]
     seen = set()
     while stack:
-        c = stack.pop()
-        if id(c.jaxpr) in seen:
+        c, const_in = stack.pop()
+        key = (id(c.jaxpr), tuple(sorted(id(v) for v in const_in)))
+        if key in seen:
             continue
-        seen.add(id(c.jaxpr))
-        constish = set(c.jaxpr.constvars)
+        seen.add(key)
+        constish = set(c.jaxpr.constvars) | set(const_in)
         for eqn in c.jaxpr.eqns:
             nested = subjaxprs(eqn)
             if nested:
-                stack.extend(sub for sub, _ in nested)
+                flags = [isinstance(v, core.Literal) or v in constish
+                         for v in eqn.invars]
+                stack.extend(
+                    (sub, frozenset(cs)) for (sub, _), cs in zip(
+                        nested, _nested_const_invars(eqn, nested, flags)))
             else:
                 yield eqn, constish
             if eqn.invars and all(
@@ -364,7 +438,7 @@ def check_network_ir(net, batch_or_struct=None, *,
     t_probe = (DEFAULT_TIMESTEPS_PROBE if timesteps_probe is None
                else int(timesteps_probe))
     net.init()
-    inputs = _input_structs(net, batch_or_struct)
+    inputs = _input_structs(net, batch_or_struct, timesteps_probe=t_probe)
     batch = int(inputs[0].shape[0])
     labels = _label_structs(net, batch, t_probe)
     conf_dtype = getattr(net.conf, "dtype", "float32")

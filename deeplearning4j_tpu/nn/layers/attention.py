@@ -69,10 +69,13 @@ class SelfAttentionLayer(BaseLayer):
     n_heads: int = 4
     causal: bool = False
     sequence_parallel: str = "ring"  # ring | all_to_all
-    # local-kernel choice: "xla" (fused by the compiler, materializes [T,T]
-    # scores) or "flash" (Pallas blockwise online-softmax, O(T) memory —
-    # ops/flash_attention.py; the pick for long sequences)
-    attention_impl: str = "xla"
+    # local-kernel choice: "auto" (cost-model-guided — ops.kernel_select
+    # scores the variants on the roofline, flash above the
+    # DL4JTPU_FLASH_MIN_SEQ threshold when it is memory-bound), "xla"
+    # (compiler-fused, materializes [T,T] scores) or "flash" (Pallas
+    # blockwise online-softmax, O(T) memory — ops/flash_attention.py).
+    # The explicit values are the per-site escape hatch.
+    attention_impl: str = "auto"
 
     @property
     def is_recurrent(self) -> bool:
@@ -115,7 +118,12 @@ class SelfAttentionLayer(BaseLayer):
 
         mesh_ctx = get_attention_mesh()
         if mesh_ctx is None:
-            if self.attention_impl == "flash":
+            from ... import ops as _ops  # noqa: PLC0415
+
+            variant = _ops.select_attention_variant(
+                B, H, T, D, x.dtype.itemsize, impl=self.attention_impl,
+                causal=self.causal)
+            if variant == "flash":
                 from ...ops.flash_attention import flash_attention  # noqa: PLC0415
 
                 out = flash_attention(q, k, v, causal=self.causal,

@@ -112,8 +112,9 @@ class LocalResponseNormalization(BaseLayer):
         return False
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        # cross-channel LRN (NHWC last axis); pallas-fused on TPU, unrolled
-        # XLA window-sum otherwise (ops dispatch — SURVEY.md §2.3 helper slot)
+        # cross-channel LRN (NHWC last axis); the fused Pallas pass vs the
+        # unrolled XLA window sum is picked by the cost-model-guided "lrn"
+        # selection site (ops.kernel_select — SURVEY.md §2.3 helper slot)
         from ... import ops as _ops  # noqa: PLC0415
 
         y = _ops.lrn(x, k=self.k, n=self.n, alpha=self.alpha, beta=self.beta)

@@ -74,15 +74,21 @@ def _lstm_scan(
     from ... import ops as _ops0  # noqa: PLC0415
     from ...nn.activations import is_builtin as _is_builtin  # noqa: PLC0415
 
-    if (
+    # Variant routing is cost-model-guided (ops.kernel_select site
+    # "lstm_seq"): the PR 5 roofline scores seqfused / fusedcell / the lax
+    # scan for these concrete shapes at trace time; DL4J_TPU_PALLAS and
+    # set_helpers_enabled keep their exact legacy forcing semantics.
+    acts_ok = (
         act_name is not None and gate_name is not None
-        and _ops0.lstm_sequence_enabled()
         and _ops0.supported_lstm_activations(act_name.lower(), gate_name.lower())
         and _is_builtin(act_name) and _is_builtin(gate_name)
-        and _ops0.sequence_fits(x.shape[0], H, xw_t.dtype.itemsize)
-    ):
+    )
+    variant = _ops0.select_lstm_variant(
+        xw_t.shape[0], x.shape[0], H, xw_t.dtype.itemsize, acts_ok,
+        masked=mask is not None)
+    if variant == "seqfused":
         # whole-loop fusion: h/c carries live in VMEM across the time grid
-        # (DL4J_TPU_PALLAS=seq; see ops/pallas_kernels.fused_lstm_sequence).
+        # (see ops/pallas_kernels.fused_lstm_sequence).
         # A reverse scan is the forward kernel on time-flipped input; padded
         # batches go through the masked variant (held h/c, scan semantics).
         from ...ops.pallas_kernels import (  # noqa: PLC0415
@@ -112,29 +118,22 @@ def _lstm_scan(
     else:
         mask_t = jnp.ones((xw_t.shape[0], 1, 1), xw_t.dtype)
 
-    # Recurrent cell: the pallas helper tier fuses the h@RW matmul + gate
-    # chain in VMEM when the activation pair is in its catalog AND neither
-    # name has been overridden via register_activation (the cuDNN-helper
-    # slot, SURVEY.md §2.3); otherwise the same math via the layer's own
-    # activation callables.
-    from ...nn.activations import is_builtin  # noqa: PLC0415
-    from ... import ops as _ops  # noqa: PLC0415
-    from ...ops.pallas_kernels import _cell_math  # noqa: PLC0415
+    # Scan path. "fusedcell" routes each step through the per-step Pallas
+    # kernel (the cuDNN-helper slot, SURVEY.md §2.3); "reference" runs the
+    # same math inline via the layer's activation callables and lets XLA
+    # fuse the scan body.
+    from ...ops.pallas_kernels import _cell_math, fused_lstm_cell  # noqa: PLC0415
 
     act_key = (act_name or "").lower()
     gate_key = (gate_name or "").lower()
-    use_helper = (
-        act_name is not None
-        and _ops.supported_lstm_activations(act_key, gate_key)
-        and is_builtin(act_name) and is_builtin(gate_name)
-    )
+    use_helper = variant == "fusedcell"
 
     def step(carry, inp):
         h_prev, c_prev = carry
         zx, m = inp
         if use_helper:
-            h, c = _ops.lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
-                                  act_key, gate_key)
+            h, c = fused_lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
+                                   act_key, gate_key)
         else:
             h, c, *_ = _cell_math(zx, h_prev, c_prev, RW, pF, pI, pO, act, gate)
         h = m * h + (1.0 - m) * h_prev

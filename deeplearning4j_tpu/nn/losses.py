@@ -59,8 +59,18 @@ def _activated(preout: jnp.ndarray, activation: str) -> jnp.ndarray:
 
 
 def mcxent(labels, preout, activation="softmax", mask=None):
-    """Multi-class cross entropy (reference: LossMCXENT). Fused with softmax."""
+    """Multi-class cross entropy (reference: LossMCXENT). Fused with softmax
+    numerically always; fused *physically* (one Pallas VMEM pass instead of
+    the max/exp/sum/log HBM round trips) when the ``softmax_xent``
+    kernel-selection site picks the fused variant for these shapes — see
+    ops.kernel_select. Both net classes' output layers route here, so every
+    softmax loss head inherits the selection."""
     if activation == "softmax":
+        lab = jnp.asarray(labels)
+        if preout.ndim == 2 and lab.shape == preout.shape:
+            from .. import ops as _ops  # noqa: PLC0415
+
+            return _apply_mask(_ops.softmax_xent_rows(lab, preout), mask)
         logp = jax.nn.log_softmax(preout, axis=-1)
     else:
         logp = jnp.log(jnp.clip(_activated(preout, activation), EPS, 1.0))

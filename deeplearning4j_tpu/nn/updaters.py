@@ -147,6 +147,69 @@ def gradient_normalization(kind: str, threshold: float = 1.0) -> optax.GradientT
 
 
 # ---------------------------------------------------------------------------
+# Fused optimizer update (kernel-selection site "optimizer")
+# ---------------------------------------------------------------------------
+
+def _maybe_fused_adam(sched, b1: float, b2: float,
+                      eps: float) -> optax.GradientTransformation:
+    """optax.adam with a cost-model-guided fused fast path.
+
+    ``init`` is exactly ``optax.adam``'s, so the optimizer-state pytree
+    (checkpoints, donation signatures) is identical either way. At trace
+    time ``update`` asks the ``optimizer`` kernel-selection site; on the
+    reference choice it delegates to optax verbatim, on the fused choice the
+    whole moment/bias-correct/scale chain runs as one Pallas pass per
+    parameter leaf (ops.fused_adam_update — bit-matching optax's
+    ``scale_by_adam`` + schedule-scale math). Any state layout this wrapper
+    does not recognize falls back to optax, never breaks.
+    """
+    ref = optax.adam(learning_rate=sched, b1=b1, b2=b2, eps=eps)
+
+    def init_fn(params):
+        return ref.init(params)
+
+    def update_fn(updates, state, params=None):
+        from ..ops import (  # noqa: PLC0415 - trace-time only
+            fused_adam_update, select_optimizer_variant)
+
+        leaves = jax.tree_util.tree_leaves(updates)
+        if not leaves:
+            return ref.update(updates, state, params)
+        n_elems = sum(int(l.size) for l in leaves)
+        itemsize = max(l.dtype.itemsize for l in leaves)
+        choice = select_optimizer_variant(n_elems, itemsize, "adam",
+                                          n_leaves=len(leaves))
+        adam_i = next((i for i, s in enumerate(state)
+                       if isinstance(s, optax.ScaleByAdamState)), None)
+        sched_i = next((i for i, s in enumerate(state)
+                        if isinstance(s, optax.ScaleByScheduleState)), None)
+        if choice != "fused" or adam_i is None or sched_i is None:
+            return ref.update(updates, state, params)
+        adam_state, sched_state = state[adam_i], state[sched_i]
+        count_inc = optax.safe_int32_increment(adam_state.count)
+        lr = sched(sched_state.count)
+        bc1 = 1.0 - jnp.asarray(b1) ** count_inc
+        bc2 = 1.0 - jnp.asarray(b2) ** count_inc
+        g_flat, treedef = jax.tree_util.tree_flatten(updates)
+        mu_flat = jax.tree_util.tree_leaves(adam_state.mu)
+        nu_flat = jax.tree_util.tree_leaves(adam_state.nu)
+        outs = [fused_adam_update(g, m, v, lr, bc1, bc2, b1, b2, eps)
+                for g, m, v in zip(g_flat, mu_flat, nu_flat)]
+        unflat = jax.tree_util.tree_unflatten
+        new_updates = unflat(treedef, [o[0] for o in outs])
+        new_mu = unflat(treedef, [o[1] for o in outs])
+        new_nu = unflat(treedef, [o[2] for o in outs])
+        new_state = list(state)
+        new_state[adam_i] = adam_state._replace(count=count_inc, mu=new_mu,
+                                                nu=new_nu)
+        new_state[sched_i] = sched_state._replace(
+            count=optax.safe_int32_increment(sched_state.count))
+        return new_updates, tuple(new_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
 # Updater config (reference: Updater enum + per-updater hyperparams on
 # NeuralNetConfiguration.Builder:486-514)
 # ---------------------------------------------------------------------------
@@ -211,8 +274,8 @@ class UpdaterConfig:
         elif name == "momentum":
             core = optax.sgd(learning_rate=sched, momentum=self.momentum)
         elif name == "adam":
-            core = optax.adam(learning_rate=sched, b1=self.beta1, b2=self.beta2,
-                              eps=self.epsilon)
+            core = _maybe_fused_adam(sched, self.beta1, self.beta2,
+                                     self.epsilon)
         elif name == "adamw":
             core = optax.adamw(learning_rate=sched, b1=self.beta1, b2=self.beta2,
                                eps=self.epsilon)

@@ -5,6 +5,13 @@ CudnnConvolutionHelper reflectively and falls back to builtin math): here the
 "helper" is a Pallas kernel, enabled when running on TPU (or forced via the
 ``DL4J_TPU_PALLAS`` env var: "1" forces on — interpret mode off-TPU, for
 testing — and "0" forces off).
+
+Since the kernel-selection rework, *which* implementation runs at each
+fusable site is decided by :mod:`.kernel_select`: the ``select_*_variant``
+wrappers below translate this module's legacy knobs (``DL4J_TPU_PALLAS``,
+``set_helpers_enabled``) into a ``forced`` choice — preserving their exact
+historical meaning — and otherwise let the PR 5 roofline score the variants
+for the concrete shapes (``DL4JTPU_KERNELS=auto|reference|fused``).
 """
 
 from __future__ import annotations
@@ -14,12 +21,15 @@ from typing import Optional
 
 import jax
 
+from . import kernel_select
 from .pallas_kernels import (
     _ACT,
     _cell_math,
     _window_sum,
+    fused_adam_update,
     fused_lrn,
     fused_lstm_cell,
+    fused_softmax_xent,
     supported_lstm_activations,
 )
 from .flash_attention import flash_attention
@@ -116,21 +126,117 @@ def lstm_cell(zx, h_prev, c_prev, RW, pF, pI, pO,
 
 
 def lrn(x, k: float = 2.0, n: int = 5, alpha: float = 1e-4, beta: float = 0.75):
-    """Cross-channel LRN over the trailing axis."""
-    if helpers_enabled():
+    """Cross-channel LRN over the trailing axis. The variant (fused Pallas
+    pass vs unrolled XLA window sum) is picked by the ``lrn`` selection
+    site; legacy ``set_helpers_enabled``/``DL4J_TPU_PALLAS`` forcing wins."""
+    C = x.shape[-1]
+    rows = max(x.size // max(C, 1), 1)
+    if select_lrn_variant(rows, C, n, x.dtype.itemsize) == "fused":
         return fused_lrn(x, k, n, alpha, beta)
     d = k + alpha * _window_sum(x * x, n)
     return x * d**-beta
 
 
+def softmax_xent_rows(labels2d, preout2d):
+    """Per-row softmax cross-entropy for 2D [N, C] logits/labels — fused
+    Pallas pass or the numerically-identical unfused XLA form, per the
+    ``softmax_xent`` selection site (losses.mcxent routes here)."""
+    N, C = preout2d.shape
+    if select_softmax_xent_variant(N, C, preout2d.dtype.itemsize) == "fused":
+        return fused_softmax_xent(preout2d, labels2d)
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    logp = jax.nn.log_softmax(preout2d, axis=-1)
+    return -jnp.sum(labels2d * logp, axis=-1)
+
+
+# ------------------------------------------------------ selection wrappers
+# Each wrapper maps this module's legacy forcing knobs onto kernel_select's
+# ``forced`` argument (exact historical semantics), then lets the roofline
+# decide. All are host-side, run at trace time, and are cached/logged by
+# kernel_select — same shapes always resolve identically.
+
+
+def select_lstm_variant(T: int, B: int, H: int, itemsize: int,
+                        acts_ok: bool, masked: bool = False) -> str:
+    """'seqfused' | 'fusedcell' | 'reference' for one LSTM direction."""
+    forced = None
+    env = os.environ.get("DL4J_TPU_PALLAS")
+    if _FORCED is False:
+        forced = "reference"
+    elif _FORCED is True:
+        forced = "seqfused"
+    elif env == "0":
+        forced = "reference"
+    elif env == "seq":
+        forced = "seqfused"
+    elif env == "1":
+        forced = "fusedcell"
+    ctx = {"T": int(T), "B": int(B), "H": int(H), "itemsize": int(itemsize),
+           "acts_ok": bool(acts_ok), "masked": bool(masked)}
+    return kernel_select.select("lstm_seq", ctx, forced=forced)
+
+
+def select_attention_variant(B: int, heads: int, T: int, D: int,
+                             itemsize: int, impl: str = "auto",
+                             causal: bool = False) -> str:
+    """'flash' | 'xla' for a local attention call; an explicit
+    ``attention_impl`` ("flash"/"xla") is the per-site escape hatch."""
+    forced = impl if impl in ("flash", "xla") else None
+    if _FORCED is False:
+        forced = "xla"
+    ctx = {"B": int(B), "heads": int(heads), "T": int(T), "D": int(D),
+           "itemsize": int(itemsize), "causal": bool(causal)}
+    return kernel_select.select("attention", ctx, forced=forced)
+
+
+def select_lrn_variant(rows: int, C: int, n: int, itemsize: int) -> str:
+    forced = None
+    env = os.environ.get("DL4J_TPU_PALLAS")
+    if _FORCED is False:
+        forced = "reference"
+    elif _FORCED is True:
+        forced = "fused"
+    elif env == "0":
+        forced = "reference"
+    elif env == "1":
+        forced = "fused"
+    ctx = {"rows": int(rows), "C": int(C), "n": int(n),
+           "itemsize": int(itemsize)}
+    return kernel_select.select("lrn", ctx, forced=forced)
+
+
+def select_softmax_xent_variant(N: int, C: int, itemsize: int) -> str:
+    forced = "reference" if _FORCED is False else None
+    ctx = {"N": int(N), "C": int(C), "itemsize": int(itemsize)}
+    return kernel_select.select("softmax_xent", ctx, forced=forced)
+
+
+def select_optimizer_variant(n_elems: int, itemsize: int, updater: str,
+                             n_leaves: int = 1) -> str:
+    forced = "reference" if _FORCED is False else None
+    ctx = {"n_elems": int(n_elems), "itemsize": int(itemsize),
+           "updater": str(updater), "n_leaves": int(n_leaves)}
+    return kernel_select.select("optimizer", ctx, forced=forced)
+
+
 __all__ = [
     "flash_attention",
+    "fused_adam_update",
     "fused_lrn",
     "fused_lstm_cell",
+    "fused_softmax_xent",
     "helpers_enabled",
+    "kernel_select",
     "lrn",
     "lstm_cell",
     "lstm_helper_enabled",
+    "select_attention_variant",
+    "select_lrn_variant",
+    "select_lstm_variant",
+    "select_optimizer_variant",
+    "select_softmax_xent_variant",
     "set_helpers_enabled",
+    "softmax_xent_rows",
     "supported_lstm_activations",
 ]

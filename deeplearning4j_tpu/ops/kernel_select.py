@@ -1,0 +1,667 @@
+"""Cost-model-guided kernel auto-selection — the routing layer over the
+Pallas helper tier.
+
+The reference hand-routed every hot path to the fastest native kernel it had
+(LSTMHelpers/CudnnConvolutionHelper discovery, SURVEY.md §2.3). This module
+is the TPU-native generalization: every *fusable site* (LSTM sequence,
+attention, LRN, softmax+cross-entropy, the optimizer update) registers its
+kernel variants here with a per-variant static cost estimate, and at trace
+time the PR 5 roofline (:mod:`..analysis.cost_model`) scores the variants
+for the concrete shapes and picks the winner. Layers stop hardcoding
+``DL4J_TPU_PALLAS`` dispatch logic; a future kernel becomes a drop-in win by
+registering one more variant.
+
+How a selection resolves, in precedence order:
+
+1. **forced** — the call site's legacy knobs (``DL4J_TPU_PALLAS``,
+   ``set_helpers_enabled``, an explicit ``attention_impl=``) still win, so
+   every pre-existing escape hatch keeps its exact meaning.
+2. **per-site override** — ``set_site_override("lstm_seq", "reference")`` or
+   the env form ``DL4JTPU_KERNELS=lstm_seq=reference,attention=flash``: the
+   pragma-style escape hatch for one site without touching the others.
+3. **mode** — ``DL4JTPU_KERNELS=auto|reference|fused`` (default ``auto``).
+   ``reference`` pins every site to the XLA path, ``fused`` to the preferred
+   fused variant (still subject to hard feasibility: VMEM fit, supported
+   activations), ``auto`` scores.
+4. **auto scoring** — each feasible variant's (FLOPs, HBM bytes, fixed
+   launch overhead) estimate becomes a predicted time
+   ``max(flops/peak, bytes/bw) + overhead`` on the configured roofline
+   (``DL4JTPU_PEAK_FLOPS``/``DL4JTPU_HBM_GBPS``); minimum wins, fused
+   breaking ties. Fused Pallas variants only *compete* when the process runs
+   on a TPU backend (or :func:`set_force_available` is on — tests/CI score
+   them in interpret mode), mirroring the helper tier's TPU-auto default.
+
+Byte estimates for the XLA reference variants use the cost model's deliberate
+un-fused counting (a known upper bound — PR 5 limits note). The bench feeds
+its measured ``predicted_vs_measured`` ratio back through
+:func:`update_calibration`; the persisted factor (``KERNEL_CALIBRATION.json``)
+discounts exactly those un-fused byte counts, so the model tightens round
+over round instead of staying a static guess.
+
+Every selection is observable end to end: a
+``dl4jtpu_kernel_selected_total{site,variant}`` counter in the PR 2 registry,
+a ``kernel_select`` event in the PR 4 flight recorder, and a ``kernels``
+block in ``CompileManager.stats()`` / ``/api/ircost`` / the BENCH_* artifact.
+Selections are cached per (site, shape key, config), so the same shapes
+always resolve to the same variant and are logged exactly once — pinned by
+tests/test_kernel_select.py.
+
+Host-side only: nothing here touches device buffers; selection runs during
+tracing (zero dispatches) and is pure shape algebra plus the roofline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "KERNELS_ENV",
+    "CALIBRATION_PATH_ENV",
+    "FLASH_MIN_SEQ_ENV",
+    "Variant",
+    "Site",
+    "register_site",
+    "select",
+    "mode",
+    "set_mode",
+    "forced_mode",
+    "set_site_override",
+    "set_force_available",
+    "force_available",
+    "calibration_factor",
+    "update_calibration",
+    "selection_log",
+    "stats",
+    "reset",
+]
+
+# env knob: auto | reference | fused, optionally mixed with per-site
+# overrides ("auto,lstm_seq=reference") — see docs/performance.md
+KERNELS_ENV = "DL4JTPU_KERNELS"
+# env knob: where the fusion-discount calibration JSON lives (default:
+# KERNEL_CALIBRATION.json next to this package's repo root)
+CALIBRATION_PATH_ENV = "DL4JTPU_KERNEL_CALIBRATION"
+# env knob: sequence-length threshold below which auto mode keeps the XLA
+# attention path even when flash is feasible (launch overhead + small [T,T]
+# scores make the fused kernel a wash at short context)
+FLASH_MIN_SEQ_ENV = "DL4JTPU_FLASH_MIN_SEQ"
+DEFAULT_FLASH_MIN_SEQ = 256
+
+_MODES = ("auto", "reference", "fused")
+
+# calibration discount floor: never trust a measured ratio enough to claim
+# XLA fuses >95% of the modeled traffic away
+_CAL_MIN, _CAL_MAX = 0.05, 1.0
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One selectable kernel implementation at a site.
+
+    ``available`` is HARD feasibility (VMEM fit, supported activations) —
+    consulted for every resolution path including forced. ``auto_gate`` is
+    soft policy (e.g. the flash min-seq threshold) consulted only by auto
+    scoring. ``cost`` returns (flops, hbm_bytes, overhead_seconds) for the
+    ctx; ``unfused_bytes`` marks estimates produced by the cost model's
+    un-fused counting, which the measured calibration factor discounts.
+    """
+
+    name: str
+    fused: bool
+    cost: Callable[[dict], Tuple[float, float, float]]
+    available: Callable[[dict], bool] = lambda ctx: True
+    auto_gate: Callable[[dict], bool] = lambda ctx: True
+    unfused_bytes: bool = False
+
+
+@dataclass
+class Site:
+    name: str
+    reference: str
+    preferred_fused: str
+    variants: Dict[str, Variant] = field(default_factory=dict)
+
+
+_SITES: Dict[str, Site] = {}
+_LOCK = threading.RLock()
+_CACHE: Dict[Tuple, dict] = {}
+_LOG: List[dict] = []
+_FORCE_AVAILABLE = False
+_MODE_OVERRIDE: Optional[str] = None
+_SITE_OVERRIDES: Dict[str, str] = {}
+_CAL_CACHE: Optional[Tuple[float, dict, float]] = None  # (mtime, data, factor)
+
+
+def register_site(site: Site) -> None:
+    with _LOCK:
+        _SITES[site.name] = site
+
+
+def _parse_env() -> Tuple[str, Dict[str, str]]:
+    """``DL4JTPU_KERNELS`` grammar: comma-separated tokens; a bare token is
+    the global mode, ``site=variant`` a per-site override."""
+    raw = os.environ.get(KERNELS_ENV, "")
+    env_mode = "auto"
+    overrides: Dict[str, str] = {}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            site, _, variant = tok.partition("=")
+            overrides[site.strip()] = variant.strip()
+        elif tok in _MODES:
+            env_mode = tok
+    return env_mode, overrides
+
+
+def mode() -> str:
+    """The effective global mode (programmatic override > env > auto)."""
+    if _MODE_OVERRIDE is not None:
+        return _MODE_OVERRIDE
+    return _parse_env()[0]
+
+
+def set_mode(m: Optional[str]) -> None:
+    """Programmatic mode override (None restores env/auto resolution)."""
+    global _MODE_OVERRIDE
+    if m is not None and m not in _MODES:
+        raise ValueError(f"mode must be one of {_MODES}, got {m!r}")
+    _MODE_OVERRIDE = m
+
+
+@contextmanager
+def forced_mode(m: str):
+    """Scoped :func:`set_mode` — the bench's auto-vs-reference A/B uses it."""
+    prev = _MODE_OVERRIDE
+    set_mode(m)
+    try:
+        yield
+    finally:
+        set_mode(prev)
+
+
+def set_site_override(site: str, variant: Optional[str]) -> None:
+    """Pin one site to one variant (None clears) — the per-site pragma
+    escape hatch; env-form overrides ride ``DL4JTPU_KERNELS=site=variant``."""
+    with _LOCK:
+        if variant is None:
+            _SITE_OVERRIDES.pop(site, None)
+        else:
+            _SITE_OVERRIDES[site] = variant
+
+
+def _site_override(site: str) -> Optional[str]:
+    ov = _SITE_OVERRIDES.get(site)
+    if ov is not None:
+        return ov
+    return _parse_env()[1].get(site)
+
+
+def set_force_available(flag: bool) -> None:
+    """Let fused variants compete in auto scoring off-TPU (interpret mode).
+    CI's kernel-selection self-scan and the parity tests run under this —
+    production auto mode only scores fused kernels on a real TPU backend."""
+    global _FORCE_AVAILABLE
+    _FORCE_AVAILABLE = bool(flag)
+
+
+def force_available() -> bool:
+    return _FORCE_AVAILABLE
+
+
+def _fused_competes() -> bool:
+    if _FORCE_AVAILABLE:
+        return True
+    try:
+        import jax  # noqa: PLC0415 - keep module import light
+
+        # "axon" is the tunnel-attached TPU backend this harness trains on —
+        # Pallas lowers there exactly as on a directly-attached chip
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_min_seq() -> int:
+    try:
+        return int(os.environ.get(FLASH_MIN_SEQ_ENV, DEFAULT_FLASH_MIN_SEQ))
+    except ValueError:
+        return DEFAULT_FLASH_MIN_SEQ
+
+
+# ------------------------------------------------------------- calibration
+def _calibration_path() -> str:
+    explicit = os.environ.get(CALIBRATION_PATH_ENV)
+    if explicit:
+        return explicit
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(pkg_root, "KERNEL_CALIBRATION.json")
+
+
+def _load_calibration() -> Tuple[dict, float]:
+    """(raw data, discount factor). Cached by file mtime; a missing or
+    malformed file means factor 1.0 (trust the un-fused counts as-is)."""
+    global _CAL_CACHE
+    path = _calibration_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}, 1.0
+    with _LOCK:
+        if _CAL_CACHE is not None and _CAL_CACHE[0] == mtime:
+            return _CAL_CACHE[1], _CAL_CACHE[2]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    ratios = [v for k, v in data.items()
+              if isinstance(v, (int, float)) and v > 0]
+    if ratios:
+        # geometric mean of predicted/measured across modes; >1 means the
+        # un-fused byte counts over-predicted, so discount by its inverse
+        import math
+
+        g = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
+        factor = min(_CAL_MAX, max(_CAL_MIN, 1.0 / g)) if g > 1.0 else 1.0
+    else:
+        factor = 1.0
+    with _LOCK:
+        _CAL_CACHE = (mtime, data, factor)
+    return data, factor
+
+
+def calibration_factor() -> float:
+    """Multiplier applied to un-fused byte estimates during auto scoring."""
+    return _load_calibration()[1]
+
+
+def update_calibration(key: str, predicted_vs_measured: float) -> bool:
+    """Persist one bench mode's predicted/measured step-time ratio — the
+    feedback half of the calibration loop (bench.py calls this from its
+    ``static_cost`` block). Returns True when written."""
+    try:
+        ratio = float(predicted_vs_measured)
+    except (TypeError, ValueError):
+        return False
+    if not (ratio > 0):
+        return False
+    path = _calibration_path()
+    data, _ = _load_calibration()
+    data = dict(data)
+    data[str(key)] = round(ratio, 6)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        return False
+    global _CAL_CACHE
+    with _LOCK:
+        _CAL_CACHE = None  # next read re-derives the factor
+    return True
+
+
+# --------------------------------------------------------------- selection
+def _predicted_seconds(v: Variant, ctx: dict, cal: float) -> float:
+    from ..analysis.cost_model import roofline_params  # noqa: PLC0415
+
+    flops, nbytes, overhead = v.cost(ctx)
+    if v.unfused_bytes:
+        nbytes *= cal
+    rl = roofline_params()
+    compute_s = flops / rl["peak_flops"] if rl["peak_flops"] else 0.0
+    memory_s = nbytes / (rl["hbm_gbps"] * 1e9) if rl["hbm_gbps"] else 0.0
+    return max(compute_s, memory_s) + overhead
+
+
+def _observe(record: dict) -> None:
+    """Counter + flight-recorder event for one NEW (site, key) selection.
+    Observability must never break the traced path that asked."""
+    try:
+        from ..telemetry import get_registry  # noqa: PLC0415
+
+        get_registry().counter(
+            "dl4jtpu_kernel_selected_total",
+            "kernel-variant selections by site (one per distinct shape key)",
+            labelnames=("site", "variant"),
+        ).labels(site=record["site"], variant=record["variant"]).inc()
+    except Exception:
+        pass
+    try:
+        from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+        get_flight_recorder().record(
+            "kernel_select", site=record["site"], variant=record["variant"],
+            reason=record["reason"], ctx=dict(record["ctx"]),
+            predicted_s=record.get("predicted_s"))
+    except Exception:
+        pass
+
+
+def select(site_name: str, ctx: dict, forced: Optional[str] = None) -> str:
+    """Resolve the variant for ``site_name`` at the concrete ``ctx`` shapes.
+
+    ``forced`` carries a call site's legacy knob (highest precedence); it is
+    still subject to the variant's hard feasibility check and falls back to
+    the reference variant when infeasible. Resolutions are cached per
+    (site, ctx, config) — deterministic, and logged/counted exactly once.
+    """
+    site = _SITES[site_name]
+    m = mode()
+    ov = _site_override(site_name)
+    cal = calibration_factor()
+    key = (site_name, tuple(sorted(ctx.items())), forced, m, ov,
+           _FORCE_AVAILABLE, round(cal, 4))
+    with _LOCK:
+        hit = _CACHE.get(key)
+        if hit is not None:
+            return hit["variant"]
+
+    def feasible(name: Optional[str]) -> Optional[str]:
+        v = site.variants.get(name or "")
+        return v.name if v is not None and v.available(ctx) else None
+
+    choice: Optional[str] = None
+    reason = "auto"
+    predicted: Optional[dict] = None
+    if forced is not None:
+        choice = feasible(forced)
+        reason = "forced"
+    if choice is None and ov is not None:
+        choice = feasible(ov)
+        if choice is not None:
+            reason = "override"
+    if choice is None and m == "reference":
+        choice, reason = site.reference, "mode"
+    if choice is None and m == "fused":
+        choice = feasible(site.preferred_fused) or next(
+            (feasible(n) for n, v in site.variants.items()
+             if v.fused and feasible(n)), None)
+        reason = "mode"
+    if choice is None:
+        fused_ok = _fused_competes()
+        candidates = [
+            v for v in site.variants.values()
+            if v.available(ctx) and v.auto_gate(ctx)
+            and (fused_ok or not v.fused)
+        ]
+        if not candidates:
+            choice, reason = site.reference, "fallback"
+        else:
+            predicted = {v.name: _predicted_seconds(v, ctx, cal)
+                         for v in candidates}
+            # minimum predicted time; fused breaks ties (it is the variant
+            # whose byte estimate we actually trust)
+            choice = min(
+                candidates,
+                key=lambda v: (predicted[v.name], 0 if v.fused else 1),
+            ).name
+            reason = "auto"
+    if choice not in site.variants:
+        choice = site.reference
+
+    record = {"site": site_name, "variant": choice, "reason": reason,
+              "ctx": dict(ctx), "mode": m}
+    if predicted is not None:
+        record["predicted_s"] = {k: float(f"{v:.3e}")
+                                 for k, v in predicted.items()}
+    with _LOCK:
+        # racing first-selection: keep the winner, log once
+        hit = _CACHE.get(key)
+        if hit is not None:
+            return hit["variant"]
+        _CACHE[key] = record
+        _LOG.append(record)
+    _observe(record)
+    return choice
+
+
+# ------------------------------------------------------------- introspection
+def selection_log() -> List[dict]:
+    with _LOCK:
+        return list(_LOG)
+
+
+def stats(last: int = 32) -> dict:
+    """Snapshot for ``cm.stats()['kernels']`` / ``/api/ircost`` / bench."""
+    with _LOCK:
+        log = list(_LOG)
+    by_site: Dict[str, Dict[str, int]] = {}
+    for rec in log:
+        row = by_site.setdefault(rec["site"], {})
+        row[rec["variant"]] = row.get(rec["variant"], 0) + 1
+    data, factor = _load_calibration()
+    return {
+        "mode": mode(),
+        "force_available": _FORCE_AVAILABLE,
+        "sites": sorted(_SITES),
+        "selections_total": len(log),
+        "by_site": by_site,
+        "recent": log[-last:],
+        "calibration": {"factor": round(factor, 4), "entries": len(data),
+                        "path": _calibration_path()},
+    }
+
+
+def reset() -> None:
+    """Test hook: clear cached selections, the log, and every override."""
+    global _FORCE_AVAILABLE, _MODE_OVERRIDE, _CAL_CACHE
+    with _LOCK:
+        _CACHE.clear()
+        _LOG.clear()
+        _SITE_OVERRIDES.clear()
+        _FORCE_AVAILABLE = False
+        _MODE_OVERRIDE = None
+        _CAL_CACHE = None
+
+
+# ---------------------------------------------------------------------------
+# Site registrations. Cost closed forms are deliberately simple RANKERS, not
+# simulators (same philosophy as the PR 5 cost model): FLOPs are identical
+# across variants of a site, byte counts model the HBM streams each variant
+# actually moves (un-fused counting for the XLA reference paths — flagged so
+# calibration discounts them), and overhead models fixed kernel-launch cost.
+# tests/test_kernel_select.py pins the rankings the ISSUE demands.
+# ---------------------------------------------------------------------------
+
+_LAUNCH_S = 5e-6  # one pallas_call dispatch
+
+
+def _lstm_flops(ctx) -> float:
+    T, B, H = ctx["T"], ctx["B"], ctx["H"]
+    # fwd recurrent matmul + bwd dzx@RW.T + dRW accumulation, plus gate math
+    return 24.0 * T * B * H * H + 60.0 * T * B * H
+
+
+def _lstm_seqfused_cost(ctx):
+    T, B, H, itemsize = ctx["T"], ctx["B"], ctx["H"], ctx["itemsize"]
+    # fwd: zx in + y out + 5 residual streams; bwd: dy + 5 residuals +
+    # shifted c/h re-reads + dzx out; RW resident once per pass
+    nbytes = itemsize * (2.0 * T * B * 4 * H + 14.0 * T * B * H
+                         + 3.0 * H * 4 * H)
+    return _lstm_flops(ctx), nbytes, 2 * _LAUNCH_S
+
+
+def _lstm_fusedcell_cost(ctx):
+    T, B, H, itemsize = ctx["T"], ctx["B"], ctx["H"], ctx["itemsize"]
+    # per-step pallas_call: 7 residual arrays spill to HBM fwd AND re-load
+    # bwd (the measured reason XLA's scan beats it — ops/__init__ docstring)
+    nbytes = itemsize * T * (4.0 * B * 4 * H + 28.0 * B * H
+                             + 4.0 * H * 4 * H)
+    return _lstm_flops(ctx), nbytes, 2 * ctx["T"] * _LAUNCH_S
+
+
+def _lstm_reference_cost(ctx):
+    T, B, H, itemsize = ctx["T"], ctx["B"], ctx["H"], ctx["itemsize"]
+    # un-fused counting of the scan body: every gate/cell intermediate is a
+    # materialized [B,H] (or [B,4H]) round trip, fwd + ~2x bwd
+    nbytes = itemsize * T * 66.0 * B * H
+    return _lstm_flops(ctx), nbytes, 0.0
+
+
+def _seq_fits_ctx(ctx) -> bool:
+    from .pallas_kernels import _seq_fits  # noqa: PLC0415
+
+    return bool(ctx["acts_ok"]) and _seq_fits(ctx["B"], ctx["H"],
+                                              ctx["itemsize"])
+
+
+def _cell_fits_ctx(ctx) -> bool:
+    from . import _cell_fits  # noqa: PLC0415
+
+    return bool(ctx["acts_ok"]) and _cell_fits(ctx["B"], ctx["H"],
+                                               ctx["itemsize"])
+
+
+register_site(Site(
+    name="lstm_seq",
+    reference="reference",
+    preferred_fused="seqfused",
+    variants={
+        "seqfused": Variant("seqfused", fused=True,
+                            cost=_lstm_seqfused_cost,
+                            available=_seq_fits_ctx),
+        "fusedcell": Variant("fusedcell", fused=True,
+                             cost=_lstm_fusedcell_cost,
+                             available=_cell_fits_ctx),
+        "reference": Variant("reference", fused=False,
+                             cost=_lstm_reference_cost, unfused_bytes=True),
+    },
+))
+
+
+def _attn_dims(ctx):
+    return ctx["B"] * ctx["heads"], ctx["T"], ctx["D"], ctx["itemsize"]
+
+
+def _attn_flash_cost(ctx):
+    bh, t, d, itemsize = _attn_dims(ctx)
+    # online-softmax recompute in the two backward passes costs extra FLOPs
+    # but HBM traffic stays O(T*D) streams
+    flops = 14.0 * bh * t * t * d
+    nbytes = itemsize * 12.0 * bh * t * d + 8.0 * bh * t
+    return flops, nbytes, 3 * _LAUNCH_S
+
+
+def _attn_xla_cost(ctx):
+    bh, t, d, itemsize = _attn_dims(ctx)
+    flops = 10.0 * bh * t * t * d
+    # the [T,T] score/prob/dprob/dscore tensors materialize in HBM
+    nbytes = itemsize * (8.0 * bh * t * t + 8.0 * bh * t * d)
+    return flops, nbytes, 0.0
+
+
+def _flash_auto_gate(ctx) -> bool:
+    from .flash_attention import _KV_VMEM_BUDGET_BYTES  # noqa: PLC0415
+
+    t, d, itemsize = ctx["T"], ctx["D"], ctx["itemsize"]
+    return (ctx["T"] >= flash_min_seq()
+            and 2 * t * d * itemsize <= _KV_VMEM_BUDGET_BYTES)
+
+
+register_site(Site(
+    name="attention",
+    reference="xla",
+    preferred_fused="flash",
+    variants={
+        # flash is always *feasible* (it falls back internally past the KV
+        # VMEM budget); the threshold is auto-mode policy only, so an
+        # explicit attention_impl="flash" keeps meaning flash
+        "flash": Variant("flash", fused=True, cost=_attn_flash_cost,
+                         auto_gate=_flash_auto_gate),
+        "xla": Variant("xla", fused=False, cost=_attn_xla_cost,
+                       unfused_bytes=True),
+    },
+))
+
+
+def _lrn_fused_cost(ctx):
+    rows, C, n, itemsize = ctx["rows"], ctx["C"], ctx["n"], ctx["itemsize"]
+    flops = (2.0 * n + 8.0) * rows * C
+    # fwd: x in, y+d out; bwd: x, d, g in, dx out
+    return flops, itemsize * 7.0 * rows * C, 2 * _LAUNCH_S
+
+
+def _lrn_reference_cost(ctx):
+    rows, C, n, itemsize = ctx["rows"], ctx["C"], ctx["n"], ctx["itemsize"]
+    flops = (2.0 * n + 8.0) * rows * C
+    # un-fused window sum: n shifted slices materialize fwd and again in the
+    # adjoint, plus the pow/mul chain
+    return flops, itemsize * (4.0 * n + 6.0) * rows * C, 0.0
+
+
+register_site(Site(
+    name="lrn",
+    reference="reference",
+    preferred_fused="fused",
+    variants={
+        "fused": Variant("fused", fused=True, cost=_lrn_fused_cost),
+        "reference": Variant("reference", fused=False,
+                             cost=_lrn_reference_cost, unfused_bytes=True),
+    },
+))
+
+
+def _sxent_fused_cost(ctx):
+    N, C, itemsize = ctx["N"], ctx["C"], ctx["itemsize"]
+    flops = 10.0 * N * C
+    # fwd: preout+labels in, per-row loss out; bwd: preout+labels+g in,
+    # dpre+dlabels out
+    return flops, itemsize * 7.0 * N * C, 2 * _LAUNCH_S
+
+
+def _sxent_reference_cost(ctx):
+    N, C, itemsize = ctx["N"], ctx["C"], ctx["itemsize"]
+    flops = 10.0 * N * C
+    # un-fused: max/exp/sum/log/mul materialize between HBM round trips,
+    # fwd + bwd softmax recompute
+    return flops, itemsize * 12.0 * N * C, 0.0
+
+
+register_site(Site(
+    name="softmax_xent",
+    reference="reference",
+    preferred_fused="fused",
+    variants={
+        "fused": Variant("fused", fused=True, cost=_sxent_fused_cost),
+        "reference": Variant("reference", fused=False,
+                             cost=_sxent_reference_cost, unfused_bytes=True),
+    },
+))
+
+
+def _opt_fused_cost(ctx):
+    n, itemsize = ctx["n_elems"], ctx["itemsize"]
+    # read g/m/v, write u/m/v in one pass per leaf
+    return 12.0 * n, itemsize * 7.0 * n, ctx.get("n_leaves", 1) * _LAUNCH_S
+
+
+def _opt_reference_cost(ctx):
+    n, itemsize = ctx["n_elems"], ctx["itemsize"]
+    # un-fused optax chain: moment updates, bias corrections, sqrt, scale —
+    # each a materialized tree-wide intermediate
+    return 12.0 * n, itemsize * 14.0 * n, 0.0
+
+
+register_site(Site(
+    name="optimizer",
+    reference="reference",
+    preferred_fused="fused",
+    variants={
+        "fused": Variant("fused", fused=True, cost=_opt_fused_cost,
+                         available=lambda ctx: ctx.get("updater") == "adam"),
+        "reference": Variant("reference", fused=False,
+                             cost=_opt_reference_cost, unfused_bytes=True),
+    },
+))

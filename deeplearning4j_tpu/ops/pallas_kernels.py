@@ -868,3 +868,189 @@ def _seq_masked_bwd(act_name, gate_name, residuals, grads):
 
 
 fused_lstm_sequence_masked.defvjp(_seq_masked_fwd, _seq_masked_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax + cross-entropy — the loss-head hot path
+# ---------------------------------------------------------------------------
+#
+# The reference fuses LossMCXENT with softmax numerically (losses.py keeps
+# that); this kernel fuses it PHYSICALLY: one VMEM pass computes the per-row
+# loss from logits+labels without materializing max/exp/sum/logp between HBM
+# round trips, and the backward rebuilds the softmax in-tile to emit
+# d(logits) and d(labels) in a single fused pass. Selected by the
+# "softmax_xent" kernel_select site where the roofline says the loss head is
+# bandwidth-bound (it always is — pure elementwise/reduce chains).
+
+_SXENT_TILE_ROWS = 1024
+
+
+def _sxent_specs(rows: int, C: int):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    tile = min(_SXENT_TILE_ROWS, rows)
+    grid = (pl.cdiv(rows, tile),)
+    mat = pl.BlockSpec((tile, C), lambda i: (i, 0))
+    col = pl.BlockSpec((tile, 1), lambda i: (i, 0))
+    return grid, mat, col
+
+
+def _sxent_compute_dt(dt):
+    # bf16/f16 logits get f32 softmax math (exp/log at data precision loses
+    # the loss's small differences); f32/f64 stay at their own precision
+    return jnp.promote_types(dt, jnp.float32)
+
+
+@jit_entry
+def _sxent_fwd_kernel(x_ref, l_ref, loss_ref):
+    cdt = _sxent_compute_dt(x_ref.dtype)
+    x = x_ref[:].astype(cdt)
+    lab = l_ref[:].astype(cdt)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
+    loss_ref[:] = (-jnp.sum(lab * (x - lse), axis=-1, keepdims=True)
+                   ).astype(loss_ref.dtype)
+
+
+@jit_entry
+def _sxent_bwd_kernel(x_ref, l_ref, g_ref, dx_ref, dl_ref):
+    cdt = _sxent_compute_dt(x_ref.dtype)
+    x = x_ref[:].astype(cdt)
+    lab = l_ref[:].astype(cdt)
+    g = g_ref[:].astype(cdt)  # [R, 1]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    ex = jnp.exp(x - m)
+    s = jnp.sum(ex, axis=-1, keepdims=True)
+    p = ex / s
+    logp = x - (jnp.log(s) + m)
+    # d/dx_j of -Σ_c lab_c·logp_c = p_j·Σ_c lab_c − lab_j  (general labels,
+    # reduces to p − lab for one-hot)
+    lab_sum = jnp.sum(lab, axis=-1, keepdims=True)
+    dx_ref[:] = ((p * lab_sum - lab) * g).astype(dx_ref.dtype)
+    dl_ref[:] = (-logp * g).astype(dl_ref.dtype)
+
+
+@jax.custom_vjp
+def fused_softmax_xent(preout, labels):
+    """Per-row -Σ labels·log_softmax(preout) for 2D [N, C] inputs, one fused
+    VMEM pass. Returns [N] row losses (mask/mean stay at the caller, exactly
+    like losses._apply_mask over the unfused form)."""
+    return _sxent_fwd_impl(preout, labels)
+
+
+def _sxent_fwd_impl(preout, labels):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    N, C = preout.shape
+    grid, mat, col = _sxent_specs(N, C)
+    out = pl.pallas_call(
+        _sxent_fwd_kernel,
+        grid=grid,
+        in_specs=[mat, mat],
+        out_specs=col,
+        out_shape=jax.ShapeDtypeStruct((N, 1), _sxent_compute_dt(preout.dtype)),
+        interpret=_interpret(),
+    )(preout, labels)
+    return out[:, 0]
+
+
+def _sxent_fwd(preout, labels):
+    return _sxent_fwd_impl(preout, labels), (preout, labels)
+
+
+def _sxent_bwd(residuals, g):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    preout, labels = residuals
+    N, C = preout.shape
+    grid, mat, col = _sxent_specs(N, C)
+    g2 = g.reshape(N, 1).astype(_sxent_compute_dt(preout.dtype))
+    dx, dl = pl.pallas_call(
+        _sxent_bwd_kernel,
+        grid=grid,
+        in_specs=[mat, mat, col],
+        out_specs=(mat, mat),
+        out_shape=(jax.ShapeDtypeStruct((N, C), preout.dtype),
+                   jax.ShapeDtypeStruct((N, C), labels.dtype)),
+        interpret=_interpret(),
+    )(preout, labels, g2)
+    return dx, dl
+
+
+fused_softmax_xent.defvjp(_sxent_fwd, _sxent_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused Adam update — the optimizer-step hot path
+# ---------------------------------------------------------------------------
+#
+# The optax chain materializes every intermediate of the moment/bias-correct/
+# scale pipeline as a tree-wide HBM round trip; per parameter leaf this
+# kernel reads (g, m, v) and writes (update, m, v) once — the bandwidth
+# floor of the math. Selected by the "optimizer" kernel_select site (the
+# update is elementwise, i.e. always below the roofline ridge). Not
+# differentiated: optimizer updates sit outside jax.grad by construction.
+
+_ADAM_LANES = 128
+_ADAM_TILE_ROWS = 4096
+
+
+@jit_entry
+def _adam_kernel(b1, b2, eps, g_ref, m_ref, v_ref, sc_ref,
+                 u_out, m_out, v_out):
+    g = g_ref[:]
+    dt = g.dtype
+    lr = sc_ref[0, 0].astype(dt)
+    bc1 = sc_ref[0, 1].astype(dt)  # 1 - b1**t
+    bc2 = sc_ref[0, 2].astype(dt)  # 1 - b2**t
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    u_out[:] = -lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    m_out[:] = m
+    v_out[:] = v
+
+
+def fused_adam_update(g, m, v, lr, bc1, bc2,
+                      b1: float, b2: float, eps: float):
+    """One fused Adam step for one parameter leaf: returns
+    ``(update, new_m, new_v)`` with ``update = -lr·m̂/(√v̂+eps)`` using
+    exactly optax's ``scale_by_adam`` bias corrections (``bc1``/``bc2`` are
+    the traced ``1 - βᵢ**t`` scalars, ``lr`` the schedule's value). Any leaf
+    shape: the view is flattened, lane-padded, and row-tiled; padded slots
+    compute a zero update and are sliced off."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    shape, dt = g.shape, g.dtype
+    n = g.size
+    cols = _ADAM_LANES if n >= _ADAM_LANES else max(n, 1)
+    pad = (-n) % cols
+    rows = (n + pad) // cols
+
+    def flat(a):
+        a = a.reshape(-1).astype(dt)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad,), dt)])
+        return a.reshape(rows, cols)
+
+    # traced scalars ride one (1, 3) array: lr, 1-b1^t, 1-b2^t (kept at
+    # >=f32 — f64 under the x64 test env so parity against optax holds)
+    sdt = jnp.promote_types(dt, jnp.float32)
+    scalars = jnp.stack([jnp.asarray(lr), jnp.asarray(bc1),
+                         jnp.asarray(bc2)]).astype(sdt).reshape(1, 3)
+    tile = min(_ADAM_TILE_ROWS, rows)
+    grid = (pl.cdiv(rows, tile),)
+    mat = pl.BlockSpec((tile, cols), lambda i: (i, 0))
+    sc = pl.BlockSpec((1, 3), lambda i: (0, 0))
+    u2, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_kernel, float(b1), float(b2), float(eps)),
+        grid=grid,
+        in_specs=[mat, mat, mat, sc],
+        out_specs=(mat, mat, mat),
+        out_shape=(jax.ShapeDtypeStruct((rows, cols), dt),) * 3,
+        interpret=_interpret(),
+    )(flat(g), flat(m), flat(v), scalars)
+
+    def unflat(a):
+        return a.reshape(-1)[:n].reshape(shape)
+
+    return unflat(u2), unflat(m2), unflat(v2)

@@ -336,6 +336,16 @@ class CompileManager:
         entry = self._get(key)
         if entry is not None:
             return entry
+        # kernel-selection hook: variants are resolved by ops.kernel_select
+        # DURING the trace below (cost-model-guided, cached per shape key);
+        # snapshot the log so selections first made for THIS admission land
+        # on its cost record and compile event
+        try:
+            from ..ops import kernel_select as _ks  # noqa: PLC0415
+
+            ks_mark = len(_ks.selection_log())
+        except Exception:
+            _ks, ks_mark = None, 0
         jitted = build()
         t0 = time.perf_counter()
         compiled = jitted.lower(*args).compile()
@@ -373,13 +383,26 @@ class CompileManager:
                                     flight=self._flight())
             except Exception:
                 cost = None
+        # selections newly resolved while tracing/admitting this program
+        kernels_here: list = []
+        if _ks is not None:
+            try:
+                kernels_here = [
+                    {"site": r["site"], "variant": r["variant"],
+                     "reason": r["reason"]}
+                    for r in _ks.selection_log()[ks_mark:]]
+                if kernels_here and cost is not None:
+                    cost["kernels"] = kernels_here
+            except Exception:
+                kernels_here = []
         try:
             self._flight().record(
                 "compile", entry=record["kind"], seconds=round(seconds, 6),
                 hbm_total_bytes=record.get("total_bytes"),
                 static_flops=(cost or {}).get("flops"),
                 predicted_step_seconds=(cost or {}).get(
-                    "roofline", {}).get("predicted_step_seconds"))
+                    "roofline", {}).get("predicted_step_seconds"),
+                kernel_selections=len(kernels_here))
         except Exception:
             pass
         return self._put(key, compiled, memory=record, cost=cost)
@@ -402,6 +425,14 @@ class CompileManager:
         """Host-side snapshot for bench artifacts / debugging."""
         with self._lock:
             size = len(self._entries)
+        # kernel-selection view next to the cost/memory records it explains;
+        # selection lives in ops.kernel_select, the manager just exposes it
+        try:
+            from ..ops import kernel_select as _ks  # noqa: PLC0415
+
+            kernels = _ks.stats()
+        except Exception:
+            kernels = {"error": "kernel_select unavailable"}
         return {
             "entries": size,
             "max_entries": self.max_entries,
@@ -411,6 +442,7 @@ class CompileManager:
             "compile_seconds": self.compile_time.summary(),
             "memory": self._memory_summary(),
             "static_cost": self._cost_summary(),
+            "kernels": kernels,
         }
 
 

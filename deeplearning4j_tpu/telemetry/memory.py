@@ -171,10 +171,12 @@ def _bytes_of(tree) -> int:
     return int(total)
 
 
-def _input_structs(net, batch_or_struct):
+def _input_structs(net, batch_or_struct, timesteps_probe=None):
     """Input ShapeDtypeStructs for a net: an int batch size builds them from
     the declared input types; arrays/structs (or a list for multi-input
-    graphs) are shelled to shape/dtype only."""
+    graphs) are shelled to shape/dtype only. ``timesteps_probe`` overrides
+    the length substituted for variable-length recurrent inputs (so IR/cost
+    probes can model the real training sequence length, not the default)."""
     import jax
     import numpy as np
 
@@ -198,10 +200,12 @@ def _input_structs(net, batch_or_struct):
                     "memory_report needs conf.input_type (or pass example "
                     "arrays/ShapeDtypeStructs instead of a batch size)")
             its = [conf.input_type]
+        t_probe = (DEFAULT_TIMESTEPS_PROBE if timesteps_probe is None
+                   else int(timesteps_probe))
         structs = []
         for it in its:
             if getattr(it, "kind", None) == "rnn" and it.timesteps is None:
-                shape = (DEFAULT_TIMESTEPS_PROBE, it.size)
+                shape = (t_probe, it.size)
             else:
                 shape = it.example_shape()
             structs.append(jax.ShapeDtypeStruct((b,) + tuple(shape),

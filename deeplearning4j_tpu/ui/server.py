@@ -437,6 +437,7 @@ class _Handler(BaseHTTPRequestHandler):
             # the compile cache, the DT2xx finding counters, and the
             # roofline the predictions were made against
             from ..analysis.cost_model import roofline_params  # noqa: PLC0415
+            from ..ops import kernel_select  # noqa: PLC0415
             from ..runtime.compile_manager import get_compile_manager  # noqa: PLC0415
 
             cm = get_compile_manager()
@@ -450,6 +451,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "cost_records": cm.cost_records(),
                 "summary": cm.stats()["static_cost"],
                 "findings_total": counts,
+                "kernels": kernel_select.stats(),
             }, default=str).encode())
         if path == "/api/flightrecorder":
             from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415

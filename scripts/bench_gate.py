@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Bench regression gate: fail when a mode's metric drops out of its band.
+
+The bench trajectory has wobbled silently before (mlp r03 7888 -> r04 5508
+samples/sec — a 30% drop nobody was forced to look at). This gate makes the
+regression loud: per-metric baselines persist in ``BENCH_BASELINE.json`` and
+a gated run FAILS (exit 1) when a metric lands below ``tolerance * baseline``.
+
+Usage:
+    python scripts/bench_gate.py [options] RESULT...
+
+    RESULT        path to a bench.py output line (JSON), or '-' for stdin;
+                  files may hold several JSON lines — each metric is gated
+    --baseline P  baseline store (default: BENCH_BASELINE.json next to the
+                  repo root, env BENCH_BASELINE_PATH)
+    --tolerance F fail when value < F * baseline (default 0.75, env
+                  BENCH_GATE_TOLERANCE — generous because CPU-fallback
+                  numbers jitter; the r03->r04 drop was 0.70)
+    --refresh     explicitly move the stored baselines to this run's values
+                  (the ONLY way an existing baseline changes)
+
+Semantics, chosen to be safe in CI:
+- a metric with no stored baseline is RECORDED (first run anchors) and passes;
+- a metric at/above its band passes and the baseline is left untouched —
+  improvements do NOT auto-ratchet (refresh deliberately);
+- ``bench_error`` / ``bench_skip`` lines fail the gate (a bench that cannot
+  measure must not look green);
+- a malformed baseline file is treated as empty rather than crashing the CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.environ.get(
+    "BENCH_BASELINE_PATH", os.path.join(REPO_DIR, "BENCH_BASELINE.json"))
+DEFAULT_TOLERANCE = float(os.environ.get("BENCH_GATE_TOLERANCE", "0.75"))
+
+
+def load_baselines(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save_baselines(path: str, data: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def iter_results(paths):
+    for p in paths:
+        text = sys.stdin.read() if p == "-" else open(p).read()
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and parsed.get("metric"):
+                yield parsed
+
+
+def gate(results, baselines: dict, tolerance: float, refresh: bool):
+    """Returns (ok, messages, new_baselines)."""
+    ok = True
+    messages = []
+    new = dict(baselines)
+    seen_any = False
+    for r in results:
+        metric, value = r["metric"], r.get("value")
+        if metric in ("bench_error", "bench_skip") or not isinstance(
+                value, (int, float)) or value <= 0:
+            ok = False
+            messages.append(f"FAIL {metric}: no measurable value "
+                            f"({r.get('error', r.get('unit', '?'))})")
+            continue
+        seen_any = True
+        base = baselines.get(metric)
+        if not isinstance(base, (int, float)) or base <= 0:
+            new[metric] = value
+            messages.append(f"ANCHOR {metric}: {value} recorded as baseline")
+            continue
+        floor = tolerance * base
+        if value < floor:
+            ok = False
+            messages.append(
+                f"FAIL {metric}: {value} < {floor:.1f} "
+                f"({tolerance:.0%} of baseline {base}) — "
+                f"regression; fix it or re-anchor with --refresh")
+        else:
+            messages.append(
+                f"OK {metric}: {value} vs baseline {base} "
+                f"({value / base:.2f}x, floor {floor:.1f})")
+        if refresh:
+            new[metric] = value
+            messages.append(f"REFRESH {metric}: baseline -> {value}")
+    if not seen_any and ok:
+        ok = False
+        messages.append("FAIL: no parseable bench metric found")
+    return ok, messages, new
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("results", nargs="+", metavar="RESULT")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE)
+    ap.add_argument("--refresh", action="store_true")
+    args = ap.parse_args(argv)
+
+    baselines = load_baselines(args.baseline)
+    ok, messages, new = gate(iter_results(args.results), baselines,
+                             args.tolerance, args.refresh)
+    for m in messages:
+        print(m)
+    if new != baselines:
+        try:
+            save_baselines(args.baseline, new)
+        except OSError as e:
+            print(f"WARN: could not write {args.baseline}: {e}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,74 @@ print(f"roofline smoke OK: {cost['flops']:,} FLOPs/step "
       f"predicted {rl['predicted_step_seconds']:.3g}s/step ({rl['bound']})")
 PY
 
+echo "== kernel-selection self-scan: auto must pick fused where memory-bound"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# Build charrnn + attention configs, trace their REAL train steps with the
+# fused variants allowed to compete (force_available scores them off-TPU in
+# interpret mode, exactly as a TPU backend would), and assert the roofline
+# picks the fused kernels at the memory-bound shapes, the selection
+# telemetry is populated, and fused-vs-reference parity holds (smoke).
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, UpdaterConfig)
+from deeplearning4j_tpu.models.char_rnn import char_rnn
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.ops import kernel_select as ks
+from deeplearning4j_tpu.telemetry import get_registry
+
+ks.reset()
+ks.set_force_available(True)
+
+# charrnn config: the ISSUE 6 acceptance workload (LSTM + softmax loss
+# head) at its bench shape — B=64, T=256 (timesteps_probe), H=512
+net = MultiLayerNetwork(char_rnn(vocab_size=96, hidden_size=512,
+                                 num_layers=2)).init()
+rep = net.analyze_ir(64, timesteps_probe=256)
+assert rep["static_cost"]["roofline"]["bound"] == "memory", "charrnn step \
+should be memory-bound on the roofline"
+picked = {r["site"]: r["variant"] for r in ks.selection_log()}
+assert picked.get("lstm_seq") == "seqfused", picked
+assert picked.get("softmax_xent") == "fused", picked
+assert picked.get("optimizer") == "fused", picked
+
+# attention config: flash above the seq threshold, xla below
+attn = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[SelfAttentionLayer(n_out=64, n_heads=8, causal=True),
+            RnnOutputLayer(n_out=8, activation="softmax", loss="mcxent")],
+    input_type=InputType.recurrent(64, 1024),
+    updater=UpdaterConfig(updater="adam", learning_rate=1e-3))).init()
+attn.analyze_ir(2)
+picked = {r["site"]: r["variant"] for r in ks.selection_log()}
+assert picked.get("attention") == "flash", picked
+assert ks.select("attention", {"B": 2, "heads": 8, "T": 64, "D": 8,
+                               "itemsize": 4, "causal": True}) == "xla"
+
+# selection telemetry counters populated (dl4jtpu_kernel_selected_total)
+fam = get_registry().get("dl4jtpu_kernel_selected_total")
+assert fam is not None
+counts = {key: child.value for key, child in fam._items()}
+assert sum(counts.values()) >= 4, counts
+
+# parity smoke: fused softmax+xent fwd/grad vs the XLA form
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+lab = jnp.asarray(np.eye(96, dtype=np.float32)[rng.integers(0, 96, 64)])
+from deeplearning4j_tpu.ops.pallas_kernels import fused_softmax_xent
+ref = -(lab * jax.nn.log_softmax(x, axis=-1)).sum(-1)
+np.testing.assert_allclose(fused_softmax_xent(x, lab), ref, atol=1e-5)
+gf = jax.grad(lambda a: fused_softmax_xent(a, lab).sum())(x)
+gr = jax.grad(lambda a: (-(lab * jax.nn.log_softmax(a, -1)).sum(-1)).sum())(x)
+np.testing.assert_allclose(gf, gr, atol=1e-5)
+ks.reset()
+print(f"kernel-selection self-scan OK: {len(counts)} (site,variant) "
+      "counters, charrnn -> seqfused+fused-xent+fused-adam, "
+      "attention -> flash@1024/xla@64, parity smoke clean")
+PY
+
 echo "== compile-count smoke: varying steps/tails must not recompile"
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_compile_manager.py::TestRecompileElimination
@@ -150,6 +218,15 @@ PY
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
+
+echo "== bench regression gate (CPU fallback mode vs BENCH_BASELINE.json)"
+# One real CPU bench run, gated against the persisted per-mode baselines —
+# a silent mlp-style throughput drop (r03 7888 -> r04 5508) now fails the
+# check. Re-anchor intentionally with: scripts/bench_gate.py --refresh.
+rm -f /tmp/_bench_gate_line.json
+BENCH_FORCE_CPU=1 BENCH_DEADLINE_S=240 python bench.py | tail -1 \
+    > /tmp/_bench_gate_line.json
+python scripts/bench_gate.py /tmp/_bench_gate_line.json
 
 echo "== tier-1 tests"
 set -o pipefail

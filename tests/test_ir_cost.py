@@ -348,6 +348,43 @@ class TestDt204DynamicIndices:
             jax.ShapeDtypeStruct((100, 8), jnp.float32))
         assert "DT204" not in _rules_hit(check_jaxpr_ir(closed))
 
+    # -- PR 6 regression fixtures: constness must survive the nested-jaxpr
+    # boundary (the PR 5 known limit — a baked np index array threaded into
+    # a scanned/sub-jaxpr used to read as a traced gather index)
+
+    def test_baked_indices_into_scan_clean(self):
+        idx = np.array([0, 2, 1, 3])
+
+        def f(x):
+            def body(carry, row):
+                return carry + row[idx].sum(), None
+
+            return jax.lax.scan(body, 0.0, x)[0]
+
+        closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((5, 4), jnp.float32))
+        assert "DT204" not in _rules_hit(check_jaxpr_ir(closed))
+
+    def test_baked_indices_as_subjaxpr_argument_clean(self):
+        idx = jnp.asarray(np.array([1, 0, 3]))
+
+        def f(x):
+            return jax.jit(lambda a, j: a[j].sum())(x, idx)
+
+        closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((7,), jnp.float32))
+        assert "DT204" not in _rules_hit(check_jaxpr_ir(closed))
+
+    def test_traced_indices_inside_scan_still_fire(self):
+        def f(x, js):
+            def body(c, j):
+                return c + x[j].sum(), None
+
+            return jax.lax.scan(body, 0.0, js)[0]
+
+        closed = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((7,), jnp.float32),
+            jax.ShapeDtypeStruct((4, 2), jnp.int32))
+        assert "DT204" in _rules_hit(check_jaxpr_ir(closed))
+
 
 class TestDt205PaddingWaste:
     def test_stager_accumulates_padding_stats(self):
