@@ -47,6 +47,7 @@ __all__ = [
     "BucketedStager",
     "bucket_length",
     "pad_batch_arrays",
+    "pad_inference_batch",
     "next_pow2",
 ]
 
@@ -139,6 +140,35 @@ def pad_batch_arrays(features: np.ndarray, labels: np.ndarray,
     out_l, lm = _pad_one(labels, labels_mask, target_b, target_t,
                          want_mask=with_masks)
     return out_f, out_l, fm, lm
+
+
+def pad_inference_batch(features: np.ndarray,
+                        features_mask: Optional[np.ndarray],
+                        target_b: int, target_t: Optional[int] = None):
+    """Pad a features-only batch for the inference fast path.
+
+    The training stager pads (features, labels) pairs and leans on
+    mask-normalized losses for exactness; inference has no labels, so
+    exactness comes from two facts instead: rows are independent through
+    every layer except BatchNormalization (callers with BN keep the exact
+    row count), and masked trailing timesteps hold recurrent state,
+    contribute nothing to attention scores, and drop out of mask-aware
+    pooling. The caller slices the padded rows/steps off the output.
+
+    Returns ``(features, features_mask)``. Sequence (3-D) features ALWAYS
+    carry a mask out — synthesized all-ones over the real region when none
+    came in — so a pow2-exact length and a padded length share ONE program
+    variant per bucket (mask presence is part of the traced signature).
+    Pure row padding of mask-less 2-D input stays mask-less: row
+    independence makes a mask redundant and a second variant wasteful.
+    """
+    features = np.asarray(features)
+    b = features.shape[0]
+    t = features.shape[1] if features.ndim == 3 else None
+    tt = target_t if t is not None else None
+    want_mask = features_mask is not None or tt is not None
+    out, mask = _pad_one(features, features_mask, target_b, tt, want_mask)
+    return out, mask
 
 
 @dataclass

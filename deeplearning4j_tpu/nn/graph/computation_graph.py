@@ -897,10 +897,22 @@ class ComputationGraph:
         (batch, T) — bucket T for variable-length streaming (pad via
         ``datasets.iterators.pad_to_bucket`` and pass ``features_masks``;
         masked steps hold recurrent h/c).
+
+        Fast path (default): routed through ``runtime/inference.py`` — time
+        axes pow2-bucket with auto-synthesized masks, the program is
+        AOT-admitted via the compile manager, RNN state + inputs donated on
+        accelerators. ``DL4JTPU_INFER=legacy`` restores the per-net
+        ``jax.jit`` dispatch below.
         """
-        self.init()
+        from ...runtime import inference as _inf
+
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
             inputs = tuple(inputs[0])
+        if _inf.fast_path_enabled():
+            outs = _inf.graph_rnn_step(self, list(inputs),
+                                       features_masks=features_masks)
+            return outs[0] if len(outs) == 1 else outs
+        self.init()
         xs = [jnp.asarray(x) for x in inputs]
         single_step = all(x.ndim == 2 for x in xs)
         if single_step:
@@ -954,10 +966,22 @@ class ComputationGraph:
     # -------------------------------------------------------------- inference
     def output(self, *inputs, train: bool = False, masks=None):
         """Output activations (reference: ComputationGraph.output). Returns a
-        single array for single-output graphs, else a list."""
+        single array for single-output graphs, else a list.
+
+        Served by the AOT-bucketed inference fast path
+        (``runtime/inference.py``): boundary dtype canonicalization, pow2
+        row/time bucketing with exact masked padding, compile-manager AOT
+        admission, host-array return with the padding sliced off.
+        ``DL4JTPU_INFER=legacy`` restores the per-net ``jax.jit``
+        dispatch."""
+        from ...runtime import inference as _inf
+
         self.init()
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
             inputs = tuple(inputs[0])
+        if _inf.fast_path_enabled():
+            outs = _inf.graph_output(self, list(inputs), masks=masks)
+            return outs[0] if len(outs) == 1 else outs
         if self._eval_forward is None:
             self._eval_forward = jax.jit(
                 lambda params, state, xs, masks: self._forward(
@@ -967,6 +991,26 @@ class ComputationGraph:
         outs = self._eval_forward(
             self.params, self.state, [jnp.asarray(x) for x in inputs], masks
         )
+        return outs[0] if len(outs) == 1 else outs
+
+    def predict(self, *inputs, masks=None):
+        """Class indices per output (reference: MultiLayerNetwork.predict's
+        graph twin). The argmax is fused into the compiled inference
+        executable — only int32 indices cross the device boundary. Returns
+        one array for single-output graphs, else a list."""
+        from ...runtime import inference as _inf
+
+        self.init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        if _inf.fast_path_enabled():
+            outs = _inf.graph_output(self, list(inputs), masks=masks,
+                                     argmax=True)
+        else:
+            outs = self.output(*inputs, masks=masks)
+            if not isinstance(outs, list):
+                outs = [outs]
+            outs = [np.asarray(jnp.argmax(o, axis=-1)) for o in outs]
         return outs[0] if len(outs) == 1 else outs
 
     def _input_masks(self, mds):

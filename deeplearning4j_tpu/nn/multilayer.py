@@ -910,7 +910,17 @@ class MultiLayerNetwork:
         h/c, so the streaming state after the call is exactly the state
         after the sequence's REAL steps, and only len(buckets) programs ever
         compile.
+
+        Fast path (default): routed through ``runtime/inference.py`` — the
+        time axis pow2-buckets with an auto-synthesized mask, the program is
+        AOT-admitted via the compile manager, and the RNN state + input
+        buffers are donated on accelerators. ``DL4JTPU_INFER=legacy``
+        restores the per-net ``jax.jit`` dispatch below.
         """
+        from ..runtime import inference as _inf
+
+        if _inf.fast_path_enabled():
+            return _inf.mln_rnn_step(self, x, features_mask=features_mask)
         self.init()
         x = jnp.asarray(x)
         single_step = x.ndim == 2
@@ -1013,8 +1023,20 @@ class MultiLayerNetwork:
 
     # -------------------------------------------------------------- inference
     def output(self, x, train: bool = False, features_mask=None):
-        """Inference output (reference: MultiLayerNetwork.output:1505)."""
+        """Inference output (reference: MultiLayerNetwork.output:1505).
+
+        Served by the AOT-bucketed inference fast path
+        (``runtime/inference.py``): input dtype canonicalized at the
+        boundary, rows/time padded to pow2 buckets with exact masked
+        padding, executable admitted through the process-wide compile
+        manager, result returned as a host array with the padding sliced
+        off. ``DL4JTPU_INFER=legacy`` restores the per-net ``jax.jit``
+        dispatch (device-array return)."""
+        from ..runtime import inference as _inf
+
         self.init()
+        if _inf.fast_path_enabled():
+            return _inf.mln_output(self, x, features_mask=features_mask)
         if self._eval_forward is None:
             self._eval_forward = jax.jit(
                 lambda params, state, x, fm: self._forward(
@@ -1024,14 +1046,24 @@ class MultiLayerNetwork:
         return self._eval_forward(self.params, self.state, jnp.asarray(x), features_mask)
 
     def predict(self, x) -> np.ndarray:
-        """Class indices (reference: MultiLayerNetwork.predict)."""
+        """Class indices (reference: MultiLayerNetwork.predict). The argmax
+        is fused into the compiled inference executable — only int32 class
+        indices cross the device boundary, never the full logits."""
+        from ..runtime import inference as _inf
+
+        if _inf.fast_path_enabled():
+            return np.asarray(_inf.mln_output(self, x, argmax=True))
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
         """All layer activations (reference: feedForward:652)."""
+        from ..runtime.inference import canonicalize_input
+
         self.init()
         acts = []
-        cur = jnp.asarray(x)
+        # boundary canonicalization: f64/host-dtype inputs would otherwise
+        # re-trace per dtype and promote every downstream op (DT200)
+        cur = jnp.asarray(canonicalize_input(x, self.conf.dtype, self.params))
         params, cur = _compute_cast(self.conf.dtype, self.params, cur)
         for i, layer in enumerate(self.conf.layers):
             pre = self.conf.preprocessors.get(i)

@@ -18,11 +18,14 @@ from .compile_manager import (
     enable_persistent_cache,
     get_compile_manager,
 )
+from .inference import canonicalize_input, fast_path_enabled
 
 __all__ = [
     "CompileManager",
     "NativeDataSetIterator",
+    "canonicalize_input",
     "enable_persistent_cache",
+    "fast_path_enabled",
     "get_compile_manager",
     "native_available",
     "native_csv_read",

@@ -1,0 +1,370 @@
+"""AOT-bucketed inference fast path: own the serving-side dispatch.
+
+``net.output()/predict()/rnn_time_step()`` used to dispatch a bare
+per-instance ``jax.jit`` — none of the machinery the training path earned
+(compile-manager AOT reuse + LRU tenancy, shape bucketing, input donation,
+kernel selection, IR admission, telemetry) applied to exactly the path
+production traffic hits. This module routes inference for BOTH net classes
+through the same :mod:`runtime.compile_manager` the fit paths use:
+
+- **Canonical dtypes at the boundary.** Floating inputs cast host-side to
+  the conf compute dtype before they ever reach a traced program, so an
+  f64/host-dtype request cannot mint a second executable (or trip DT200
+  promotion) for the same logical shape.
+- **Pow2 bucketing with exact masked padding.** Request rows pad to the
+  next power-of-two bucket (skipped for BatchNormalization models — batch
+  statistics couple rows); sequence time axes pad to pow2 buckets with a
+  synthesized/extended features mask (masked steps hold recurrent state,
+  drop out of attention and mask-aware pooling). Mixed request shapes
+  therefore share a logarithmic set of AOT executables, and the padded
+  rows/steps are sliced off host-side — a device-side slice would compile
+  a tiny program per distinct request size.
+- **AOT through the shared LRU.** Executables are admitted via
+  ``CompileManager.aot`` — compiles are counted/timed, XLA memory and
+  static-cost records attach, kernel selection and the DT2xx IR scan run,
+  and inference entries share eviction pressure with training entries, so
+  multi-model serving tenancy falls out of the one bounded cache.
+- **Donation.** The request tensors (and the streaming RNN state, which
+  aliases its replacement exactly) are donated on accelerator backends;
+  params/state are never donated — they are shared across requests.
+- **Fused argmax.** ``predict()`` compiles ``argmax`` into the executable
+  and transfers only class indices instead of materializing full logits
+  on the host.
+
+Results return as host ``np.ndarray`` — the fetch is the sync point the
+serving layer needs anyway, and host-side slicing keeps the zero-warm-
+compile guarantee under mixed request shapes.
+
+``DL4JTPU_INFER=legacy`` restores the old per-net ``jax.jit`` dispatch
+(shape-exact, no bucketing) as a debugging escape hatch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "fast_path_enabled",
+    "canonicalize_input",
+    "mln_output",
+    "mln_rnn_step",
+    "graph_output",
+    "graph_rnn_step",
+]
+
+# env knob: "legacy" (or "0") restores the pre-PR7 per-net jax.jit dispatch
+INFER_ENV = "DL4JTPU_INFER"
+
+
+def fast_path_enabled() -> bool:
+    return os.environ.get(INFER_ENV, "").lower() not in ("legacy", "0", "off")
+
+
+def _compute_dtype(conf_dtype: str, params):
+    """The net's floating compute dtype: bf16 for bf16 models, else the
+    params' floating dtype (f32 in production; f64 under an x64-enabled
+    process, where casting down would LOSE precision vs the in-trace
+    cast)."""
+    import jax  # noqa: PLC0415
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    if conf_dtype == "bfloat16":
+        return jnp.bfloat16
+    for leaf in jax.tree_util.tree_leaves(params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.dtype
+    return np.float32
+
+
+def _canon_rnn_state(net):
+    """Align the streaming state's floating dtype with the compute dtype
+    (host-side). ``init_recurrent_state`` follows the jax default float —
+    under x64 that is f64 while the program emits compute-dtype state, so
+    an un-canonicalized FIRST call would trace a second program."""
+    import jax  # noqa: PLC0415
+
+    if net._rnn_state is None:
+        return
+    target = _compute_dtype(net.conf.dtype, net.params)
+
+    def cast(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating) and arr.dtype != target:
+            return arr.astype(target)
+        return a
+
+    net._rnn_state = jax.tree_util.tree_map(cast, net._rnn_state)
+
+
+def canonicalize_input(x, conf_dtype: str, params=None) -> np.ndarray:
+    """Host-side dtype canonicalization (satellite of ISSUE 7): floating
+    inputs become the net's compute dtype BEFORE tracing, so f64/host-dtype
+    requests reuse the f32/bf16 executable instead of compiling (and
+    silently promoting) a second program. Mirrors the in-trace
+    ``_cast_input`` contract: bf16 models take bf16 inputs, float models
+    take their params' floating dtype (f32 in production; f64 under an
+    x64-enabled process, where casting down would LOSE precision vs the
+    in-trace cast)."""
+    import jax  # noqa: PLC0415 - keep module import light
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    if isinstance(x, jax.core.Tracer):
+        # under tracing (memory_report's eval_shape over feed_forward, IR
+        # scans): cast symbolically, never materialize
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            target = _compute_dtype(conf_dtype, params)
+            if x.dtype != target:
+                x = x.astype(target)
+        return x
+    x = np.asarray(x)
+    if np.issubdtype(x.dtype, np.floating) or x.dtype == jnp.bfloat16:
+        target = _compute_dtype(conf_dtype, params)
+        if x.dtype != target:
+            x = x.astype(target)
+    return x
+
+
+def _bucket_plan(b: int, t: Optional[int], pad_rows: bool) -> Tuple[int, Optional[int]]:
+    """(target_b, target_t) pow2 buckets for one request shape."""
+    from .compile_manager import next_pow2
+
+    target_b = next_pow2(b) if pad_rows else b
+    target_t = next_pow2(t) if t is not None else None
+    return target_b, target_t
+
+
+def _slice_output(out, b: int, t: Optional[int], target_t: Optional[int],
+                  argmax: bool = False) -> np.ndarray:
+    """Fetch one output to host and cut the padding off: rows always, time
+    only when the program's time axis is the padded bucket (time-preserving
+    nets); pooled outputs ([B, C]) have no time axis to cut. Fused argmax
+    drops the class dim, so its time-preserving shape is [B, T] not
+    [B, T, C]."""
+    out = np.asarray(out)
+    res = out[:b]
+    time_ndim = 2 if argmax else 3
+    if (
+        t is not None and target_t is not None and t != target_t
+        and res.ndim == time_ndim and res.shape[1] == target_t
+    ):
+        res = res[:, :t]
+    return res
+
+
+def _donate(*argnums: int) -> Tuple[int, ...]:
+    """Donate request buffers on accelerator backends only (CPU ignores
+    donation with a warning per program)."""
+    import jax  # noqa: PLC0415
+
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
+# ------------------------------------------------------------ MultiLayer
+def mln_output(net, x, features_mask=None, argmax: bool = False) -> np.ndarray:
+    """Bucketed AOT forward for :class:`MultiLayerNetwork`. With ``argmax``
+    the executable returns int32 class indices (fused — logits never reach
+    the host)."""
+    import jax  # noqa: PLC0415
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    from ..datasets.bucketing import pad_inference_batch
+    from .compile_manager import get_compile_manager, signature
+
+    net.init()
+    x = canonicalize_input(x, net.conf.dtype, net.params)
+    b = int(x.shape[0])
+    t = int(x.shape[1]) if x.ndim == 3 else None
+    target_b, target_t = _bucket_plan(b, t, net._pad_examples_ok())
+    fm = None if features_mask is None else np.asarray(features_mask)
+    x_p, fm_p = pad_inference_batch(x, fm, target_b, target_t)
+
+    cm = get_compile_manager()
+    args = (net.params, net.state, x_p, fm_p)
+    key = (net._cm_token, "mln_infer",
+           signature(bool(argmax), args))
+
+    def build():
+        def fn(params, state, xs, mask):
+            out = net._forward(params, xs, state, False, None,
+                               features_mask=mask)[0]
+            if argmax:
+                out = jnp.argmax(out, axis=-1).astype(jnp.int32)
+            return out
+
+        return jax.jit(fn, donate_argnums=_donate(2, 3))
+
+    compiled = cm.aot(key, build, args)
+    return _slice_output(compiled(*args), b, t, target_t, argmax=argmax)
+
+
+def mln_rnn_step(net, x, features_mask=None):
+    """Stateful streaming step for :class:`MultiLayerNetwork` through the
+    compile manager: time axis pow2-bucketed with a mask (masked steps hold
+    LSTM h/c, so post-call streaming state is exactly the state after the
+    real steps), RNN state + input donated on accelerators."""
+    import jax  # noqa: PLC0415
+
+    from ..datasets.bucketing import pad_inference_batch
+    from .compile_manager import get_compile_manager, signature
+
+    net.init()
+    x = canonicalize_input(x, net.conf.dtype, net.params)
+    single_step = x.ndim == 2
+    if single_step:
+        x = x[:, None, :]
+    b, t = int(x.shape[0]), int(x.shape[1])
+    target_t = _bucket_plan(b, t, False)[1]
+    fm = None if features_mask is None else np.asarray(features_mask)
+    x_p, fm_p = pad_inference_batch(x, fm, b, target_t)
+
+    leaves = (jax.tree_util.tree_leaves(net._rnn_state)
+              if net._rnn_state is not None else [])
+    if net._rnn_state is None or (leaves and int(leaves[0].shape[0]) != b):
+        net._rnn_state = net._init_rnn_states(b)
+    _canon_rnn_state(net)
+
+    cm = get_compile_manager()
+    args = (net.params, net.state, net._rnn_state, x_p, fm_p)
+    key = (net._cm_token, "mln_rnn_step", signature(args))
+
+    def build():
+        def fn(params, state, rnn, xs, mask):
+            # (out, new_rnn) — per-token dispatch stays on device
+            return net._forward(params, xs, state, False, None,
+                                features_mask=mask, rnn_state=rnn)[::2]
+
+        return jax.jit(fn, donate_argnums=_donate(2, 3))
+
+    compiled = cm.aot(key, build, args)
+    out, net._rnn_state = compiled(*args)
+    res = _slice_output(out, b, t, target_t)
+    if single_step and res.ndim == 3:
+        res = res[:, 0, :]
+    return res
+
+
+# ------------------------------------------------------- ComputationGraph
+def _canon_graph_inputs(net, inputs) -> List[np.ndarray]:
+    return [canonicalize_input(x, net.conf.dtype, net.params)
+            for x in inputs]
+
+
+def _graph_masks_list(net, masks) -> List[Optional[np.ndarray]]:
+    """Normalize the graph mask argument (None | dict | list) to a list
+    aligned with ``conf.network_inputs``."""
+    names = net.conf.network_inputs
+    if masks is None:
+        return [None] * len(names)
+    if isinstance(masks, dict):
+        return [None if masks.get(n) is None else np.asarray(masks[n])
+                for n in names]
+    if not isinstance(masks, (list, tuple)):
+        masks = [masks]  # single bare mask for a single-input graph
+    masks = list(masks)
+    if len(masks) != len(names):
+        raise ValueError(
+            f"masks has {len(masks)} entries but the graph has "
+            f"{len(names)} inputs ({names})")
+    return [None if m is None else np.asarray(m) for m in masks]
+
+
+def _pad_graph_inputs(net, xs, mask_list, pad_rows: bool):
+    """Pad every graph input to the shared row bucket and its own time
+    bucket. Returns (padded_xs, masks_dict_or_None, b, per-input (t,
+    target_t), target_b)."""
+    from ..datasets.bucketing import pad_inference_batch
+
+    b = int(xs[0].shape[0])
+    if any(int(x.shape[0]) != b for x in xs):
+        raise ValueError("graph inputs disagree on batch size")
+    target_b = _bucket_plan(b, None, pad_rows)[0]
+    padded, masks, times = [], {}, []
+    any_mask = False
+    for name, x, m in zip(net.conf.network_inputs, xs, mask_list):
+        t = int(x.shape[1]) if x.ndim == 3 else None
+        target_t = _bucket_plan(b, t, False)[1]
+        x_p, m_p = pad_inference_batch(x, m, target_b, target_t)
+        padded.append(x_p)
+        masks[name] = m_p
+        any_mask = any_mask or m_p is not None
+        times.append((t, target_t))
+    return padded, (masks if any_mask else None), b, times, target_b
+
+
+def graph_output(net, inputs, masks=None, argmax: bool = False):
+    """Bucketed AOT forward for :class:`ComputationGraph`; returns a list
+    of host arrays aligned with ``conf.network_outputs``."""
+    import jax  # noqa: PLC0415
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    from .compile_manager import get_compile_manager, signature
+
+    net.init()
+    xs = _canon_graph_inputs(net, inputs)
+    mask_list = _graph_masks_list(net, masks)
+    xs_p, masks_p, b, times, _ = _pad_graph_inputs(
+        net, xs, mask_list, net._pad_examples_ok())
+
+    cm = get_compile_manager()
+    args = (net.params, net.state, xs_p, masks_p)
+    key = (net._cm_token, "graph_infer", signature(bool(argmax), args))
+
+    def build():
+        def fn(params, state, ins, mk):
+            outs = net._forward(params, ins, state, False, None, mk)[0]
+            if argmax:
+                outs = [jnp.argmax(o, axis=-1).astype(jnp.int32)
+                        for o in outs]
+            return outs
+
+        return jax.jit(fn, donate_argnums=_donate(2, 3))
+
+    compiled = cm.aot(key, build, args)
+    outs = compiled(*args)
+    # per-output time cut: outputs follow their driving input's time bucket
+    # only when shapes say so; (t, target_t) of input 0 is the best witness
+    t0, tt0 = times[0] if times else (None, None)
+    return [_slice_output(o, b, t0, tt0, argmax=argmax) for o in outs]
+
+
+def graph_rnn_step(net, inputs, features_masks=None):
+    """Stateful streaming step for :class:`ComputationGraph` (see
+    :func:`mln_rnn_step`); returns a list of host arrays."""
+    import jax  # noqa: PLC0415
+
+    from .compile_manager import get_compile_manager, signature
+
+    net.init()
+    xs = _canon_graph_inputs(net, inputs)
+    single_step = all(x.ndim == 2 for x in xs)
+    if single_step:
+        xs = [x[:, None, :] for x in xs]
+    mask_list = _graph_masks_list(net, features_masks)
+    xs_p, masks_p, b, times, _ = _pad_graph_inputs(net, xs, mask_list, False)
+
+    leaves = (jax.tree_util.tree_leaves(net._rnn_state)
+              if net._rnn_state is not None else [])
+    if net._rnn_state is None or (leaves and int(leaves[0].shape[0]) != b):
+        net._rnn_state = net._init_rnn_states(b)
+    _canon_rnn_state(net)
+
+    cm = get_compile_manager()
+    args = (net.params, net.state, net._rnn_state, xs_p, masks_p)
+    key = (net._cm_token, "graph_rnn_step", signature(args))
+
+    def build():
+        def fn(params, state, rnn, ins, mk):
+            return net._forward(params, ins, state, False, None, mk, rnn)[::2]
+
+        return jax.jit(fn, donate_argnums=_donate(2, 3))
+
+    compiled = cm.aot(key, build, args)
+    outs, net._rnn_state = compiled(*args)
+    t0, tt0 = times[0] if times else (None, None)
+    res = [_slice_output(o, b, t0, tt0) for o in outs]
+    if single_step:
+        res = [o[:, 0, :] if o.ndim == 3 else o for o in res]
+    return res

@@ -1,0 +1,34 @@
+"""Serving front-end: the "millions of users" leg of the north star.
+
+Layers (bottom up):
+
+- :mod:`runtime.inference` — the AOT-bucketed fast path every entry point
+  here dispatches through (dtype canonicalization, pow2 bucket + masked
+  padding, compile-manager admission, donation, fused argmax).
+- :mod:`serving.batcher` — dynamic micro-batching: concurrent requests
+  coalesce under a latency budget (``DL4JTPU_SERVE_MAX_DELAY_MS``,
+  ``DL4JTPU_SERVE_MAX_BATCH``) into one padded dispatch.
+- :mod:`serving.decode` — continuous batching for stateful RNN decode:
+  sessions own slots of one shared ``rnn_time_step`` state batch; masked
+  ticks step only the sessions with a pending token.
+- :mod:`serving.service` — the multi-model registry + serving metrics
+  (``dl4jtpu_serve_*``), exposed over HTTP by ``ui/server.py``
+  (POST ``/serving/predict``, POST ``/serving/rnn``, GET ``/api/serving``).
+
+See docs/serving.md for the endpoint contract and knob semantics.
+"""
+
+from .batcher import MAX_BATCH_ENV, MAX_DELAY_ENV, MicroBatcher
+from .decode import DECODE_SLOTS_ENV, DecodeServer
+from .service import InferenceService, get_service, set_service
+
+__all__ = [
+    "DECODE_SLOTS_ENV",
+    "DecodeServer",
+    "InferenceService",
+    "MAX_BATCH_ENV",
+    "MAX_DELAY_ENV",
+    "MicroBatcher",
+    "get_service",
+    "set_service",
+]

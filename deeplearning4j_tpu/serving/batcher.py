@@ -1,0 +1,196 @@
+"""Dynamic micro-batcher: coalesce concurrent requests under a latency budget.
+
+Serving traffic arrives one small request at a time; TPU executables want
+large, shape-stable batches. The batcher bridges the two: requests enqueue
+from any thread, a dispatcher thread coalesces whatever arrived within the
+latency budget (``DL4JTPU_SERVE_MAX_DELAY_MS``) — capped at
+``DL4JTPU_SERVE_MAX_BATCH`` rows — into ONE row-concatenated dispatch, and
+the inference fast path pads that to the nearest pow2 bucket with masked
+tails, so every mixed-size burst reuses the same bounded executable set.
+
+Semantics:
+
+- The **latency budget** is the longest any request waits for company: the
+  first request of a cycle starts the clock, the dispatch fires when the
+  budget lapses or the row cap fills, whichever is first. Budget 0 degrades
+  to per-request dispatch (useful for tests / latency-critical models).
+- Only **shape-compatible** requests coalesce (same trailing dims + dtype);
+  stragglers of a different shape stay queued for the next cycle, they are
+  never dropped.
+- Failures propagate per request: an exception in the dispatch function
+  rejects exactly the futures of that batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["MicroBatcher", "MAX_DELAY_ENV", "MAX_BATCH_ENV"]
+
+# env knobs (see docs/serving.md): how long a request may wait for company,
+# and the most rows one coalesced dispatch may carry
+MAX_DELAY_ENV = "DL4JTPU_SERVE_MAX_DELAY_MS"
+MAX_BATCH_ENV = "DL4JTPU_SERVE_MAX_BATCH"
+_DEFAULT_DELAY_MS = 2.0
+_DEFAULT_MAX_BATCH = 64
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _Request:
+    __slots__ = ("features", "future", "enqueued")
+
+    def __init__(self, features: np.ndarray):
+        self.features = features
+        self.future: "Future[np.ndarray]" = Future()
+        self.enqueued = time.perf_counter()
+
+
+class MicroBatcher:
+    """One model's request queue + dispatcher thread.
+
+    ``dispatch(features)`` receives the row-concatenated batch and returns
+    the row-aligned outputs (the inference fast path — bucketing, masking
+    and slicing live there, not here).
+    """
+
+    def __init__(self, dispatch: Callable[[np.ndarray], np.ndarray], *,
+                 max_delay_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 on_batch: Optional[Callable[..., None]] = None,
+                 on_request: Optional[Callable[[float], None]] = None):
+        self._dispatch = dispatch
+        self.max_delay_s = (
+            _env_float(MAX_DELAY_ENV, _DEFAULT_DELAY_MS)
+            if max_delay_ms is None else float(max_delay_ms)) / 1000.0
+        self.max_batch = int(
+            _env_float(MAX_BATCH_ENV, _DEFAULT_MAX_BATCH)
+            if max_batch is None else max_batch)
+        self._on_batch = on_batch
+        self._on_request = on_request
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: "deque[_Request]" = deque()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- client
+    def submit(self, features) -> "Future[np.ndarray]":
+        """Enqueue one request ([rows, ...features]); returns a Future of
+        the row-aligned output."""
+        features = np.asarray(features)
+        if features.ndim < 2:
+            raise ValueError(
+                f"request must be batched ([rows, ...]); got shape "
+                f"{features.shape}")
+        req = _Request(features)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req.future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5)
+        # reject whatever never dispatched
+        with self._lock:
+            leftover = list(self._queue)
+            self._queue.clear()
+        for req in leftover:
+            req.future.set_exception(RuntimeError("batcher stopped"))
+
+    # ---------------------------------------------------------- dispatcher
+    @staticmethod
+    def _shape_key(features: np.ndarray) -> Tuple:
+        return (features.shape[1:], str(features.dtype))
+
+    def _collect(self) -> List[_Request]:
+        """Block for the first request, then soak up shape-compatible
+        company until the latency budget lapses or the row cap fills."""
+        with self._cv:
+            while not self._queue and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if self._closed and not self._queue:
+                return []
+            first = self._queue.popleft()
+        group = [first]
+        rows = int(first.features.shape[0])
+        key = self._shape_key(first.features)
+        deadline = first.enqueued + self.max_delay_s
+        while rows < self.max_batch:
+            with self._cv:
+                # scan for the next compatible request that still FITS the
+                # row cap (the cap bounds the compiled bucket — overshoot
+                # would dispatch into a bucket warmup never compiled);
+                # incompatible/oversize ones keep their position
+                hit = None
+                for i, req in enumerate(self._queue):
+                    if (self._shape_key(req.features) == key
+                            and rows + int(req.features.shape[0])
+                            <= self.max_batch):
+                        hit = i
+                        break
+                if hit is not None:
+                    req = self._queue[hit]
+                    del self._queue[hit]
+                else:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(timeout=remaining)
+                    continue
+            group.append(req)
+            rows += int(req.features.shape[0])
+        return group
+
+    def _run(self) -> None:
+        while True:
+            group = self._collect()
+            if not group:
+                return
+            t0 = time.perf_counter()
+            feats = (group[0].features if len(group) == 1 else
+                     np.concatenate([r.features for r in group]))
+            try:
+                out = self._dispatch(feats)
+            except Exception as e:  # noqa: BLE001 - reject THIS batch only
+                for req in group:
+                    if not req.future.cancelled():
+                        req.future.set_exception(e)
+                continue
+            seconds = time.perf_counter() - t0
+            out = np.asarray(out)
+            offset = 0
+            done = time.perf_counter()
+            for req in group:
+                n = int(req.features.shape[0])
+                if not req.future.cancelled():
+                    req.future.set_result(out[offset:offset + n])
+                if self._on_request is not None:
+                    self._on_request(done - req.enqueued)
+                offset += n
+            if self._on_batch is not None:
+                self._on_batch(rows=int(feats.shape[0]),
+                               requests=len(group), seconds=seconds,
+                               queue_depth=self.queue_depth())

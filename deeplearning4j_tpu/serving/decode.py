@@ -1,0 +1,207 @@
+"""Continuous batching for stateful RNN decode.
+
+Streaming decode (char-RNN sampling, seq2seq generation) is the worst case
+for naive serving: every client holds private recurrent state and sends one
+token at a time, so per-client dispatch runs the chip at batch 1. This
+module keeps ONE slot-batched stream per model instead: each decode session
+owns a row of the net's streaming ``rnn_time_step`` state, and a ticker
+coalesces whichever sessions have a token pending (within the micro-batch
+latency budget) into a single masked step over the full slot batch.
+
+Exactness rides on the proven ``rnn_time_step`` mask contract: a slot whose
+mask is 0 this tick holds its LSTM h/c bit-exactly — so idle sessions are
+unaffected by other sessions' steps, and a session's output trajectory is
+identical to running it alone (pinned by tests/test_serving.py).
+
+Slot lifecycle: ``open()`` claims a free slot and zeroes its state rows
+(host-side — session churn is rare next to step traffic), ``step()``
+submits one token/frame, ``close()`` frees the slot.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from .batcher import MAX_DELAY_ENV, _env_float
+
+__all__ = ["DecodeServer", "DECODE_SLOTS_ENV"]
+
+# env knob: slot capacity of the continuous decode batch (pow2 recommended —
+# it IS the compiled batch dimension)
+DECODE_SLOTS_ENV = "DL4JTPU_SERVE_DECODE_SLOTS"
+_DEFAULT_SLOTS = 8
+
+
+class _Pending:
+    __slots__ = ("features", "future", "enqueued")
+
+    def __init__(self, features: np.ndarray):
+        self.features = features
+        self.future: "Future[np.ndarray]" = Future()
+        self.enqueued = time.perf_counter()
+
+
+class DecodeServer:
+    """Slot-batched streaming decode over one recurrent net."""
+
+    def __init__(self, net, *, capacity: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 on_batch=None, on_request=None):
+        from ..runtime.compile_manager import next_pow2
+
+        self.net = net
+        cap = (int(_env_float(DECODE_SLOTS_ENV, _DEFAULT_SLOTS))
+               if capacity is None else int(capacity))
+        self.capacity = max(1, next_pow2(cap))
+        self.max_delay_s = (
+            _env_float(MAX_DELAY_ENV, 2.0)
+            if max_delay_ms is None else float(max_delay_ms)) / 1000.0
+        self._on_batch = on_batch
+        self._on_request = on_request
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # serializes net state access: the ticker's step vs open()'s
+        # slot-state reset (the net's _rnn_state is one shared pytree)
+        self._net_lock = threading.Lock()
+        self._sessions: Dict[str, int] = {}           # session id -> slot
+        self._pending: Dict[int, _Pending] = {}       # slot -> request
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------ sessions
+    def open(self) -> str:
+        """Claim a free slot; returns the session id."""
+        with self._lock:
+            used = set(self._sessions.values())
+            free = next((i for i in range(self.capacity) if i not in used),
+                        None)
+            if free is None:
+                raise RuntimeError(
+                    f"all {self.capacity} decode slots are in use "
+                    f"(raise {DECODE_SLOTS_ENV})")
+            sid = uuid.uuid4().hex[:12]
+            self._sessions[sid] = free
+            self._reset_slot(free)
+            return sid
+
+    def close(self, session_id: str) -> None:
+        with self._cv:
+            slot = self._sessions.pop(session_id, None)
+            pend = self._pending.pop(slot, None) if slot is not None else None
+        if pend is not None:
+            pend.future.set_exception(RuntimeError("session closed"))
+
+    def sessions(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def _reset_slot(self, slot: int) -> None:
+        """Zero one slot's rows of the streaming state (fresh session).
+        Host-side round trip by design: churn is rare, and a device-side
+        per-slot scatter would compile one tiny program per slot index."""
+        import jax  # noqa: PLC0415
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        def zero_row(a):
+            host = np.array(a)
+            host[slot] = 0
+            return jnp.asarray(host)
+
+        with self._net_lock:
+            if self.net._rnn_state is None:
+                return  # first tick initializes a zero state anyway
+            self.net._rnn_state = jax.tree_util.tree_map(
+                zero_row, self.net._rnn_state)
+
+    # ---------------------------------------------------------------- step
+    def step(self, session_id: str, features, timeout_s: float = 30.0):
+        """One decode step for a session: ``features`` is a single frame
+        [features...]. Returns the net's output row for that frame once the
+        coalesced tick it joined has run."""
+        features = np.asarray(features)
+        with self._cv:
+            slot = self._sessions.get(session_id)
+            if slot is None:
+                raise KeyError(f"unknown decode session {session_id!r}")
+            if slot in self._pending:
+                raise RuntimeError(
+                    f"session {session_id!r} already has a step in flight")
+            pend = _Pending(features)
+            self._pending[slot] = pend
+            self._cv.notify()
+        return pend.future.result(timeout=timeout_s)
+
+    # --------------------------------------------------------------- ticker
+    def _collect(self) -> Dict[int, _Pending]:
+        with self._cv:
+            while not self._pending and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if self._closed:
+                return {}
+            first_t = min(p.enqueued for p in self._pending.values())
+            deadline = first_t + self.max_delay_s
+            # wait out the budget so concurrent sessions join this tick;
+            # a full slot set dispatches immediately
+            while (len(self._pending) < len(self._sessions)
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            batch = dict(self._pending)
+            self._pending.clear()
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            t0 = time.perf_counter()
+            try:
+                feat_dim = next(iter(batch.values())).features.shape
+                x = np.zeros((self.capacity, 1) + tuple(feat_dim),
+                             np.float32)
+                mask = np.zeros((self.capacity, 1), np.float32)
+                for slot, pend in batch.items():
+                    x[slot, 0] = pend.features
+                    mask[slot, 0] = 1.0
+                with self._net_lock:
+                    out = self.net.rnn_time_step(x, features_mask=mask)
+                out = np.asarray(out)
+                if out.ndim == 3:  # [slots, 1, C] -> [slots, C]
+                    out = out[:, 0]
+            except Exception as e:  # noqa: BLE001 - reject THIS tick only
+                for pend in batch.values():
+                    pend.future.set_exception(e)
+                continue
+            seconds = time.perf_counter() - t0
+            done = time.perf_counter()
+            for slot, pend in batch.items():
+                pend.future.set_result(out[slot])
+                if self._on_request is not None:
+                    self._on_request(done - pend.enqueued)
+            if self._on_batch is not None:
+                self._on_batch(rows=len(batch), requests=len(batch),
+                               seconds=seconds, queue_depth=0,
+                               bucket_rows=self.capacity)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._closed = True
+            pend = list(self._pending.values())
+            self._pending.clear()
+            self._cv.notify_all()
+        for p in pend:
+            p.future.set_exception(RuntimeError("decode server stopped"))
+        self._worker.join(timeout=5)

@@ -1,0 +1,338 @@
+"""InferenceService: multi-model serving front-end over the fast path.
+
+One service owns named models (both net classes), a dynamic micro-batcher
+per model (``batcher.py``), optional continuous decode streams for
+recurrent models (``decode.py``), and the serving observability the ISSUE 7
+acceptance names:
+
+- ``dl4jtpu_serve_requests_total{model}`` / ``dl4jtpu_serve_rows_total`` /
+  ``dl4jtpu_serve_batches_total`` — traffic counters,
+- ``dl4jtpu_serve_latency_seconds{model}`` — end-to-end request latency
+  histogram (enqueue → result), the Prometheus twin of the exact p50/p99
+  computed from a bounded recent-latency ring in :meth:`stats`,
+- ``dl4jtpu_serve_queue_depth{model}`` + ``dl4jtpu_serve_batch_fill_ratio``
+  gauges — how much headroom the batcher has and how full the pow2 buckets
+  run,
+- flight-recorder ``serve_dispatch`` events per coalesced dispatch.
+
+Multi-model tenancy needs no code here: every model's executables live in
+the process-wide compile-manager LRU next to the training entries, so cold
+models age out under eviction pressure and hot models stay resident.
+
+The process-global service (``get_service()``) is what ``ui/server.py``
+exposes over HTTP (POST ``/serving/predict``, POST ``/serving/rnn``, GET
+``/api/serving``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from .batcher import MicroBatcher
+from .decode import DecodeServer
+
+__all__ = ["InferenceService", "get_service", "set_service"]
+
+# request latencies span sub-ms (warm CPU micro-batch) to seconds (cold
+# accelerator dispatch) — finer low end than the step-time default buckets
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class _ModelEntry:
+    def __init__(self, name: str, net, batcher: MicroBatcher):
+        self.name = name
+        self.net = net
+        self.batcher = batcher
+        self.decoder: Optional[DecodeServer] = None
+        self.lock = threading.Lock()
+        self.latencies: "deque[float]" = deque(maxlen=2048)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.fill_sum = 0.0
+        self.last_dispatch: Optional[dict] = None
+
+
+class InferenceService:
+    """Named-model registry + per-model micro-batchers + serving metrics."""
+
+    def __init__(self, registry=None, *,
+                 max_delay_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None):
+        if registry is None:
+            from ..telemetry import get_registry  # noqa: PLC0415
+
+            registry = get_registry()
+        self.registry = registry
+        self.max_delay_ms = max_delay_ms
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelEntry] = {}
+        self.requests_total = registry.counter(
+            "dl4jtpu_serve_requests_total",
+            "inference requests served, by model", labelnames=("model",))
+        self.rows_total = registry.counter(
+            "dl4jtpu_serve_rows_total",
+            "example rows served, by model", labelnames=("model",))
+        self.batches_total = registry.counter(
+            "dl4jtpu_serve_batches_total",
+            "coalesced micro-batch dispatches, by model",
+            labelnames=("model",))
+        self.latency = registry.histogram(
+            "dl4jtpu_serve_latency_seconds",
+            "end-to-end request latency (enqueue to result), by model",
+            labelnames=("model",), buckets=LATENCY_BUCKETS)
+        self.queue_depth = registry.gauge(
+            "dl4jtpu_serve_queue_depth",
+            "requests waiting in the micro-batch queue, by model",
+            labelnames=("model",))
+        self.batch_fill = registry.gauge(
+            "dl4jtpu_serve_batch_fill_ratio",
+            "real rows / pow2 bucket rows of the last dispatch, by model",
+            labelnames=("model",))
+
+    # ------------------------------------------------------------ registry
+    @staticmethod
+    def _is_graph(net) -> bool:
+        return hasattr(net.conf, "network_inputs")
+
+    def register(self, name: str, net) -> "InferenceService":
+        """Serve ``net`` as ``name``. Graphs must be single-input /
+        single-output (the row-concatenating batcher has one features
+        tensor per request)."""
+        if self._is_graph(net):
+            if (len(net.conf.network_inputs) != 1
+                    or len(net.conf.network_outputs) != 1):
+                raise ValueError(
+                    f"model {name!r}: only single-input/single-output "
+                    "graphs can be served through the micro-batcher")
+        net.init()
+        entry_holder: list = []
+
+        def dispatch(feats: np.ndarray) -> np.ndarray:
+            return self._run_model(entry_holder[0], feats, argmax=False)
+
+        batcher = MicroBatcher(
+            dispatch,
+            max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
+            on_batch=lambda **kw: self._record_batch(name, **kw),
+            on_request=lambda s: self._record_request(name, s))
+        entry = _ModelEntry(name, net, batcher)
+        entry_holder.append(entry)
+        with self._lock:
+            old = self._models.get(name)
+            self._models[name] = entry
+        if old is not None:
+            old.batcher.stop()
+            if old.decoder is not None:
+                old.decoder.stop()
+        return self
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            entry = self._models.pop(name, None)
+        if entry is not None:
+            entry.batcher.stop()
+            if entry.decoder is not None:
+                entry.decoder.stop()
+
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def _entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise KeyError(f"unknown model {name!r}; registered: "
+                           f"{self.models()}")
+        return entry
+
+    # ------------------------------------------------------------ dispatch
+    def _run_model(self, entry: _ModelEntry, feats: np.ndarray,
+                   argmax: bool) -> np.ndarray:
+        from ..runtime import inference as _inf
+
+        net = entry.net
+        if self._is_graph(net):
+            return _inf.graph_output(net, [feats], argmax=argmax)[0]
+        return _inf.mln_output(net, feats, argmax=argmax)
+
+    def warmup(self, name: str, example, *, argmax: bool = False,
+               max_rows: Optional[int] = None) -> int:
+        """Compile-ahead for serving: run every pow2 row bucket from 1 up to
+        the micro-batcher's row cap through the fast path (plus the
+        fused-argmax variants when ``argmax``), so live traffic — whatever
+        mix of request sizes the batcher coalesces — pays zero compiles.
+        ``example`` is one request ([rows, ...features]); only its trailing
+        shape/dtype matter. Returns the number of buckets warmed."""
+        from ..runtime.compile_manager import next_pow2
+
+        entry = self._entry(name)
+        example = np.asarray(example)
+        cap = next_pow2(max_rows if max_rows is not None
+                        else entry.batcher.max_batch)
+        rows, warmed = 1, 0
+        while rows <= cap:
+            probe = np.zeros((rows,) + example.shape[1:], example.dtype)
+            self._run_model(entry, probe, argmax=False)
+            if argmax:
+                self._run_model(entry, probe, argmax=True)
+            warmed += 1
+            rows *= 2
+        return warmed
+
+    def predict(self, name: str, features, *, argmax: bool = False,
+                timeout_s: float = 30.0) -> np.ndarray:
+        """Serve one request through the model's micro-batcher. ``argmax``
+        requests bypass coalescing only in shape (they share the same
+        compiled bucket family via the fused-argmax variant)."""
+        entry = self._entry(name)
+        if argmax:
+            # class-index requests dispatch directly on the fused-argmax
+            # executable: coalescing mixed argmax/logits requests would
+            # force two transfers per batch
+            t0 = time.perf_counter()
+            out = self._run_model(entry, np.asarray(features), argmax=True)
+            lat = time.perf_counter() - t0
+            self._record_request(name, lat)
+            self._record_batch(name, rows=int(np.asarray(features).shape[0]),
+                               requests=1, seconds=lat, queue_depth=0)
+            return out
+        fut = entry.batcher.submit(features)
+        self.queue_depth.labels(model=name).set(entry.batcher.queue_depth())
+        return fut.result(timeout=timeout_s)
+
+    # ----------------------------------------------------------- decode
+    def decoder(self, name: str) -> DecodeServer:
+        """The model's continuous-decode stream (created on first use)."""
+        entry = self._entry(name)
+        with entry.lock:
+            if entry.decoder is None:
+                entry.decoder = DecodeServer(
+                    entry.net,
+                    max_delay_ms=self.max_delay_ms,
+                    on_batch=lambda **kw: self._record_batch(
+                        name, kind="decode", **kw),
+                    on_request=lambda s: self._record_request(name, s))
+            return entry.decoder
+
+    # ------------------------------------------------------------ metrics
+    def _record_request(self, name: str, seconds: float) -> None:
+        self.requests_total.labels(model=name).inc()
+        self.latency.labels(model=name).observe(seconds)
+        entry = self._models.get(name)
+        if entry is not None:
+            entry.requests += 1
+            entry.latencies.append(float(seconds))
+
+    def _record_batch(self, name: str, *, rows: int, requests: int,
+                      seconds: float, queue_depth: int,
+                      bucket_rows: Optional[int] = None,
+                      kind: str = "predict") -> None:
+        from ..runtime.compile_manager import next_pow2
+
+        bucket = bucket_rows if bucket_rows is not None else next_pow2(rows)
+        fill = rows / bucket if bucket else 0.0
+        self.batches_total.labels(model=name).inc()
+        self.rows_total.labels(model=name).inc(rows)
+        self.queue_depth.labels(model=name).set(queue_depth)
+        self.batch_fill.labels(model=name).set(fill)
+        entry = self._models.get(name)
+        if entry is not None:
+            entry.rows += rows
+            entry.batches += 1
+            entry.fill_sum += fill
+            entry.last_dispatch = {
+                "kind": kind, "rows": rows, "requests": requests,
+                "bucket_rows": bucket, "fill_ratio": round(fill, 4),
+                "seconds": round(seconds, 6)}
+        try:
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            get_flight_recorder().record(
+                "serve_dispatch", model=name, mode=kind, rows=int(rows),
+                requests=int(requests), bucket_rows=int(bucket),
+                fill_ratio=round(fill, 4), seconds=round(seconds, 6))
+        except Exception:  # observability must never fail a request
+            pass
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """JSON-ready serving snapshot (the /api/serving payload): per-model
+        traffic, exact p50/p99 over the recent-latency ring, batch fill,
+        live queue depth, decode stream state, plus the shared compile-cache
+        view that explains executable tenancy."""
+        from ..runtime.compile_manager import get_compile_manager
+
+        with self._lock:
+            entries = dict(self._models)
+        models = {}
+        for name, e in entries.items():
+            lats = list(e.latencies)
+            models[name] = {
+                "requests_total": e.requests,
+                "rows_total": e.rows,
+                "batches_total": e.batches,
+                "queue_depth": e.batcher.queue_depth(),
+                "mean_batch_fill_ratio": (
+                    round(e.fill_sum / e.batches, 4) if e.batches else None),
+                "latency_seconds": {
+                    "p50": _percentile(lats, 50),
+                    "p99": _percentile(lats, 99),
+                    "max": max(lats) if lats else None,
+                    "samples": len(lats),
+                },
+                "last_dispatch": e.last_dispatch,
+                "decode_sessions": (
+                    e.decoder.sessions() if e.decoder is not None else 0),
+                "batcher": {
+                    "max_delay_ms": round(e.batcher.max_delay_s * 1000, 3),
+                    "max_batch": e.batcher.max_batch,
+                },
+            }
+        return {
+            "models": models,
+            "compile_cache": get_compile_manager().stats(),
+        }
+
+    def stop(self) -> None:
+        with self._lock:
+            entries = list(self._models.values())
+            self._models.clear()
+        for e in entries:
+            e.batcher.stop()
+            if e.decoder is not None:
+                e.decoder.stop()
+
+
+_GLOBAL: Optional[InferenceService] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_service() -> InferenceService:
+    """The process-wide serving front-end (what the UI server exposes)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = InferenceService()
+        return _GLOBAL
+
+
+def set_service(service: Optional[InferenceService]) -> None:
+    """Swap the process-wide service (tests / custom deployments)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = service

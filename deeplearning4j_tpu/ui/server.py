@@ -28,6 +28,11 @@ Pages:
 - ``/api/ircost``     — the IR lint / static roofline view: per-executable
   ``static_cost`` reports from the compile cache, DT2xx finding counters,
   and the configured roofline (DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS).
+- ``/api/serving``    — serving snapshot: per-model traffic counters, exact
+  p50/p99 request latency, batch fill, queue depth, decode sessions.
+- ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
+  and continuous-decode endpoints over the process serving front-end
+  (``serving.get_service()``; see docs/serving.md).
 """
 
 from __future__ import annotations
@@ -462,6 +467,14 @@ class _Handler(BaseHTTPRequestHandler):
                 last = 256
             return self._send(200, json.dumps(
                 get_flight_recorder().snapshot(last), default=str).encode())
+        if path == "/api/serving":
+            # serving snapshot: per-model traffic, exact p50/p99 over the
+            # recent-latency ring, batch fill, decode sessions, and the
+            # shared compile cache that holds every model's executables
+            from ..serving import get_service  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                get_service().stats(), default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]
@@ -547,10 +560,18 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send(404, b'{"error": "not found"}')
 
     def do_POST(self):
-        """Remote stats receiver (reference: ui/module/remote/)."""
+        """Remote stats receiver (reference: ui/module/remote/) + the
+        batch-inference serving endpoints (ISSUE 7)."""
         storages: List[StatsStorage] = self.server.storages  # type: ignore
         length = int(self.headers.get("Content-Length", 0))
-        record = json.loads(self.rfile.read(length) or b"{}")
+        try:
+            record = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return self._send(400, b'{"error": "malformed JSON body"}')
+        if self.path == "/serving/predict":
+            return self._serve_predict(record)
+        if self.path == "/serving/rnn":
+            return self._serve_rnn(record)
         if not storages:
             return self._send(503, b'{"error": "no storage attached"}')
         if self.path == "/remote/static":
@@ -560,6 +581,78 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             return self._send(404, b"{}")
         return self._send(200, b'{"status": "ok"}')
+
+    def _serve_predict(self, record: dict):
+        """POST /serving/predict {model, features, argmax?, timeout_s?}:
+        one batch-inference request through the model's dynamic
+        micro-batcher (requests from concurrent clients coalesce under the
+        service latency budget into one padded pow2-bucket dispatch)."""
+        from ..serving import get_service  # noqa: PLC0415
+
+        name = record.get("model")
+        feats = record.get("features")
+        if not name or feats is None:
+            return self._send(
+                400, b'{"error": "need \'model\' and \'features\'"}')
+        svc = get_service()
+        try:
+            out = svc.predict(
+                name, feats, argmax=bool(record.get("argmax", False)),
+                timeout_s=float(record.get("timeout_s", 30.0)))
+        except KeyError as e:
+            return self._send(404, json.dumps({"error": str(e)}).encode())
+        except Exception as e:  # noqa: BLE001 - report, don't kill the server
+            return self._send(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"[:500]}).encode())
+        key = "classes" if record.get("argmax") else "output"
+        import numpy as _np  # noqa: PLC0415
+
+        return self._send(200, json.dumps(
+            {"model": name, key: _np.asarray(out).tolist()}).encode())
+
+    def _serve_rnn(self, record: dict):
+        """POST /serving/rnn {model, op: open|step|close, session?,
+        features?}: continuous-batching decode sessions. ``open`` claims a
+        state slot, ``step`` submits one frame (concurrent sessions' steps
+        coalesce into one masked rnn_time_step tick), ``close`` frees the
+        slot."""
+        from ..serving import get_service  # noqa: PLC0415
+
+        name = record.get("model")
+        op = record.get("op", "step")
+        if not name:
+            return self._send(400, b'{"error": "need \'model\'"}')
+        svc = get_service()
+        try:
+            dec = svc.decoder(name)
+            if op == "open":
+                return self._send(200, json.dumps(
+                    {"model": name, "session": dec.open()}).encode())
+            sid = record.get("session")
+            if not sid:
+                return self._send(400, b'{"error": "need \'session\'"}')
+            if op == "close":
+                dec.close(sid)
+                return self._send(200, json.dumps(
+                    {"model": name, "closed": sid}).encode())
+            if op != "step":
+                return self._send(400, json.dumps(
+                    {"error": f"unknown op {op!r}"}).encode())
+            feats = record.get("features")
+            if feats is None:
+                return self._send(400, b'{"error": "need \'features\'"}')
+            out = dec.step(sid, feats,
+                           timeout_s=float(record.get("timeout_s", 30.0)))
+            import numpy as _np  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                {"model": name, "session": sid,
+                 "output": _np.asarray(out).tolist()}).encode())
+        except KeyError as e:
+            return self._send(404, json.dumps({"error": str(e)}).encode())
+        except Exception as e:  # noqa: BLE001 - report, don't kill the server
+            return self._send(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"[:500]}).encode())
 
 
 class UIServer:

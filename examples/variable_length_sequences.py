@@ -82,7 +82,15 @@ def main(quick: bool = False) -> float:
         pred = out.mean(axis=0).argmax()
         correct += int(pred == labels[0].argmax())
     acc = correct / len(test)
-    stream_programs = net._rnn_step_fn._cache_size()
+    # PR 7: streaming programs are AOT entries in the process compile
+    # manager (keyed by the net's owner token), not a per-net jit cache
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+    cm = get_compile_manager()
+    stream_programs = len([
+        k for k in cm._entries
+        if isinstance(k, tuple) and k and k[0] == net._cm_token
+        and cm._key_kind(k) == "mln_rnn_step"])
     assert stream_programs <= len(bounds), stream_programs
     distinct = len({f.shape[0] for f, _ in corpus})
     print(

@@ -16,10 +16,12 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ 
 echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
 
-echo "== dl4jtpu-check: compile/bucketing modules held to --fail-on warning"
+echo "== dl4jtpu-check: compile/bucketing/serving modules held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/runtime/compile_manager.py \
+    deeplearning4j_tpu/runtime/inference.py \
     deeplearning4j_tpu/datasets/bucketing.py \
+    deeplearning4j_tpu/serving/ \
     --fail-on warning
 
 echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
@@ -215,6 +217,82 @@ finally:
     server.stop()
 PY
 
+echo "== serving smoke: concurrent mixed shapes, zero warm compiles, p99 budget"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 7 acceptance smoke: in-process HTTP serving front-end under
+# concurrent mixed-shape traffic must (1) pay ZERO compiles after warmup —
+# the compile-manager counter is the proof, (2) keep exact p99 under a
+# generous CPU budget, (3) populate /api/serving and the dl4jtpu_serve_*
+# series on /metrics.
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+from deeplearning4j_tpu.serving import get_service
+from deeplearning4j_tpu.ui.server import UIServer
+
+net = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[DenseLayer(n_out=64, activation="relu"),
+            OutputLayer(n_out=10, activation="softmax", loss="mcxent")],
+    input_type=InputType.feed_forward(32),
+    updater=UpdaterConfig(updater="adam", learning_rate=1e-3))).init()
+svc = get_service()
+svc.register("smoke", net)
+svc.warmup("smoke", np.zeros((1, 32), np.float32), argmax=True)
+server = UIServer.get_instance(port=0)
+base = f"http://127.0.0.1:{server.port}"
+
+cm = get_compile_manager()
+compiles_before = cm.compiles.value
+rng = np.random.default_rng(0)
+errors = []
+
+def client(ci):
+    try:
+        for i in range(12):
+            rows = 1 + (ci + i) % 6  # mixed request shapes
+            body = json.dumps({
+                "model": "smoke",
+                "features": rng.normal(size=(rows, 32)).tolist(),
+                "argmax": bool(i % 2)}).encode()
+            req = urllib.request.Request(
+                base + "/serving/predict", body,
+                {"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            got = out.get("classes" if i % 2 else "output")
+            assert len(got) == rows, (rows, out)
+    except Exception as e:  # surfaced after join
+        errors.append(e)
+
+threads = [threading.Thread(target=client, args=(ci,)) for ci in range(8)]
+for t in threads: t.start()
+for t in threads: t.join()
+assert not errors, errors
+warm = cm.compiles.value - compiles_before
+assert warm == 0, f"{warm} compiles paid by warm serving traffic"
+
+stats = json.loads(urllib.request.urlopen(base + "/api/serving",
+                                          timeout=10).read())
+m = stats["models"]["smoke"]
+assert m["requests_total"] >= 96, m
+p99 = m["latency_seconds"]["p99"]
+assert p99 is not None and p99 < 0.25, f"p99 {p99}s over the 250ms budget"
+metrics = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+for name in ("dl4jtpu_serve_requests_total", "dl4jtpu_serve_latency_seconds",
+             "dl4jtpu_serve_queue_depth", "dl4jtpu_serve_batch_fill_ratio"):
+    assert name in metrics, f"{name} missing from /metrics"
+server.stop()
+svc.stop()
+print(f"serving smoke OK: {int(m['requests_total'])} requests, 0 warm "
+      f"compiles, p99 {p99*1000:.1f}ms, fill "
+      f"{m['mean_batch_fill_ratio']}, /api/serving + /metrics populated")
+PY
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
@@ -227,6 +305,12 @@ rm -f /tmp/_bench_gate_line.json
 BENCH_FORCE_CPU=1 BENCH_DEADLINE_S=240 python bench.py | tail -1 \
     > /tmp/_bench_gate_line.json
 python scripts/bench_gate.py /tmp/_bench_gate_line.json
+
+echo "== bench regression gate (serve mode vs BENCH_BASELINE.json)"
+rm -f /tmp/_bench_gate_serve.json
+BENCH_FORCE_CPU=1 BENCH_MODEL=serve BENCH_DEADLINE_S=240 python bench.py \
+    | tail -1 > /tmp/_bench_gate_serve.json
+python scripts/bench_gate.py /tmp/_bench_gate_serve.json
 
 echo "== tier-1 tests"
 set -o pipefail

@@ -39,6 +39,22 @@ def test_ragged_mode_row():
     assert tel["compile"]["compile_seconds"]["count"] >= 1
 
 
+def test_serve_mode_row():
+    r = bench.bench_serve(feature_dim=16, hidden=32, classes=4,
+                          levels=(1, 3), requests_per_client=6,
+                          max_rows=4, max_delay_ms=2.0, max_batch=16)
+    assert r["metric"] == "serve_offered_load_samples_per_sec"
+    assert r["value"] > 0 and r["unit"] == "samples/sec"
+    # the acceptance bar: the whole offered-load sweep after warmup pays
+    # ZERO compiles (mixed request sizes share the warmed pow2 buckets)
+    assert r["warm_compiles_total"] == 0
+    assert set(r["sweep"]) == {"1", "3"}
+    best = r["best_level"]
+    assert best["p50_ms"] is not None and best["p99_ms"] >= best["p50_ms"]
+    assert 0 < best["mean_batch_fill_ratio"] <= 1.0
+    assert r["telemetry"]["bench_serve_p99_ms"] >= 0
+
+
 def test_real_text_corpus_is_real_english():
     sents = bench._real_text_sequences(min_words=5000)
     words = [w for s in sents for w in s]

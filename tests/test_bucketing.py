@@ -95,7 +95,15 @@ def test_pad_to_bucket_streaming_bounds_compiles_and_preserves_state():
         out = np.asarray(net.rnn_time_step(xp, features_mask=mask))[:, :t]
         assert out.shape == (2, t, 3)
     # one program per touched bucket, regardless of the 7 distinct lengths
-    assert net._rnn_step_fn._cache_size() <= len(BOUNDS)
+    # (PR 7: streaming programs are AOT entries in the process compile
+    # manager, keyed by the net's owner token, not a per-net jit cache)
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+    cm = get_compile_manager()
+    programs = [k for k in cm._entries
+                if isinstance(k, tuple) and k and k[0] == net._cm_token
+                and cm._key_kind(k) == "mln_rnn_step"]
+    assert len(programs) <= len(BOUNDS)
 
     # masked padded steps hold h/c: state equals the exact-length run's
     exact = _rnn_net(seed=7)
@@ -147,4 +155,10 @@ def test_graph_rnn_time_step_masked_bucketing():
         xp, mask, real_t = pad_to_bucket(x, BOUNDS)
         out = np.asarray(net.rnn_time_step(xp, features_masks=mask))[:, :real_t]
         assert out.shape == (2, t, 3)
-    assert net._rnn_step_fn._cache_size() <= len(BOUNDS)
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+    cm = get_compile_manager()
+    programs = [k for k in cm._entries
+                if isinstance(k, tuple) and k and k[0] == net._cm_token
+                and cm._key_kind(k) == "graph_rnn_step"]
+    assert len(programs) <= len(BOUNDS)
