@@ -317,6 +317,19 @@ def check_partition_specs(shardings, mesh, params=None, *,
                     f"{sorted(own_axes)} but will be applied on a mesh with "
                     f"axes {sorted(mesh_axes)}", **ctx))
                 continue
+            if leaf.mesh != mesh:
+                # same axis names, different mesh: a stale layout's params
+                # mixed with a fresh mesh (different axis sizes or device
+                # sets) — lower() would fail with a raw incompatible-devices
+                # error, or worse, silently resolve to a different factor
+                own_shape = {str(a): int(s) for a, s in leaf.mesh.shape.items()}
+                detail = (f"axis sizes {own_shape} vs {mesh_axes}"
+                          if own_shape != mesh_axes
+                          else "a different device set")
+                findings.append(rule.finding(
+                    "NamedSharding was built on a DIFFERENT mesh than it "
+                    f"will be applied on ({detail}) — stale layout?", **ctx))
+                continue
         elif isinstance(leaf, PartitionSpec):
             spec = leaf
         else:

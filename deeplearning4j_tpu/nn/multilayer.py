@@ -32,13 +32,22 @@ from .conf.inputs import InputType
 
 
 def _cast_params(conf_dtype: str, params):
-    """Mixed precision: master params stay f32; bf16 compute keeps the MXU fed."""
+    """Mixed precision: master params stay f32; bf16 compute keeps the MXU fed.
+
+    The inverse combination is the bf16-storage/f32-compute precision
+    policy (parallel/layout.py): ``params_dtype="bfloat16"`` under a
+    float32 compute dtype stores/communicates bf16 leaves but upcasts them
+    here, per step, so the forward/backward math (and the loss/psum
+    accumulation downstream) runs in f32. Gradients transpose back through
+    the cast and land in bf16 — half the all-reduce bytes."""
     if conf_dtype == "bfloat16":
         return jax.tree_util.tree_map(
             lambda a: a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a,
             params,
         )
-    return params
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32)
+        if getattr(a, "dtype", None) == jnp.bfloat16 else a, params)
 
 
 def _carry_params_dtype(conf, params):

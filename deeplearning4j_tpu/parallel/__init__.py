@@ -7,6 +7,7 @@ from .mesh import (
     replicated_sharding,
     data_sharding,
 )
+from .layout import MeshLayout, PrecisionPolicy, layout_of
 from .wrapper import ParallelWrapper
 from .training_master import (
     TrainingMaster,
@@ -34,6 +35,9 @@ __all__ = [
     "initialize_multihost",
     "replicated_sharding",
     "data_sharding",
+    "MeshLayout",
+    "PrecisionPolicy",
+    "layout_of",
     "ParallelWrapper",
     "TrainingMaster",
     "TrainingStats",

@@ -1,0 +1,405 @@
+"""MeshLayout: ONE sharding layer under training AND serving (dp×fsdp×tp).
+
+`parallel/` grew four overlapping scale paths (wrapper, param_server,
+training_master, pipeline), each doing its own mesh handling, and none of
+them could shard *parameters* over a data-parallel axis — the largest
+trainable model was bounded by one chip's HBM. This module is the single
+GSPMD-style layout authority (per Xu et al., *GSPMD*; ZeRO-style parameter
+sharding per Rajbhandari et al., *ZeRO*) the ROADMAP tentpole names:
+
+- **One named mesh** with ``("data", "fsdp", "tp")`` axes. Any axis of size
+  1 collapses out of the emitted PartitionSpecs (the mesh keeps all three
+  names so specs stay portable across layouts).
+- **Parameter-name→spec assignment** in the style of SNIPPETS.md [2]
+  (``SpecLayout``): 2-D+ kernels shard their last dim over ``tp`` when
+  divisible and a divisible non-tp dim over ``fsdp``; 1-D vectors follow
+  the legacy tp rule; exactly-3-D expert-stacked MoE weights shard dim 0
+  over an expert axis. Optimizer moments mirror their param's shape, so the
+  same shape rule lands them on the same spec ("moments follow their
+  param").
+- **Batch sharding** over ``data×fsdp`` (the ZeRO convention: fsdp ranks
+  see different data; GSPMD inserts the per-step all-gather of params and
+  reduce-scatter of gradients).
+- **Precision policy**: ``params_dtype="bfloat16"`` carries parameters,
+  gradients and optimizer moments in bf16 *storage* while the forward/
+  backward compute (and the loss/psum accumulation) runs in f32 — the
+  promoted form of the ``__graft_entry__`` §8 dryrun. bf16 leaves shard
+  exactly like f32 ones, so fsdp + bf16 compound: per-device param bytes
+  drop by ``2 × fsdp`` and gradient all-reduce bytes halve.
+
+ParallelWrapper, the TrainingMasters and the serving stack
+(`runtime/inference.py`, `serving/service.py`) are thin strategy wrappers
+over this class — none of them constructs a NamedSharding/PartitionSpec of
+its own. Every layout is validated by the DT008 ``check_partition_specs``
+rule (here via :meth:`MeshLayout.validate`, and automatically at
+``CompileManager.aot`` admission for any executable compiled with sharded
+arguments). See docs/distributed.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["MeshLayout", "PrecisionPolicy", "layout_of"]
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Storage-vs-compute dtype contract of a layout.
+
+    ``params_dtype`` is what parameter/gradient/moment *leaves* are stored
+    (and communicated) in; ``compute_dtype`` is what the forward/backward
+    math runs in (``nn.multilayer._compute_cast`` upcasts bf16 storage to
+    f32 per step when they differ — loss and reductions accumulate in f32).
+    """
+
+    params_dtype: Optional[str] = None  # None = keep the model's own dtype
+    compute_dtype: str = "float32"
+
+    def apply_to_net(self, net) -> None:
+        """Stamp the policy onto a net: conf carries it forward (JSON
+        round-trips), and already-initialized params/opt-state leaves are
+        cast to the storage dtype in place."""
+        if self.params_dtype is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        net.conf.params_dtype = self.params_dtype
+        if net.params is None:
+            return
+
+        target = jnp.dtype(self.params_dtype)
+
+        def cast(a):
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                    and a.dtype != target:
+                return a.astype(target)
+            return a
+
+        net.params = jax.tree_util.tree_map(cast, net.params)
+        if net.opt_state is not None:
+            # moments mirror their param's storage (scalar counts stay int)
+            net.opt_state = jax.tree_util.tree_map(cast, net.opt_state)
+
+    def describe(self) -> dict:
+        return {"params_dtype": self.params_dtype,
+                "compute_dtype": self.compute_dtype}
+
+
+def layout_of(net) -> Optional["MeshLayout"]:
+    """The MeshLayout a net was sharded with (``MeshLayout.apply``), or
+    None — how the serving fast path discovers mesh placement."""
+    return getattr(net, "_mesh_layout", None)
+
+
+class MeshLayout:
+    """One named mesh + the spec rules every scale path shares."""
+
+    def __init__(self, data: Optional[int] = None, fsdp: int = 1, tp: int = 1,
+                 *, devices: Optional[Sequence] = None,
+                 params_dtype: Optional[str] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        fsdp, tp = int(fsdp), int(tp)
+        if fsdp < 1 or tp < 1:
+            raise ValueError(f"axis sizes must be >= 1, got fsdp={fsdp} tp={tp}")
+        devs = list(devices) if devices is not None else jax.devices()
+        if data is None:
+            data = max(1, len(devs) // (fsdp * tp))
+        data = int(data)
+        need = data * fsdp * tp
+        if need > len(devs):
+            raise ValueError(
+                f"layout data={data} x fsdp={fsdp} x tp={tp} needs {need} "
+                f"devices, have {len(devs)}")
+        arr = np.array(devs[:need]).reshape(data, fsdp, tp)
+        self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp"))
+        self._batch_axes = tuple(
+            a for a in ("data", "fsdp") if self.mesh.shape[a] > 1)
+        self._fsdp_axis = "fsdp" if fsdp > 1 else None
+        self._tp_axis = "tp" if tp > 1 else None
+        self._expert_axis = None
+        self.precision = PrecisionPolicy(params_dtype=params_dtype)
+
+    @classmethod
+    def from_mesh(cls, mesh, model_axis: Optional[str] = None,
+                  expert_axis: Optional[str] = None,
+                  params_dtype: Optional[str] = None) -> "MeshLayout":
+        """Wrap an existing mesh (the legacy ParallelWrapper construction
+        path): ``model_axis`` plays the tp role, ``expert_axis`` enables the
+        MoE expert-stacked rule, every other axis is a batch axis. A named
+        axis absent from the mesh raises — a typo must fail loudly, not
+        silently train replicated."""
+        self = cls.__new__(cls)
+        for ax, label in ((model_axis, "model_axis"),
+                          (expert_axis, "expert_axis")):
+            if ax is not None and ax not in mesh.shape:
+                raise ValueError(
+                    f"{label} '{ax}' not in mesh axes {tuple(mesh.shape)}")
+        self.mesh = mesh
+        self._batch_axes = tuple(
+            a for a in mesh.axis_names if a not in (model_axis, expert_axis))
+        self._fsdp_axis = "fsdp" if (
+            "fsdp" in mesh.shape and mesh.shape["fsdp"] > 1
+            and "fsdp" not in (model_axis, expert_axis)) else None
+        self._tp_axis = model_axis
+        self._expert_axis = expert_axis
+        self.precision = PrecisionPolicy(params_dtype=params_dtype)
+        return self
+
+    # ------------------------------------------------------------ geometry
+    @property
+    def axis_sizes(self) -> dict:
+        return {str(a): int(s) for a, s in self.mesh.shape.items()}
+
+    def _size(self, axis: Optional[str]) -> int:
+        return int(self.mesh.shape[axis]) if axis is not None else 1
+
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        return self._batch_axes
+
+    @property
+    def batch_factor(self) -> int:
+        """How many ways the batch dim shards (global batch must divide it)."""
+        return int(np.prod([self.mesh.shape[a] for a in self._batch_axes],
+                           dtype=np.int64)) if self._batch_axes else 1
+
+    @property
+    def num_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    # ---------------------------------------------------------------- specs
+    def batch_spec(self):
+        """Dim-0 (batch/replica) spec over every batch axis (data×fsdp)."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self._batch_axes) if self._batch_axes else P()
+
+    def staged_batch_spec(self):
+        """Spec for staged windows/groups ``[K, B, ...]`` — batch dim is 1."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(None, self._batch_axes) if self._batch_axes else P()
+
+    def param_spec(self, shape) -> "Any":
+        """The fsdp/tp/expert rule set for one parameter (or moment) shape:
+
+        - exactly-3-D leaves whose dim 0 divides an expert axis (MoE
+          expert-stacked ``[E, F, H]``) shard dim 0 over it;
+        - 2-D+ kernels shard the last dim over ``tp`` when divisible, then
+          the first remaining divisible dim over ``fsdp``;
+        - 1-D vectors shard over ``fsdp`` when divisible (ZeRO shards
+          biases too — and GSPMD's own propagation picks exactly this
+          placement, so declaring it keeps executable outputs at the
+          declared specs: zero warm recompiles), else over ``tp`` when
+          divisible (legacy parity);
+        - everything else replicates.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        shape = tuple(int(s) for s in shape)
+        esize = self._size(self._expert_axis)
+        tsize = self._size(self._tp_axis)
+        fsize = self._size(self._fsdp_axis)
+        if (self._expert_axis and len(shape) == 3 and esize > 1
+                and shape[0] % esize == 0 and shape[0] >= esize):
+            return P(self._expert_axis, *([None] * (len(shape) - 1)))
+        entries: List[Any] = [None] * len(shape)
+        if len(shape) >= 2:
+            if tsize > 1 and shape[-1] > 0 and shape[-1] % tsize == 0:
+                entries[-1] = self._tp_axis
+            if fsize > 1:
+                for d, size in enumerate(shape):
+                    if entries[d] is None and size % fsize == 0 \
+                            and size >= fsize:
+                        entries[d] = self._fsdp_axis
+                        break
+        elif len(shape) == 1:
+            if fsize > 1 and shape[0] % fsize == 0 and shape[0] >= fsize:
+                entries[0] = self._fsdp_axis
+            elif tsize > 1 and shape[0] % tsize == 0 and shape[0] >= tsize:
+                entries[0] = self._tp_axis
+        while entries and entries[-1] is None:
+            entries.pop()  # canonical form: P() not P(None,) — GSPMD emits
+            #               the trimmed spelling, and cache keys compare it
+        return P(*entries)
+
+    # ------------------------------------------------------------ shardings
+    def sharding(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        return self.sharding(P())
+
+    def batch_sharding(self):
+        return self.sharding(self.batch_spec())
+
+    def staged_batch_sharding(self):
+        return self.sharding(self.staged_batch_spec())
+
+    def replica_sharding(self):
+        """Leading-replica-axis sharding for the periodic-averaging mode
+        (one independent replica per batch-axis slot). tp/expert layouts
+        have no replica semantics — :class:`ParallelWrapper` refuses the
+        combination before this is ever called."""
+        if self._tp_axis is not None or self._expert_axis is not None:
+            raise ValueError(
+                "replica (periodic-averaging) placement is undefined for "
+                "tp/expert layouts; use sync mode (averaging_frequency=1)")
+        return self.batch_sharding()
+
+    def param_specs(self, tree):
+        """PartitionSpec pytree for params — or any shape-mirroring tree
+        (optimizer moments land on their param's spec by the shape rule;
+        scalar bookkeeping replicates)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: self.param_spec(np.shape(a)), tree)
+
+    def param_shardings(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: self.sharding(self.param_spec(np.shape(a))), tree)
+
+    # -------------------------------------------------------------- devices
+    def put(self, arr, sharding=None):
+        """Place host data on the mesh (multi-process safe — delegates to
+        :func:`parallel.mesh.global_put`). Default: batch sharding."""
+        from .mesh import global_put
+
+        return global_put(arr, sharding if sharding is not None
+                          else self.batch_sharding())
+
+    def put_params(self, tree):
+        """device_put a param-shaped pytree leaf-wise on its layout specs."""
+        import jax
+
+        from .mesh import global_put
+
+        return jax.tree_util.tree_map(
+            lambda a: global_put(a, self.sharding(
+                self.param_spec(np.shape(a)))), tree)
+
+    def put_replicated(self, tree):
+        import jax
+
+        from .mesh import global_put
+
+        rep = self.replicated()
+        return jax.tree_util.tree_map(lambda a: global_put(a, rep), tree)
+
+    # ------------------------------------------------------------- networks
+    def apply(self, net) -> "MeshLayout":
+        """Make ``net`` live on this layout: apply the precision policy,
+        shard params + optimizer state by the rule set (state replicates),
+        and stamp the layout so the serving fast path (and a later
+        ParallelWrapper) discovers the placement. Idempotent."""
+        import jax
+
+        net.init()
+        self.precision.apply_to_net(net)
+        net.params = self.put_params(net.params)
+        if net.opt_state is not None:
+            net.opt_state = self.put_params(net.opt_state)
+        if jax.tree_util.tree_leaves(net.state):
+            net.state = self.put_replicated(net.state)
+        net._mesh_layout = self
+        return self
+
+    def shard_params(self, net):
+        """:meth:`apply` returning the param sharding pytree (checkpoint
+        restore wants it) — the layout twin of the legacy
+        ``parallel.sharding.shard_params``."""
+        self.apply(net)
+        return self.param_shardings(net.params)
+
+    # ------------------------------------------------------------ validation
+    def validate(self, params=None, *, source: str = "<MeshLayout>"):
+        """DT008 ``check_partition_specs`` over this layout's param specs
+        (axis membership, duplicate axes, divisibility when ``params`` is
+        given). Returns analysis findings — empty means clean."""
+        from ..analysis import check_partition_specs
+
+        tree = params if params is not None else {}
+        specs = self.param_specs(tree) if params is not None else {}
+        return check_partition_specs(specs, self.mesh, params, source=source)
+
+    # ------------------------------------------------------- fsdp HBM math
+    def _leaf_bytes(self, leaf, *, storage: bool, sharded: bool) -> float:
+        import jax.numpy as jnp
+
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            return 0.0
+        dt = np.dtype(leaf.dtype)
+        if storage and self.precision.params_dtype is not None \
+                and jnp.issubdtype(dt, np.floating):
+            dt = np.dtype(self.precision.params_dtype)
+        n = float(np.prod(shape, dtype=np.float64)) * dt.itemsize
+        if not sharded:
+            return n
+        factor = 1
+        for entry in tuple(self.param_spec(shape)):
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+                factor *= self._size(ax)
+        return n / factor
+
+    def sharded_totals(self, net, report: dict) -> dict:
+        """Per-device byte projection of a :func:`telemetry.memory_report`
+        under this layout — the fsdp HBM math ``preflight(layout=...)``
+        checks against the budget:
+
+        - params/grads/moments divide by each leaf's spec factor (and drop
+          to the storage dtype under the precision policy);
+        - activations and inputs divide by the batch factor (data×fsdp).
+        """
+        import jax
+
+        p_pd = sum(self._leaf_bytes(l, storage=True, sharded=True)
+                   for l in jax.tree_util.tree_leaves(net.params))
+        o_pd = sum(self._leaf_bytes(l, storage=True, sharded=True)
+                   for l in jax.tree_util.tree_leaves(net.opt_state))
+        bf = self.batch_factor
+        act_pd = report["totals"]["activation_bytes"] / bf
+        in_pd = report["totals"]["input_bytes"] / bf
+        projected = 2 * p_pd + o_pd + act_pd + in_pd
+        return {
+            "param_bytes": int(p_pd),
+            "grad_bytes": int(p_pd),
+            "opt_state_bytes": int(o_pd),
+            "activation_bytes": int(act_pd),
+            "input_bytes": int(in_pd),
+            "projected_peak_bytes": int(projected),
+            "batch_factor": bf,
+        }
+
+    # ---------------------------------------------------------------- misc
+    def describe(self) -> dict:
+        """JSON-ready layout summary (serving stats / flight events)."""
+        return {
+            "axes": self.axis_sizes,
+            "batch_axes": list(self._batch_axes),
+            "fsdp_axis": self._fsdp_axis,
+            "tp_axis": self._tp_axis,
+            "expert_axis": self._expert_axis,
+            "devices": self.num_devices,
+            "precision": self.precision.describe(),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        sizes = "x".join(f"{a}={s}" for a, s in self.axis_sizes.items())
+        return f"MeshLayout({sizes}, params_dtype={self.precision.params_dtype})"

@@ -34,29 +34,16 @@ def tree_shardings(tree, mesh, model_axis: str | None = "model",
     shard dim 0 over it — XLA then derives the dispatch/combine all-to-alls
     from the routing einsums, the GSPMD form of expert parallelism. (3-D
     exactly: 4-D conv kernels whose height happens to divide must not
-    match.)"""
-    from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: PLC0415
+    match.)
 
-    for ax in (model_axis, expert_axis):
-        if ax is not None and ax not in mesh.shape:
-            raise ValueError(f"axis '{ax}' not in mesh axes {tuple(mesh.shape)}")
-    size = mesh.shape[model_axis] if model_axis is not None else 1
-    esize = mesh.shape[expert_axis] if expert_axis else 1
+    Since the dp×fsdp×tp unification this is a thin wrapper over
+    :class:`~deeplearning4j_tpu.parallel.layout.MeshLayout` — ONE rule set
+    serves the legacy model/expert spelling and the canonical layout (a
+    mesh carrying an ``fsdp`` axis additionally gets the fsdp rule)."""
+    from .layout import MeshLayout  # noqa: PLC0415
 
-    def rule(a):
-        shape = np.shape(a)
-        if (expert_axis and len(shape) == 3 and shape[0] % esize == 0
-                and shape[0] >= esize):
-            spec = P(expert_axis, *([None] * (len(shape) - 1)))
-        elif len(shape) >= 2 and size > 1 and shape[-1] % size == 0:
-            spec = P(*([None] * (len(shape) - 1)), model_axis)
-        elif len(shape) == 1 and size > 1 and shape[0] % size == 0 and shape[0] >= size:
-            spec = P(model_axis)
-        else:
-            spec = P()
-        return NamedSharding(mesh, spec)
-
-    return jax.tree_util.tree_map(rule, tree)
+    return MeshLayout.from_mesh(
+        mesh, model_axis, expert_axis).param_shardings(tree)
 
 
 def param_shardings(params, mesh, model_axis: str | None = "model",
@@ -81,13 +68,10 @@ def shard_params(net, mesh, model_axis: str | None = "model",
                  expert_axis: str | None = None):
     """device_put the net's params (and existing optimizer state) with
     tensor/expert-parallel shardings; returns the param sharding pytree so
-    callers can reuse it for checkpoint restore."""
-    net.init()
-    shardings = param_shardings(net.params, mesh, model_axis, expert_axis)
-    net.params = jax.device_put(net.params, shardings)
-    if net.opt_state is not None:
-        net.opt_state = jax.device_put(
-            net.opt_state, tree_shardings(net.opt_state, mesh, model_axis,
-                                          expert_axis)
-        )
-    return shardings
+    callers can reuse it for checkpoint restore. Delegates to
+    :meth:`MeshLayout.shard_params` (which also replicates layer state and
+    stamps the net so the serving fast path sees the placement)."""
+    from .layout import MeshLayout  # noqa: PLC0415
+
+    return MeshLayout.from_mesh(mesh, model_axis,
+                                expert_axis).shard_params(net)

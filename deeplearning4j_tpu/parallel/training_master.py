@@ -87,18 +87,23 @@ class SyncAllReduceTrainingMaster(TrainingMaster):
     form of averagingFrequency=1 (SURVEY.md §5.8). Subsumes both the reference's
     ParallelWrapper (single host) and its Spark master when the mesh spans hosts."""
 
-    def __init__(self, workers: Optional[int] = None, mesh=None):
+    def __init__(self, workers: Optional[int] = None, mesh=None, layout=None):
         from .wrapper import ParallelWrapper
 
         self._wrapper_cls = ParallelWrapper
         self.workers = workers
         self.mesh = mesh
+        # MeshLayout (parallel/layout.py): the single sharding authority —
+        # dp×fsdp×tp placement plus the precision policy; mesh= stays as the
+        # legacy data-parallel spelling (it wraps into a layout downstream)
+        self.layout = layout
         self.stats = TrainingStats()
 
     def execute_training(self, net, data, epochs: int = 1):
         t0 = time.perf_counter()
         wrapper = self._wrapper_cls(
-            net, workers=self.workers, averaging_frequency=1, mesh=self.mesh
+            net, workers=self.workers, averaging_frequency=1, mesh=self.mesh,
+            layout=self.layout,
         )
         self.stats.record("setup", t0, time.perf_counter())
         t1 = time.perf_counter()
@@ -130,6 +135,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         report_score_after_averaging: bool = True,
         collect_training_stats: bool = True,
         mesh=None,
+        layout=None,
     ):
         self.workers = workers
         self.averaging_frequency = averaging_frequency
@@ -138,6 +144,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.report_score_after_averaging = report_score_after_averaging
         self.collect_training_stats = collect_training_stats
         self.mesh = mesh
+        # pure-dp MeshLayouts only: the wrapper refuses fsdp/tp/expert
+        # layouts in periodic mode (replica stacking drops param sharding)
+        self.layout = layout
         self.stats = TrainingStats()
 
     def execute_training(self, net, data, epochs: int = 1):
@@ -151,6 +160,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             average_updaters=self.average_updaters,
             report_score_after_averaging=self.report_score_after_averaging,
             mesh=self.mesh,
+            layout=self.layout,
         )
         if self.collect_training_stats:
             self.stats.record("broadcast", t0, time.perf_counter())

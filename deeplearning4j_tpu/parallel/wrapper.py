@@ -23,6 +23,11 @@ TPU-native design — the thread/queue machinery does not exist:
   the replica axis = all-reduce, broadcast back = all-gather) replaces
   ``Nd4j.averageAndPropagate``. Updater state averaging matches
   ``averageUpdaters`` (ParallelWrapper.java:198-224).
+
+Every sharding this wrapper places comes from ONE authority — the
+:class:`~deeplearning4j_tpu.parallel.layout.MeshLayout` (dp×fsdp×tp layout
+rules + precision policy, docs/distributed.md); the wrapper is a thin
+training strategy over it.
 """
 
 from __future__ import annotations
@@ -34,8 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .mesh import (make_mesh, replicated_sharding, data_sharding, global_put,
-                   global_put_local, global_put_tree)
+from .layout import MeshLayout
+from .mesh import make_mesh, global_put, global_put_local
 
 
 def _stack_tree(tree, n: int):
@@ -67,32 +72,41 @@ class ParallelWrapper:
         model_axis: Optional[str] = None,
         expert_axis: Optional[str] = None,
         data_is_local: bool = False,
+        layout: Optional[MeshLayout] = None,
     ):
         self.net = net
-        self.mesh = mesh if mesh is not None else make_mesh(workers)
-        # dp×tp: batch shards over "data", params over model_axis (GSPMD
-        # inserts the tensor-parallel collectives — parallel/sharding.py);
-        # dp×ep: MoE expert-stacked weights shard over expert_axis
-        self.model_axis = model_axis
-        self.expert_axis = expert_axis
-        for axis, label in ((model_axis, "model_axis"), (expert_axis, "expert_axis")):
-            if axis is not None and axis not in self.mesh.axis_names:
+        # ONE sharding authority: every batch/param/opt-state sharding this
+        # wrapper uses comes from a MeshLayout (parallel/layout.py). Pass
+        # ``layout=`` for the canonical dp×fsdp×tp mesh; the legacy
+        # mesh/model_axis/expert_axis arguments wrap into a layout too
+        # (model_axis plays the tp role), so both paths share the rule set.
+        if layout is not None:
+            if mesh is not None or model_axis or expert_axis:
                 raise ValueError(
-                    f"{label} '{axis}' not in mesh axes {self.mesh.axis_names}"
-                )
-        if (model_axis or expert_axis) and averaging_frequency > 1:
+                    "pass either layout= or mesh=/model_axis=/expert_axis=, "
+                    "not both — the layout already owns the mesh and axes")
+            self.layout = layout
+        else:
+            m = mesh if mesh is not None else make_mesh(workers)
+            # dp×tp: batch shards over "data", params over model_axis (GSPMD
+            # inserts the tensor-parallel collectives); dp×ep: MoE
+            # expert-stacked weights shard over expert_axis — from_mesh
+            # raises on an axis name absent from the mesh (typo = loud)
+            self.layout = MeshLayout.from_mesh(m, model_axis, expert_axis)
+        self.mesh = self.layout.mesh
+        self.model_axis = self.layout._tp_axis
+        self.expert_axis = self.layout._expert_axis
+        if averaging_frequency > 1 and (
+                self.layout._tp_axis or self.layout._expert_axis
+                or self.layout._fsdp_axis):
             raise ValueError(
-                "tensor/expert parallelism requires sync mode "
-                "(averaging_frequency=1); periodic replica averaging would "
-                "silently replicate the model"
+                "fsdp/tensor/expert parallelism requires sync mode "
+                "(averaging_frequency=1); periodic replica averaging stacks "
+                "independent UNSHARDED replicas and would silently drop the "
+                "declared param sharding"
             )
-        self._data_axes = tuple(n for n in self.mesh.axis_names
-                                if n not in (model_axis, expert_axis))
-        self.workers = int(
-            np.prod([self.mesh.shape[n] for n in self._data_axes])
-            if (model_axis or expert_axis)
-            else np.prod(self.mesh.devices.shape)
-        )
+        self._data_axes = self.layout.batch_axes
+        self.workers = int(self.layout.batch_factor)
         # data_is_local: each PROCESS feeds only its shard of the global
         # batch (per-host input pipelines, SURVEY.md §7(d)); default is the
         # broadcast pattern (every process holds the full batch). Sync mode
@@ -152,29 +166,22 @@ class ParallelWrapper:
     # ------------------------------------------------------------- sync mode
     def _setup_sync(self):
         net = self.net
-        net.init()
+        # layout.apply: precision policy + params/opt-state sharded by the
+        # rule set (moments follow their param's spec; training state is
+        # preserved, not reset), state replicated, net stamped so the
+        # serving fast path discovers the placement
+        self.layout.apply(net)
+        # the rng key rides every staged dispatch and comes back
+        # mesh-replicated; placing it up front keeps the FIRST dispatch's
+        # cache signature identical to every later one (zero warm compiles)
+        net._rng = self.layout.put(net._rng, self.layout.replicated())
         if net._train_step is None:
             net._train_step = net._build_train_step()
-        rep = replicated_sharding(self.mesh)
-        if self.model_axis is not None or self.expert_axis is not None:
-            from .sharding import shard_params  # noqa: PLC0415
-
-            # shards params AND the existing opt_state (moments follow their
-            # param's sharding; training state is preserved, not reset)
-            shard_params(net, self.mesh, self.model_axis,
-                         expert_axis=self.expert_axis)
-        else:
-            net.params = global_put_tree(net.params, rep)
-            net.opt_state = global_put_tree(net.opt_state, rep)
-        if jax.tree_util.tree_leaves(net.state):
-            net.state = global_put_tree(net.state, rep)
         self._sync_ready = True
 
     def _batch_sharding(self):
-        """Batch-dim sharding over every non-model mesh axis."""
-        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
-
-        return NamedSharding(self.mesh, PartitionSpec(self._data_axes))
+        """Batch-dim sharding over every batch (data×fsdp) mesh axis."""
+        return self.layout.batch_sharding()
 
     def _fit_sync(self, global_ds) -> None:
         """One SPMD step on a globally-sharded batch; grads psum over ICI."""
@@ -242,10 +249,8 @@ class ParallelWrapper:
                                                 features_masks, labels_masks)
         if not self._sync_ready:
             self._setup_sync()
-        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
-
         net = self.net
-        shard = NamedSharding(self.mesh, PartitionSpec(None, self._data_axes))
+        shard = self.layout.staged_batch_sharding()
         put = global_put_local if self.data_is_local else global_put
         try:
             with self.timer.phase("data"):
@@ -339,11 +344,8 @@ class ParallelWrapper:
         if fn is None:
             fn = self._build_periodic_multi_step(n_steps, num_groups, phase)
             self._periodic_multi_cache[cache_key] = fn
-        shard0 = data_sharding(self.mesh)
-        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
-
         # groups [K, workers, batch, ...]: replica axis is 1
-        group_shard = NamedSharding(self.mesh, PartitionSpec(None, *shard0.spec))
+        group_shard = self.layout.staged_batch_sharding()
         try:
             with self.timer.phase("data"):
                 xs = global_put(xs, group_shard)
@@ -393,8 +395,13 @@ class ParallelWrapper:
             _stack_tree(net.opt_state, n),
             _stack_tree(net.state, n),
         )
-        shard0 = data_sharding(self.mesh)  # leading replica axis over devices
-        self._replica = global_put_tree(self._replica, shard0)
+        # leading replica axis over the batch devices; the layout REFUSES
+        # this placement for tp/expert layouts (stacked replicas would
+        # silently drop the declared param sharding — the constructor
+        # guards the same combination)
+        shard0 = self.layout.replica_sharding()
+        self._replica = jax.tree_util.tree_map(
+            lambda a: global_put(a, shard0), self._replica)
 
         tx = net._tx
 
@@ -436,7 +443,7 @@ class ParallelWrapper:
         params, opt_state, state = self._replica
         net._rng, k = jax.random.split(net._rng)
         keys = jax.random.split(k, self.workers)
-        shard0 = data_sharding(self.mesh)
+        shard0 = self.layout.replica_sharding()
         with self.timer.phase("data"):
             x = global_put(np.asarray(stacked_ds.features), shard0)
             y = global_put(np.asarray(stacked_ds.labels), shard0)

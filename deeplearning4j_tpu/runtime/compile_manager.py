@@ -72,13 +72,35 @@ def next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _sharding_sig(x: Any):
+    """A leaf's mesh placement, iff it is explicitly mesh-sharded. Local
+    (single-device / uncommitted / shell) leaves all collapse to None so
+    the pre-sharding cache keys are byte-identical — but two programs whose
+    arguments live on different meshes (or under different PartitionSpecs)
+    must NOT share an executable: an AOT program is compiled FOR its input
+    shardings, and serving a replicated-params executable to an
+    fsdp-sharded net (or vice versa) would fail at dispatch."""
+    sh = getattr(x, "sharding", None)
+    if sh is None or type(sh).__name__ != "NamedSharding":
+        return None
+    mesh = sh.mesh
+    if mesh.devices.size <= 1:
+        return None
+    spec = tuple(sh.spec)
+    while spec and spec[-1] is None:
+        spec = spec[:-1]  # P(None,) ≡ P(): GSPMD round-trips trim the spec
+    return ("mesh", tuple((str(a), int(s)) for a, s in mesh.shape.items()),
+            tuple(int(d.id) for d in mesh.devices.flat), str(spec))
+
+
 def _leaf_sig(x: Any):
     """One leaf's contribution to a canonical key. Arrays reduce to
-    (shape, dtype, weak_type) — exactly what decides whether an AOT
-    executable can be reused; everything else must be hashable."""
+    (shape, dtype, weak_type, mesh-sharding-or-None) — exactly what decides
+    whether an AOT executable can be reused; everything else must be
+    hashable."""
     if hasattr(x, "shape") and hasattr(x, "dtype"):
         return ("arr", tuple(x.shape), str(x.dtype),
-                bool(getattr(x, "weak_type", False)))
+                bool(getattr(x, "weak_type", False)), _sharding_sig(x))
     return x
 
 
@@ -327,6 +349,52 @@ class CompileManager:
                 pass
         return value
 
+    def _check_arg_shardings(self, key, args) -> None:
+        """DT008 at admission (next to the DT2xx IR scan): an executable
+        about to be compiled with mesh-sharded in/out structs gets every
+        declared NamedSharding checked against the computation's mesh —
+        axis membership, duplicate axes, shape divisibility, and
+        cross-mesh mixing (stale params from a retired layout next to a
+        fresh batch sharding fail lower() with a raw device error; the
+        finding names the leaf first). Findings land in
+        ``dl4jtpu_ir_findings_total{rule="DT008"}`` + a flight event and
+        never block the compile — ``validate_shardings`` used to be
+        manual-call-only."""
+        import jax  # noqa: PLC0415
+
+        meshes = []
+        for leaf in jax.tree_util.tree_leaves(args):
+            sh = getattr(leaf, "sharding", None)
+            if type(sh).__name__ == "NamedSharding" and sh.mesh.devices.size > 1:
+                if not any(sh.mesh is m or sh.mesh == m for m in meshes):
+                    meshes.append(sh.mesh)
+        if not meshes:
+            return
+        from jax.sharding import PartitionSpec  # noqa: PLC0415
+
+        from ..analysis.graph_checks import check_partition_specs  # noqa: PLC0415
+
+        def spec_of(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if type(sh).__name__ == "NamedSharding":
+                return sh  # keeps its own mesh: cross-mesh mixing is checked
+            return PartitionSpec()  # local leaf: trivially applicable
+
+        shardings = jax.tree_util.tree_map(spec_of, args)
+        findings = check_partition_specs(
+            shardings, meshes[0], args,
+            source=f"<aot:{self._key_kind(key)}>")
+        if not findings:
+            return
+        for f in findings:
+            self.ir_findings.labels(rule=f.rule_id).inc()
+        try:
+            from ..analysis.ir_checks import record_findings  # noqa: PLC0415
+
+            record_findings(findings, registry=False, flight=self._flight())
+        except Exception:
+            pass
+
     def aot(self, key: Tuple, build: Callable[[], Any], args) -> Any:
         """Compiled executable for ``key``; on miss, ``build()`` must return
         a jitted callable which is AOT-lowered against ``args`` (concrete
@@ -336,6 +404,11 @@ class CompileManager:
         entry = self._get(key)
         if entry is not None:
             return entry
+        if os.environ.get(IR_CHECKS_ENV, "1") != "0":
+            try:  # analysis must never break compilation
+                self._check_arg_shardings(key, args)
+            except Exception:
+                pass
         # kernel-selection hook: variants are resolved by ops.kernel_select
         # DURING the trace below (cost-model-guided, cached per shape key);
         # snapshot the log so selections first made for THIS admission land

@@ -30,6 +30,13 @@ through the same :mod:`runtime.compile_manager` the fit paths use:
 - **Fused argmax.** ``predict()`` compiles ``argmax`` into the executable
   and transfers only class indices instead of materializing full logits
   on the host.
+- **One sharding layer with training.** A net that lives on a
+  :class:`~deeplearning4j_tpu.parallel.layout.MeshLayout` (trained under
+  one, or registered with ``service.register(..., layout=...)``) serves
+  from its mesh placement: request tensors/masks/streaming state are put
+  on the layout (batch-sharded over data×fsdp when the padded rows divide
+  the batch factor, replicated otherwise), and the cache key carries the
+  shardings so differently-placed programs never collide.
 
 Results return as host ``np.ndarray`` — the fetch is the sync point the
 serving layer needs anyway, and host-side slicing keeps the zero-warm-
@@ -67,7 +74,8 @@ def _compute_dtype(conf_dtype: str, params):
     """The net's floating compute dtype: bf16 for bf16 models, else the
     params' floating dtype (f32 in production; f64 under an x64-enabled
     process, where casting down would LOSE precision vs the in-trace
-    cast)."""
+    cast). bf16 params under a non-bf16 conf are STORAGE-only (the
+    precision policy, parallel/layout.py) — compute stays f32."""
     import jax  # noqa: PLC0415
     import jax.numpy as jnp  # noqa: PLC0415
 
@@ -75,7 +83,7 @@ def _compute_dtype(conf_dtype: str, params):
         return jnp.bfloat16
     for leaf in jax.tree_util.tree_leaves(params):
         if jnp.issubdtype(leaf.dtype, jnp.floating):
-            return leaf.dtype
+            return jnp.float32 if leaf.dtype == jnp.bfloat16 else leaf.dtype
     return np.float32
 
 
@@ -162,6 +170,41 @@ def _donate(*argnums: int) -> Tuple[int, ...]:
     return argnums if jax.default_backend() != "cpu" else ()
 
 
+# --------------------------------------------------------------- layout
+def _net_layout(net):
+    """The MeshLayout the net was sharded with (``MeshLayout.apply`` /
+    ``ParallelWrapper`` stamp it), or None. Serving is a strategy wrapper
+    over the SAME layout training used: request tensors are placed on the
+    layout's mesh so the already-sharded params serve without a resharding
+    round-trip."""
+    from ..parallel.layout import layout_of  # noqa: PLC0415
+
+    return layout_of(net)
+
+
+def _layout_put(layout, arr, rows: Optional[int] = None):
+    """Place one request tensor on the net's layout: batch-sharded over
+    data×fsdp when the (padded) row count divides the batch factor,
+    replicated otherwise — both compile and run under GSPMD; replication
+    only costs the sharding win, never correctness. No-op without a
+    layout (single-device serving keeps host arrays — zero extra puts)."""
+    if layout is None or arr is None:
+        return arr
+    bf = layout.batch_factor
+    if rows is not None and bf > 1 and rows % bf == 0:
+        return layout.put(arr, layout.batch_sharding())
+    return layout.put(arr, layout.replicated())
+
+
+def _layout_put_tree(layout, tree, rows: Optional[int] = None):
+    import jax  # noqa: PLC0415
+
+    if layout is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: _layout_put(layout, a, rows), tree)
+
+
 # ------------------------------------------------------------ MultiLayer
 def mln_output(net, x, features_mask=None, argmax: bool = False) -> np.ndarray:
     """Bucketed AOT forward for :class:`MultiLayerNetwork`. With ``argmax``
@@ -180,6 +223,9 @@ def mln_output(net, x, features_mask=None, argmax: bool = False) -> np.ndarray:
     target_b, target_t = _bucket_plan(b, t, net._pad_examples_ok())
     fm = None if features_mask is None else np.asarray(features_mask)
     x_p, fm_p = pad_inference_batch(x, fm, target_b, target_t)
+    layout = _net_layout(net)
+    x_p = _layout_put(layout, x_p, target_b)
+    fm_p = _layout_put(layout, fm_p, target_b)
 
     cm = get_compile_manager()
     args = (net.params, net.state, x_p, fm_p)
@@ -225,6 +271,12 @@ def mln_rnn_step(net, x, features_mask=None):
     if net._rnn_state is None or (leaves and int(leaves[0].shape[0]) != b):
         net._rnn_state = net._init_rnn_states(b)
     _canon_rnn_state(net)
+    layout = _net_layout(net)
+    x_p = _layout_put(layout, x_p, b)
+    fm_p = _layout_put(layout, fm_p, b)
+    # streaming state rides the same placement as its rows (the executable
+    # donates it back with an identical sharding)
+    net._rnn_state = _layout_put_tree(layout, net._rnn_state, b)
 
     cm = get_compile_manager()
     args = (net.params, net.state, net._rnn_state, x_p, fm_p)
@@ -305,8 +357,11 @@ def graph_output(net, inputs, masks=None, argmax: bool = False):
     net.init()
     xs = _canon_graph_inputs(net, inputs)
     mask_list = _graph_masks_list(net, masks)
-    xs_p, masks_p, b, times, _ = _pad_graph_inputs(
+    xs_p, masks_p, b, times, target_b = _pad_graph_inputs(
         net, xs, mask_list, net._pad_examples_ok())
+    layout = _net_layout(net)
+    xs_p = _layout_put_tree(layout, xs_p, target_b)
+    masks_p = _layout_put_tree(layout, masks_p, target_b)
 
     cm = get_compile_manager()
     args = (net.params, net.state, xs_p, masks_p)
@@ -350,6 +405,10 @@ def graph_rnn_step(net, inputs, features_masks=None):
     if net._rnn_state is None or (leaves and int(leaves[0].shape[0]) != b):
         net._rnn_state = net._init_rnn_states(b)
     _canon_rnn_state(net)
+    layout = _net_layout(net)
+    xs_p = _layout_put_tree(layout, xs_p, b)
+    masks_p = _layout_put_tree(layout, masks_p, b)
+    net._rnn_state = _layout_put_tree(layout, net._rnn_state, b)
 
     cm = get_compile_manager()
     args = (net.params, net.state, net._rnn_state, xs_p, masks_p)

@@ -108,10 +108,17 @@ class InferenceService:
     def _is_graph(net) -> bool:
         return hasattr(net.conf, "network_inputs")
 
-    def register(self, name: str, net) -> "InferenceService":
+    def register(self, name: str, net, layout=None) -> "InferenceService":
         """Serve ``net`` as ``name``. Graphs must be single-input /
         single-output (the row-concatenating batcher has one features
-        tensor per request)."""
+        tensor per request).
+
+        ``layout``: a :class:`~deeplearning4j_tpu.parallel.MeshLayout` to
+        serve under — params/opt-state shard by the SAME dp×fsdp×tp rule
+        set (and precision policy) training uses, and the inference fast
+        path places request tensors on the layout's mesh. A net that
+        arrives already sharded (``MeshLayout.apply`` / ParallelWrapper)
+        keeps its placement without passing anything here."""
         if self._is_graph(net):
             if (len(net.conf.network_inputs) != 1
                     or len(net.conf.network_outputs) != 1):
@@ -119,6 +126,8 @@ class InferenceService:
                     f"model {name!r}: only single-input/single-output "
                     "graphs can be served through the micro-batcher")
         net.init()
+        if layout is not None:
+            layout.apply(net)
         entry_holder: list = []
 
         def dispatch(feats: np.ndarray) -> np.ndarray:
@@ -279,10 +288,14 @@ class InferenceService:
 
         with self._lock:
             entries = dict(self._models)
+        from ..parallel.layout import layout_of  # noqa: PLC0415
+
         models = {}
         for name, e in entries.items():
             lats = list(e.latencies)
+            lo = layout_of(e.net)
             models[name] = {
+                "layout": lo.describe() if lo is not None else None,
                 "requests_total": e.requests,
                 "rows_total": e.rows,
                 "batches_total": e.batches,

@@ -364,14 +364,27 @@ def _hbm_limit() -> tuple:
 
 def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
               headroom: float = 0.9, registry: Optional[MetricsRegistry] = None,
-              flight: Optional[Any] = None) -> dict:
+              flight: Optional[Any] = None, layout: Optional[Any] = None) -> dict:
     """Will this net + batch fit? Raises :class:`MemoryPreflightError` with
     the biggest consumers named BEFORE any fit/warmup dispatch pays a doomed
     compile; returns the annotated :func:`memory_report` when it fits (or
     when no limit source exists — ``report["preflight"]["checked"]`` says
     which). ``headroom`` reserves a fraction of the limit for XLA scratch
-    and fragmentation."""
+    and fragmentation.
+
+    ``layout``: a :class:`~deeplearning4j_tpu.parallel.MeshLayout` — the
+    check then runs against the PER-DEVICE projection (params/grads/
+    moments divided by each leaf's fsdp/tp shard factor and dropped to the
+    precision policy's storage dtype; activations and inputs divided by the
+    data×fsdp batch factor). A model whose global working set exceeds one
+    device's HBM passes preflight when the layout makes its per-device
+    share fit — the capability jump fsdp exists for."""
     report = memory_report(net, batch_or_struct)
+    if layout is not None:
+        # fsdp HBM math (docs/distributed.md): what ONE device holds
+        net.init()
+        report["layout"] = layout.describe()
+        report["totals"]["per_device"] = layout.sharded_totals(net, report)
     source = "explicit limit_bytes"
     if limit_bytes is None:
         limit_bytes, source = _hbm_limit()
@@ -398,11 +411,14 @@ def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
         report["preflight"] = {"checked": False, "reason": source}
         return report
     projected = report["totals"]["projected_peak_bytes"]
+    if layout is not None:
+        projected = report["totals"]["per_device"]["projected_peak_bytes"]
     budget = int(limit_bytes * headroom)
     report["preflight"] = {
         "checked": True,
         "fits": projected <= budget,
         "projected_peak_bytes": projected,
+        "per_device": layout is not None,
         "limit_bytes": int(limit_bytes),
         "headroom": headroom,
         "limit_source": source,
@@ -416,8 +432,10 @@ def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
         top = ", ".join(
             f"{c['name']} ({c['type']}, {c['human']})"
             for c in report["top_consumers"])
+        what = ("projected per-device training peak" if layout is not None
+                else "projected training peak")
         raise MemoryPreflightError(
-            f"projected training peak {_fmt_bytes(projected)} exceeds "
+            f"{what} {_fmt_bytes(projected)} exceeds "
             f"{_fmt_bytes(budget)} ({headroom:.0%} of "
             f"{_fmt_bytes(limit_bytes)} from {source}); "
             f"biggest consumers: {top}",

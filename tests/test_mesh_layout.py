@@ -1,0 +1,396 @@
+"""MeshLayout (ISSUE 8): one dp×fsdp×tp sharding layer under training AND
+serving, with the bf16-storage/f32-compute precision policy — the promoted
+form of the ``__graft_entry__`` §8 dryrun.
+
+Runs on a 4-device mesh carved from the suite's 8 virtual CPU devices
+(conftest.py). Everything here is single-process GSPMD, so the known CPU
+multiprocess limitation (cross-process collectives — probe in
+tests/test_multiprocess.py) does not apply.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.parallel import (
+    MeshLayout,
+    ParallelWrapper,
+    layout_of,
+    make_mesh,
+)
+
+
+def _devices(n=4):
+    return jax.devices()[:n]
+
+
+def _conf(seed=3, params_dtype=None, hidden=32, features=16, classes=4,
+          updater="adam", lr=1e-2):
+    return MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=hidden, activation="tanh"),
+            OutputLayer(n_out=classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(features),
+        updater=UpdaterConfig(updater=updater, learning_rate=lr),
+        seed=seed,
+        params_dtype=params_dtype,
+    )
+
+
+def _data(n=32, features=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    x = (y @ rng.normal(size=(classes, features)) * 2
+         + rng.normal(scale=0.3, size=(n, features))).astype(np.float32)
+    return x, y
+
+
+class TestSpecRules:
+    def test_canonical_mesh_axes(self):
+        lo = MeshLayout(data=2, fsdp=2, tp=1, devices=_devices())
+        assert lo.axis_sizes == {"data": 2, "fsdp": 2, "tp": 1}
+        assert lo.batch_axes == ("data", "fsdp")
+        assert lo.batch_factor == 4
+
+    def test_size_one_axes_collapse(self):
+        """A pure-dp layout emits NO fsdp/tp axis in any spec."""
+        lo = MeshLayout(data=4, devices=_devices())
+        assert lo.batch_spec() == P(("data",))
+        assert lo.param_spec((64, 32)) == P()
+        assert lo.param_spec((64,)) == P()
+
+    def test_fsdp_rule_non_tp_dim(self):
+        lo = MeshLayout(data=1, fsdp=2, tp=2, devices=_devices())
+        # 2-D kernel: last dim over tp, first remaining divisible dim fsdp
+        assert lo.param_spec((16, 32)) == P("fsdp", "tp")
+        # tp-indivisible last dim: fsdp still lands
+        assert lo.param_spec((16, 31)) == P("fsdp")
+        # fsdp-indivisible rows: next divisible dim is the tp dim — skipped
+        assert lo.param_spec((3, 32)) == P(None, "tp")
+        # 1-D: fsdp first (ZeRO shards biases), tp as the fallback
+        assert lo.param_spec((32,)) == P("fsdp")
+        assert lo.param_spec((31,)) == P()
+        lo_tp = MeshLayout(data=2, tp=2, devices=_devices())
+        assert lo_tp.param_spec((32,)) == P("tp")
+        # scalars replicate
+        assert lo.param_spec(()) == P()
+
+    def test_specs_canonical_no_trailing_none(self):
+        lo = MeshLayout(data=1, fsdp=4, devices=_devices())
+        # replicated 1-D comes back as P(), never P(None,) — cache keys
+        # compare the canonical spelling GSPMD round-trips
+        assert tuple(lo.param_spec((63,))) == ()
+        assert lo.param_spec((64, 3)) == P("fsdp")
+        assert tuple(lo.param_spec((64, 3))) == ("fsdp",)
+
+    def test_from_mesh_legacy_tp_and_expert(self):
+        mesh = make_mesh(4, axis_names=("data", "model"), shape=(2, 2))
+        lo = MeshLayout.from_mesh(mesh, model_axis="model")
+        assert lo.param_spec((16, 32)) == P(None, "model")
+        assert lo.batch_axes == ("data",)
+        mesh_e = make_mesh(4, axis_names=("data", "expert"), shape=(2, 2))
+        lo_e = MeshLayout.from_mesh(mesh_e, expert_axis="expert")
+        assert lo_e.param_spec((4, 8, 16)) == P("expert", None, None)
+        # 4-D conv kernels must NOT match the expert rule
+        assert lo_e.param_spec((4, 8, 16, 2)) == P()
+
+    def test_from_mesh_typo_raises(self):
+        mesh = make_mesh(4)
+        with pytest.raises(ValueError, match="not in mesh axes"):
+            MeshLayout.from_mesh(mesh, model_axis="modle")
+
+    def test_dt008_validate_clean(self):
+        lo = MeshLayout(data=2, fsdp=2, devices=_devices())
+        net = MultiLayerNetwork(_conf()).init()
+        assert lo.validate(net.params) == []
+
+
+class TestPrecisionPolicy:
+    def test_bf16_leaves_actually_shard_and_loss_finite(self):
+        """The promoted §8 property: bf16 STORAGE leaves shard over fsdp,
+        training stays finite, moments follow the param's dtype + spec."""
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=2, fsdp=2, params_dtype="bfloat16",
+                        devices=_devices())
+        w = ParallelWrapper(net, layout=lo)
+        x, y = _data()
+        w.fit(DataSet(x, y))
+        W = net.params[0]["W"]
+        assert W.dtype == jnp.bfloat16
+        assert "fsdp" in str(W.sharding.spec)
+        assert jnp.isfinite(net._last_loss)
+        # moments follow their param: same storage dtype, same spec
+        mu_leaves = [l for l in jax.tree_util.tree_leaves(net.opt_state)
+                     if hasattr(l, "shape") and l.shape == W.shape]
+        assert mu_leaves and all(l.dtype == jnp.bfloat16 for l in mu_leaves)
+        assert all("fsdp" in str(l.sharding.spec) for l in mu_leaves)
+        # compute stays wide: serving output is not bf16
+        out = net.output(x[:8])
+        assert np.asarray(out).dtype != jnp.bfloat16
+
+    def test_policy_applies_to_already_initialized_net(self):
+        net = MultiLayerNetwork(_conf()).init()
+        assert net.params[0]["W"].dtype != jnp.bfloat16
+        MeshLayout(data=1, fsdp=4, params_dtype="bfloat16",
+                   devices=_devices()).apply(net)
+        assert net.params[0]["W"].dtype == jnp.bfloat16
+        assert net.conf.params_dtype == "bfloat16"
+
+
+class TestTrajectoriesAgree:
+    def test_dp_vs_fsdp_vs_tp(self):
+        """The same model + data under dp, dp×fsdp and dp×tp layouts must
+        follow the same optimization trajectory (GSPMD changes the
+        partitioning, not the math) within reduction-order tolerance."""
+        layouts = {
+            "dp": MeshLayout(data=4, devices=_devices()),
+            "dp_fsdp": MeshLayout(data=2, fsdp=2, devices=_devices()),
+            "dp_tp": MeshLayout(data=2, tp=2, devices=_devices()),
+        }
+        x, y = _data(n=32)
+        finals = {}
+        for name, lo in layouts.items():
+            net = MultiLayerNetwork(_conf(updater="sgd", lr=0.1)).init()
+            w = ParallelWrapper(net, layout=lo)
+            for _ in range(6):
+                w.fit(DataSet(x, y))
+            finals[name] = [np.asarray(l, np.float64)
+                            for l in jax.tree_util.tree_leaves(net.params)]
+        for name in ("dp_fsdp", "dp_tp"):
+            for a, b in zip(finals["dp"], finals[name]):
+                np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5,
+                                           err_msg=name)
+
+    def test_fsdp_bf16_converges(self):
+        net = MultiLayerNetwork(_conf(updater="sgd", lr=0.1)).init()
+        lo = MeshLayout(data=1, fsdp=4, params_dtype="bfloat16",
+                        devices=_devices())
+        w = ParallelWrapper(net, layout=lo)
+        x, y = _data(n=64)
+        s0 = float(net.score(DataSet(x, y)))
+        for _ in range(10):
+            w.fit(DataSet(x, y))
+        assert float(net.score(DataSet(x, y))) < s0
+
+
+class TestZeroWarmCompiles:
+    def test_sharded_fit_on_device_pays_zero_warm_compiles(self):
+        """PR 3 guarantee under sharding: after the warm-up dispatch, more
+        staged windows at the same shapes/shardings admit NO new programs
+        (step counts stay device scalars)."""
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            get_compile_manager,
+        )
+
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=2, fsdp=2, params_dtype="bfloat16",
+                        devices=_devices())
+        w = ParallelWrapper(net, layout=lo)
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(2, 16, 16)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, 16))]
+        cm = get_compile_manager()
+        w.fit_on_device(xs, ys, steps=4)  # warm-up: pays the compile
+        before = cm.compiles.value
+        l1 = w.fit_on_device(xs, ys, steps=4)
+        l2 = w.fit_on_device(xs, ys, steps=3)  # same pow2 cap bucket
+        assert cm.compiles.value - before == 0
+        assert np.all(np.isfinite(l1)) and np.all(np.isfinite(l2))
+
+    def test_signature_separates_shardings(self):
+        """Two placements of the same abstract shapes must NOT share an
+        executable: the canonical key carries the mesh sharding."""
+        from deeplearning4j_tpu.runtime.compile_manager import signature
+
+        lo = MeshLayout(data=1, fsdp=4, devices=_devices())
+        a_local = jnp.ones((8, 16))
+        a_mesh = jax.device_put(a_local, lo.sharding(P("fsdp", None)))
+        a_rep = jax.device_put(a_local, lo.replicated())
+        assert signature(a_mesh) != signature(a_rep)
+        assert signature(a_mesh) != signature(a_local)
+        # and SDS shells (warmup) keep matching local concrete arrays
+        shell = jax.ShapeDtypeStruct(a_local.shape, a_local.dtype)
+        assert signature(shell) == signature(jnp.asarray(a_local))
+
+
+class TestPreflightProvesFsdpFits:
+    """ISSUE 8 acceptance: a net whose param+grad+opt bytes exceed a
+    synthetic single-device limit raises unsharded and passes preflight —
+    then actually trains — under MeshLayout(fsdp=4)."""
+
+    def _big_net(self):
+        # hidden 512: params+grads+opt ≈ 4 × 1.1 MB ≈ 4.5 MiB (f64 under
+        # the suite's x64 mode doubles that) — comfortably over a 3 MiB
+        # synthetic limit, under it when fsdp-sharded 4 ways + bf16
+        return MultiLayerNetwork(_conf(hidden=512, features=256)).init()
+
+    def test_unsharded_raises_fsdp_passes_and_trains(self, monkeypatch):
+        from deeplearning4j_tpu.telemetry import MemoryPreflightError
+
+        monkeypatch.setenv("DL4JTPU_HBM_LIMIT_BYTES", str(3 << 20))
+        net = self._big_net()
+        with pytest.raises(MemoryPreflightError, match="exceeds"):
+            net.preflight(16)
+        lo = MeshLayout(data=1, fsdp=4, params_dtype="bfloat16",
+                        devices=_devices())
+        report = net.preflight(16, layout=lo)
+        assert report["preflight"]["checked"] and report["preflight"]["fits"]
+        assert report["preflight"]["per_device"]
+        pd = report["totals"]["per_device"]
+        assert pd["projected_peak_bytes"] < report["totals"][
+            "projected_peak_bytes"]
+        # the capability jump is real, not just projected: training works
+        w = ParallelWrapper(net, layout=lo)
+        x, y = _data(n=16, features=256)
+        w.fit(DataSet(x, y))
+        assert jnp.isfinite(net._last_loss)
+        assert "fsdp" in str(net.params[0]["W"].sharding.spec)
+
+
+class TestDT008Admission:
+    def test_cross_mesh_args_counted_at_admission(self):
+        """CompileManager.aot: args mixing two meshes yield a DT008 finding
+        (counter + flight) BEFORE lower() fails with a raw device error."""
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            CompileManager, signature,
+        )
+        from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+        cm = CompileManager(registry=MetricsRegistry())
+        mesh_a = make_mesh(4, axis_names=("data", "fsdp", "tp"),
+                           shape=(1, 4, 1))
+        devs_b = np.array(jax.devices()[4:8]).reshape(1, 4, 1)
+        from jax.sharding import Mesh
+
+        mesh_b = Mesh(devs_b, ("data", "fsdp", "tp"))
+        x = jax.ShapeDtypeStruct((8, 8), np.float32,
+                                 sharding=NamedSharding(mesh_a, P("fsdp")))
+        y = jax.ShapeDtypeStruct((8, 8), np.float32,
+                                 sharding=NamedSharding(mesh_b, P("fsdp")))
+        args = (x, y)
+        with pytest.raises(Exception):
+            cm.aot(("t", signature(args)),
+                   lambda: jax.jit(lambda a, b: a + b), args)
+        counted = cm.ir_findings.labels(rule="DT008").value
+        assert counted >= 1
+
+    def test_clean_sharded_admission_counts_nothing(self):
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            CompileManager, signature,
+        )
+        from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+        cm = CompileManager(registry=MetricsRegistry())
+        lo = MeshLayout(data=1, fsdp=4, devices=_devices())
+        x = jax.device_put(jnp.ones((8, 8)), lo.sharding(P("fsdp", None)))
+        compiled = cm.aot(("t", signature(x)),
+                          lambda: jax.jit(lambda a: a * 2), (x,))
+        assert compiled is not None
+        assert cm.ir_findings.labels(rule="DT008").value == 0
+
+
+class TestServingUnderLayout:
+    def test_register_with_layout_serves_and_reports(self):
+        from deeplearning4j_tpu.serving import InferenceService
+        from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=2, fsdp=2, params_dtype="bfloat16",
+                        devices=_devices())
+        svc = InferenceService(registry=MetricsRegistry(), max_delay_ms=1.0)
+        try:
+            svc.register("m", net, layout=lo)
+            assert layout_of(net) is lo
+            assert net.params[0]["W"].dtype == jnp.bfloat16
+            x, _ = _data(n=8)
+            out = svc.predict("m", x)
+            assert np.asarray(out).shape == (8, 4)
+            cls = svc.predict("m", x, argmax=True)
+            assert np.asarray(cls).shape == (8,)
+            st = svc.stats()["models"]["m"]
+            assert st["layout"]["axes"]["fsdp"] == 2
+            assert st["layout"]["precision"]["params_dtype"] == "bfloat16"
+        finally:
+            svc.stop()
+
+    def test_trained_net_keeps_placement_in_serving(self):
+        """Train under a layout, serve WITHOUT re-registering a layout: the
+        stamped placement carries over (train→serve, one sharding layer)."""
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=1, fsdp=4, devices=_devices())
+        w = ParallelWrapper(net, layout=lo)
+        x, y = _data(n=16)
+        w.fit(DataSet(x, y))
+        out = net.output(x[:4])
+        assert np.asarray(out).shape == (4, 4)
+        assert layout_of(net) is lo
+        pred = net.predict(x[:4])
+        assert np.asarray(pred).shape == (4,)
+
+
+class TestStrategyWrappers:
+    def test_wrapper_rejects_layout_plus_mesh(self):
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=4, devices=_devices())
+        with pytest.raises(ValueError, match="layout"):
+            ParallelWrapper(net, layout=lo, mesh=make_mesh(4))
+
+    def test_periodic_mode_rejects_sharded_layouts(self):
+        """The satellite bugfix: periodic averaging stacks UNSHARDED
+        replicas — a layout that declares fsdp/tp must refuse loudly
+        instead of silently dropping the sharding."""
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=1, fsdp=4, devices=_devices())
+        with pytest.raises(ValueError, match="sync mode"):
+            ParallelWrapper(net, layout=lo, averaging_frequency=2)
+
+    def test_periodic_mode_allows_pure_dp_layout(self):
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=4, devices=_devices())
+        w = ParallelWrapper(net, layout=lo, averaging_frequency=2)
+        x, y = _data(n=64)
+        # 8 minibatches = 2 replica groups -> one averaging boundary (the
+        # default report_score_after_averaging publishes the score there)
+        batches = [DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+                   for i in range(8)]
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+        w.fit(ListDataSetIterator(batches))
+        assert jnp.isfinite(net._last_loss)
+
+    def test_training_master_takes_layout(self):
+        from deeplearning4j_tpu.parallel import SyncAllReduceTrainingMaster
+
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=2, fsdp=2, devices=_devices())
+        master = SyncAllReduceTrainingMaster(layout=lo)
+        x, y = _data(n=32)
+        master.execute_training(net, DataSet(x, y))
+        assert jnp.isfinite(net._last_loss)
+        assert layout_of(net) is lo
+
+    def test_legacy_tree_shardings_delegate(self):
+        """sharding.tree_shardings now routes through MeshLayout — same
+        legacy rule results (last dim over model, 1-D divisible, expert)."""
+        from deeplearning4j_tpu.parallel.sharding import tree_shardings
+
+        mesh = make_mesh(4, axis_names=("data", "model"), shape=(2, 2))
+        tree = {"W": jnp.ones((6, 8)), "b": jnp.ones((8,)),
+                "odd": jnp.ones((7,)), "s": jnp.ones(())}
+        sh = tree_shardings(tree, mesh, model_axis="model")
+        assert sh["W"].spec == P(None, "model")
+        assert sh["b"].spec == P("model")
+        assert sh["odd"].spec == P()
+        assert sh["s"].spec == P()
