@@ -908,21 +908,33 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
     cm = get_compile_manager()
 
     def census(net, layout):
-        """Collective ops in the compiled per-step program — the measured
-        twin of the DT207 jaxpr census (GSPMD inserts these at partition
-        time, so only the post-SPMD HLO shows them). Compiled AFTER the
+        """Measured vs predicted collective census (ISSUE 9). Measured:
+        collective ops parsed out of the compiled per-step program's
+        post-SPMD HLO (kind, mesh axes from replica groups, per-device
+        payload bytes). Predicted: the static sharding-flow pass over the
+        SAME step's jaxpr — no devices touched. ``match`` holds them to
+        parity (same major kinds/axes, byte totals within 1.5x) — the
+        ground truth that keeps the static pass honest. Compiled AFTER the
         timed region; failures degrade to an error note."""
+        from deeplearning4j_tpu.analysis.shard_flow import (
+            check_network_shard_flow, compare_census, hlo_collective_census)
+
         try:
             x_d = layout.put(xs[0], layout.batch_sharding())
             y_d = layout.put(ys[0], layout.batch_sharding())
             step = net._build_train_step()
             hlo = step.lower(net.params, net.opt_state, net.state, x_d, y_d,
                              net._rng, None, None).compile().as_text()
-            ops = ("all-reduce", "all-gather", "reduce-scatter",
-                   "collective-permute", "all-to-all")
-            counts = {op: hlo.count(f"{op}(") + hlo.count(f"{op}-start(")
-                      for op in ops}
-            return {op: c for op, c in counts.items() if c}
+            measured = hlo_collective_census(hlo, layout)
+            flow = check_network_shard_flow(net, batch, layout)
+            predicted = flow["census"]
+            return {
+                "measured": measured,
+                "predicted": predicted,
+                "predicted_comm_bytes_per_step": flow["comm_bytes_per_step"],
+                "findings": [f.rule_id for f in flow["findings"]],
+                "match": compare_census(predicted, measured),
+            }
         except Exception as e:  # noqa: BLE001 - the metric line must survive
             return {"error": f"{type(e).__name__}: {e}"[:200]}
 

@@ -21,6 +21,16 @@ Two passes, run before anything compiles:
   (FLOPs/bytes/arithmetic intensity, predicted step time). Entry points:
   ``net.analyze_ir(batch)``, ``conf.analyze(ir=True)``, the CLI ``--ir``
   flag, and the compile manager's automatic admission scan.
+- **Sharding-flow pass** (`shard_flow`, DT3xx): static sharding
+  propagation of a ``MeshLayout``'s PartitionSpecs through the traced
+  step — predicts GSPMD's collective census (kind, mesh axes, per-device
+  payload) before anything compiles, flags implicit all-gathers /
+  reshards / oversized tp all-reduces / per-scan-step collectives, and
+  feeds the ``DL4JTPU_ICI_GBPS`` communication roofline term. Validated
+  against the measured post-SPMD census (``BENCH_MODEL=shard``). Entry
+  points: ``net.analyze_ir(batch, layout=...)``, ``preflight(layout=…)``,
+  CLI ``--ir --mesh data=8,fsdp=4,tp=2``, and admission for any program
+  compiled with mesh-sharded args.
 
 Each finding carries a rule id (``DT0xx``/``DT1xx``/``DT2xx``), severity,
 location and fix hint; rules live in a registry (`rules`) so later PRs add
@@ -41,13 +51,19 @@ from .graph_checks import (
     check_shardings,
 )
 from .ast_checks import check_source, check_file
-from .cost_model import jaxpr_cost, roofline_params, static_cost
+from .cost_model import apply_roofline, jaxpr_cost, roofline_params, static_cost
 from .ir_checks import (
     audit_donation,
     analyze_config_ir,
     check_jaxpr_ir,
     check_network_ir,
     check_padding_waste,
+)
+from .shard_flow import (
+    analyze_shard_flow,
+    check_network_shard_flow,
+    compare_census,
+    hlo_collective_census,
 )
 
 __all__ = [
@@ -69,10 +85,15 @@ __all__ = [
     "check_file",
     "jaxpr_cost",
     "roofline_params",
+    "apply_roofline",
     "static_cost",
     "audit_donation",
     "analyze_config_ir",
     "check_jaxpr_ir",
     "check_network_ir",
     "check_padding_waste",
+    "analyze_shard_flow",
+    "check_network_shard_flow",
+    "compare_census",
+    "hlo_collective_census",
 ]

@@ -45,8 +45,37 @@ def _iter_py_files(root: str):
                 yield os.path.join(dirpath, fn)
 
 
+def _parse_mesh(text: str):
+    """``--mesh data=2,fsdp=2,tp=1[,bf16][,zero1]`` -> an ABSTRACT
+    MeshLayout (no devices needed — the sharding-flow pass is pure spec
+    algebra, so a 64-chip layout analyzes fine from a laptop)."""
+    from ..parallel.layout import MeshLayout
+
+    sizes = {"data": 1, "fsdp": 1, "tp": 1}
+    params_dtype = None
+    zero_stage = 3
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part in ("bf16", "bfloat16"):
+            params_dtype = "bfloat16"
+        elif part in ("zero1", "zero-1"):
+            zero_stage = 1
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            if k.strip() not in sizes:
+                raise ValueError(f"unknown mesh axis {k.strip()!r} "
+                                 "(data/fsdp/tp)")
+            sizes[k.strip()] = int(v)
+        else:
+            raise ValueError(f"cannot parse mesh part {part!r}")
+    return MeshLayout.abstract(params_dtype=params_dtype,
+                               zero_stage=zero_stage, **sizes)
+
+
 def _analyze_json_config(path: str, batch: int, timesteps: int,
-                         ir: bool, costs: list) -> List[Finding]:
+                         ir: bool, costs: list, layout=None) -> List[Finding]:
     from .graph_checks import check_config
 
     with open(path, "r", encoding="utf-8") as fh:
@@ -61,7 +90,8 @@ def _analyze_json_config(path: str, batch: int, timesteps: int,
         conf = (ComputationGraphConfiguration.from_dict(d)
                 if "vertices" in d else MultiLayerConfiguration.from_dict(d))
         ir_findings, cost = analyze_config_ir(
-            conf, batch=batch, timesteps_probe=timesteps, source=path)
+            conf, batch=batch, timesteps_probe=timesteps, source=path,
+            layout=layout)
         findings += ir_findings
         costs.append({"source": path, **cost})
     return findings
@@ -97,6 +127,12 @@ def main(argv=None) -> int:
     ap.add_argument("--ir", action="store_true",
                     help="run the DT2xx jaxpr/IR pass + static cost model on "
                     "each .json config (traces the real train step)")
+    ap.add_argument("--mesh", default=None, metavar="SPEC",
+                    help="with --ir: run the DT3xx sharding-flow pass under "
+                    "an abstract dp x fsdp x tp layout, e.g. "
+                    "--mesh data=2,fsdp=4,tp=2,bf16,zero1 — predicts the "
+                    "collective census + communication roofline with no "
+                    "devices attached")
     ap.add_argument("--ignore", default="",
                     help="comma-separated rule ids to drop from the report "
                     "(e.g. DT204,DT206 — the suppression mechanism for IR "
@@ -116,6 +152,17 @@ def main(argv=None) -> int:
         print(f"error: --ignore names unknown rule(s): "
               f"{', '.join(sorted(unknown))}", file=sys.stderr)
         return 2
+    layout = None
+    if args.mesh:
+        if not args.ir:
+            print("error: --mesh requires --ir (the sharding-flow pass "
+                  "runs on the traced step)", file=sys.stderr)
+            return 2
+        try:
+            layout = _parse_mesh(args.mesh)
+        except (ValueError, TypeError) as e:
+            print(f"error: bad --mesh spec: {e}", file=sys.stderr)
+            return 2
 
     findings: List[Finding] = []
     costs: list = []
@@ -129,7 +176,7 @@ def main(argv=None) -> int:
             try:
                 findings += _analyze_json_config(path, args.batch,
                                                  args.timesteps, args.ir,
-                                                 costs)
+                                                 costs, layout=layout)
             except Exception as e:
                 print(f"error: could not analyze config {path}: {e}",
                       file=sys.stderr)
@@ -165,6 +212,13 @@ def main(argv=None) -> int:
                   f"AI {cost['arithmetic_intensity']:.2f} FLOPs/byte, "
                   f"predicted {rl['predicted_step_seconds']:.3g}s/step "
                   f"({rl['bound']}-bound)")
+            flow = cost.get("shard_flow")
+            if flow:
+                rows = ", ".join(
+                    f"{r['kind']}[{','.join(r['axes'])}]x{r['count']}"
+                    f"={r['bytes']:,}B" for r in flow["census"]) or "none"
+                print(f"{cost['source']}: predicted collectives/step: {rows} "
+                      f"({flow['comm_bytes_per_step']:,} bytes over ICI)")
         print(f"{len(findings)} finding(s) ({counts['error']} error, "
               f"{counts['warning']} warning, {counts['info']} info) "
               f"across {n_files} file(s)")

@@ -30,8 +30,14 @@ Counting conventions (deliberately simple, deliberately stated):
 Collectives (``psum``/``all_gather``/``ppermute``/...) are tallied
 separately — count and payload bytes per step — feeding the DT207 check.
 
-Roofline knobs: ``DL4JTPU_PEAK_FLOPS`` (peak FLOP/s) and ``DL4JTPU_HBM_GBPS``
-(HBM GB/s); defaults model one TPU v4 core (275 Tf/s bf16, 1228 GB/s).
+Roofline knobs: ``DL4JTPU_PEAK_FLOPS`` (peak FLOP/s), ``DL4JTPU_HBM_GBPS``
+(HBM GB/s) and ``DL4JTPU_ICI_GBPS`` (interconnect GB/s per chip); defaults
+model one TPU v4 core (275 Tf/s bf16, 1228 GB/s HBM, 300 GB/s aggregate
+ICI). The interconnect term makes ``predicted_step_seconds`` cover
+compute-, memory- AND communication-bound steps: the per-step collective
+bytes (the jaxpr census here, plus the sharding-flow predicted census when
+a layout is analyzed — see ``analysis/shard_flow.py``) divide by the ICI
+bandwidth, and ``bound`` reports which of the three ceilings wins.
 """
 
 from __future__ import annotations
@@ -42,7 +48,9 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "PEAK_FLOPS_ENV",
     "HBM_GBPS_ENV",
+    "ICI_GBPS_ENV",
     "roofline_params",
+    "apply_roofline",
     "jaxpr_cost",
     "static_cost",
     "subjaxprs",
@@ -50,8 +58,10 @@ __all__ = [
 
 PEAK_FLOPS_ENV = "DL4JTPU_PEAK_FLOPS"
 HBM_GBPS_ENV = "DL4JTPU_HBM_GBPS"
+ICI_GBPS_ENV = "DL4JTPU_ICI_GBPS"
 DEFAULT_PEAK_FLOPS = 2.75e14  # one TPU v4 core, bf16 MXU
 DEFAULT_HBM_GBPS = 1228.0  # TPU v4 HBM2 bandwidth
+DEFAULT_ICI_GBPS = 300.0  # TPU v4 aggregate ICI per chip (6 links)
 
 # pure data movement: 0 FLOPs, bytes only
 _ZERO_FLOP = frozenset({
@@ -74,6 +84,17 @@ _COLLECTIVES = frozenset({
     "reduce_scatter", "psum_scatter", "pbroadcast",
 })
 
+# jaxpr primitive -> census kind: the DT207 census keys (kind, axes) the
+# same way the measured post-SPMD census and the sharding-flow predicted
+# census do (analysis/shard_flow.py)
+_COLLECTIVE_KINDS = {
+    "psum": "all_reduce", "pmax": "all_reduce", "pmin": "all_reduce",
+    "pmean": "all_reduce", "pbroadcast": "all_reduce",
+    "all_gather": "all_gather", "all_to_all": "all_to_all",
+    "ppermute": "collective_permute",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+}
+
 
 def roofline_params() -> dict:
     """The configured roofline: peak FLOP/s, HBM GB/s, and the ridge point
@@ -89,9 +110,11 @@ def roofline_params() -> dict:
 
     peak = _env_float(PEAK_FLOPS_ENV, DEFAULT_PEAK_FLOPS)
     gbps = _env_float(HBM_GBPS_ENV, DEFAULT_HBM_GBPS)
+    ici = _env_float(ICI_GBPS_ENV, DEFAULT_ICI_GBPS)
     return {
         "peak_flops": peak,
         "hbm_gbps": gbps,
+        "ici_gbps": ici,
         "ridge_flops_per_byte": peak / (gbps * 1e9),
     }
 
@@ -228,7 +251,8 @@ def jaxpr_cost(closed_jaxpr) -> dict:
     acc = {
         "flops": 0, "hbm_bytes": 0, "eqns": 0, "dynamic_loop": False,
         "by_primitive": {},
-        "collectives": {"count": 0, "bytes": 0, "by_primitive": {}},
+        "collectives": {"count": 0, "bytes": 0, "by_primitive": {},
+                        "census": {}},
     }
 
     def walk(closed, mult: int) -> Tuple[int, int]:
@@ -269,10 +293,27 @@ def jaxpr_cost(closed_jaxpr) -> dict:
                 payload = mult * sum(_aval_bytes(v.aval) for v in eqn.invars)
                 acc["collectives"]["count"] += mult
                 acc["collectives"]["bytes"] += payload
+                # mesh-axis labels: psum/all_gather/... carry the named axes
+                # they span, so the jaxpr census keys exactly like the
+                # measured post-SPMD census ((kind, axes) — see
+                # analysis/shard_flow.hlo_collective_census)
+                axes = eqn.params.get("axes") or eqn.params.get(
+                    "axis_name") or ()
+                if not isinstance(axes, (tuple, list)):
+                    axes = (axes,)
+                axes = tuple(sorted(str(a) for a in axes))
                 crow = acc["collectives"]["by_primitive"].setdefault(
-                    name, {"count": 0, "bytes": 0})
+                    name, {"count": 0, "bytes": 0, "axes": []})
                 crow["count"] += mult
                 crow["bytes"] += payload
+                for a in axes:
+                    if a not in crow["axes"]:
+                        crow["axes"].append(a)
+                cens = acc["collectives"]["census"].setdefault(
+                    (_COLLECTIVE_KINDS.get(name, name), axes),
+                    {"count": 0, "bytes": 0})
+                cens["count"] += mult
+                cens["bytes"] += payload
         return flops_here, bytes_here
 
     flops, nbytes = walk(closed_jaxpr, 1)
@@ -280,16 +321,42 @@ def jaxpr_cost(closed_jaxpr) -> dict:
     acc["hbm_bytes"] = int(nbytes)
     acc["arithmetic_intensity"] = (
         flops / nbytes if nbytes else 0.0)
+    # census rows in list form (tuple keys don't survive JSON)
+    acc["collectives"]["census"] = [
+        {"kind": k, "axes": list(axes), "count": row["count"],
+         "bytes": row["bytes"]}
+        for (k, axes), row in sorted(acc["collectives"]["census"].items())]
+    apply_roofline(acc, comm_bytes=acc["collectives"]["bytes"])
+    return acc
+
+
+def apply_roofline(cost: dict, *, comm_bytes: Optional[int] = None) -> dict:
+    """(Re)compute ``cost["roofline"]`` from its flops/bytes and a per-step
+    communication volume. ``comm_bytes`` defaults to the jaxpr-level
+    collective tally; the sharding-flow pass calls this again with its
+    predicted census total, so ``predicted_step_seconds`` covers the
+    communication-bound regime and ``bound`` can come back
+    ``"communication"``."""
+    flops = cost.get("flops", 0)
+    nbytes = cost.get("hbm_bytes", 0)
+    if comm_bytes is None:
+        comm_bytes = int(cost.get("collectives", {}).get("bytes", 0))
     rl = roofline_params()
     compute_s = flops / rl["peak_flops"] if rl["peak_flops"] else 0.0
     memory_s = (nbytes / (rl["hbm_gbps"] * 1e9)) if rl["hbm_gbps"] else 0.0
-    rl["predicted_step_seconds"] = max(compute_s, memory_s)
+    comm_s = (comm_bytes / (rl["ici_gbps"] * 1e9)) if rl["ici_gbps"] else 0.0
+    rl["predicted_step_seconds"] = max(compute_s, memory_s, comm_s)
     rl["compute_seconds"] = compute_s
     rl["memory_seconds"] = memory_s
-    rl["bound"] = ("compute" if acc["arithmetic_intensity"]
-                   >= rl["ridge_flops_per_byte"] else "memory")
-    acc["roofline"] = rl
-    return acc
+    rl["communication_seconds"] = comm_s
+    rl["communication_bytes"] = int(comm_bytes)
+    if comm_s > max(compute_s, memory_s):
+        rl["bound"] = "communication"
+    else:
+        rl["bound"] = ("compute" if cost.get("arithmetic_intensity", 0.0)
+                       >= rl["ridge_flops_per_byte"] else "memory")
+    cost["roofline"] = rl
+    return cost
 
 
 def static_cost(fn, *example_args, **make_jaxpr_kw) -> dict:

@@ -290,8 +290,15 @@ def check_jaxpr_ir(closed_jaxpr, *, source: str = IR_SOURCE,
             file=source, context="roofline"))
     col = cost["collectives"]
     if col["count"]:
-        parts = ", ".join(f"{n}×{r['count']}"
-                          for n, r in sorted(col["by_primitive"].items()))
+        # census rows carry mesh-axis labels, so the message (and the
+        # machine-readable census) key exactly like the measured post-SPMD
+        # census: (kind, axes) -> count/bytes
+        rows = col.get("census") or [
+            {"kind": n, "axes": r.get("axes", []), "count": r["count"]}
+            for n, r in sorted(col["by_primitive"].items())]
+        parts = ", ".join(
+            f"{r['kind']}[{','.join(r['axes']) or '?'}]×{r['count']}"
+            for r in rows)
         findings.append(get_rule("DT207").finding(
             f"{col['count']} collective eqn(s) per optimizer step ({parts}), "
             f"~{_fmt_bytes(col['bytes'])} moved per step",
@@ -418,6 +425,7 @@ def _label_structs(net, batch: int, timesteps_probe: int):
 def check_network_ir(net, batch_or_struct=None, *,
                      ignore: Iterable[str] = (),
                      timesteps_probe: Optional[int] = None,
+                     layout=None,
                      source: str = IR_SOURCE) -> dict:
     """The DT2xx pass + static cost model over a net's real train step.
 
@@ -429,6 +437,14 @@ def check_network_ir(net, batch_or_struct=None, *,
     Returns ``{"findings": [...], "static_cost": {...}}``. The donation
     audit always checks the TPU contract (``donate_argnums=(0, 1, 2)``)
     even on backends where the fit path skips donation.
+
+    ``layout``: a :class:`~deeplearning4j_tpu.parallel.MeshLayout` — adds
+    the DT3xx sharding-flow pass (``analysis/shard_flow.py``): the report
+    gains a ``"shard_flow"`` block (predicted collective census, per-step
+    communication bytes), the DT300-DT305 findings join the list, and the
+    roofline's interconnect term (``DL4JTPU_ICI_GBPS``) is fed the
+    predicted census so ``predicted_step_seconds`` covers the
+    communication-bound regime.
     """
     import jax  # noqa: PLC0415
 
@@ -458,18 +474,33 @@ def check_network_ir(net, batch_or_struct=None, *,
     findings = check_jaxpr_ir(closed, source=source, cost=cost)
     findings += audit_donation(inner, args, donate_argnums=(0, 1, 2),
                                source=source, context="train_step donation")
+    report = {"static_cost": cost}
+    if layout is not None:
+        from .cost_model import apply_roofline  # noqa: PLC0415
+        from .shard_flow import check_network_shard_flow  # noqa: PLC0415
+
+        flow = check_network_shard_flow(
+            net, batch_or_struct, layout, timesteps_probe=timesteps_probe,
+            source=source)
+        findings += flow.pop("findings")
+        report["shard_flow"] = flow
+        apply_roofline(cost, comm_bytes=cost["collectives"]["bytes"]
+                       + flow["comm_bytes_per_step"])
     ignore = frozenset(ignore)
     findings = [f for f in findings if f.rule_id not in ignore]
-    return {"findings": merge_findings(findings), "static_cost": cost}
+    report["findings"] = merge_findings(findings)
+    return report
 
 
 def analyze_config_ir(conf, *, batch: int = 4,
                       timesteps_probe: Optional[int] = None,
-                      source: str = IR_SOURCE,
+                      source: str = IR_SOURCE, layout=None,
                       ignore: Iterable[str] = ()) -> Tuple[List[Finding], dict]:
     """Headless DT2xx entry for a config (the CLI ``--ir`` path): builds the
     matching network class, initializes it, and runs
-    :func:`check_network_ir`. Returns ``(findings, static_cost)``."""
+    :func:`check_network_ir`. Returns ``(findings, static_cost)`` — with
+    ``layout`` (e.g. the CLI ``--mesh`` flag's abstract MeshLayout) the
+    static_cost carries the DT3xx ``shard_flow`` census block too."""
     if hasattr(conf, "vertices"):
         from ..nn.graph import ComputationGraph  # noqa: PLC0415
 
@@ -479,8 +510,14 @@ def analyze_config_ir(conf, *, batch: int = 4,
 
         net = MultiLayerNetwork(conf)
     report = check_network_ir(net, batch, timesteps_probe=timesteps_probe,
-                              source=source, ignore=ignore)
-    return report["findings"], report["static_cost"]
+                              source=source, ignore=ignore, layout=layout)
+    cost = report["static_cost"]
+    if "shard_flow" in report:
+        cost = dict(cost)
+        cost["shard_flow"] = {
+            k: v for k, v in report["shard_flow"].items()
+            if k in ("census", "comm_bytes_per_step", "layout")}
+    return report["findings"], cost
 
 
 # ------------------------------------------------------------ padding waste
@@ -555,6 +592,47 @@ def admission_check(jitted, compiled, args, *, kind: str = "aot") -> Tuple[
     cost = jaxpr_cost(closed)
     source = f"<ir:{kind}>"
     findings = check_jaxpr_ir(closed, source=source, cost=cost)
+
+    # DT3xx sharding-flow at admission: when the program is compiled with
+    # mesh-sharded arguments, propagate those ACTUAL shardings through the
+    # jaxpr and predict the collective census before lower() runs. Invars
+    # are spec-indistinguishable here (a ZeRO param shard and a batch shard
+    # both read P('fsdp')), so invar gathers are treated as the documented
+    # param cost and never fire DT300/DT303 — net.analyze_ir(layout=...)
+    # is the precise entry. Failures degrade silently: analysis must never
+    # break compilation.
+    try:
+        flat, _ = jax.tree_util.tree_flatten(args)
+        mesh = None
+        specs = []
+        flags = []
+        for leaf in flat:
+            sh = getattr(leaf, "sharding", None)
+            if type(sh).__name__ == "NamedSharding" \
+                    and sh.mesh.devices.size > 1:
+                mesh = mesh or sh.mesh
+                specs.append(sh.spec)
+                flags.append(True)
+            else:
+                specs.append(None)
+                flags.append(False)
+        if mesh is not None:
+            from ..parallel.layout import MeshLayout  # noqa: PLC0415
+            from .cost_model import apply_roofline  # noqa: PLC0415
+            from .shard_flow import (  # noqa: PLC0415
+                flow_report, propagate_jaxpr, shard_findings)
+
+            tp = ("tp" if "tp" in mesh.shape and mesh.shape["tp"] > 1
+                  else None)
+            layout = MeshLayout.from_mesh(mesh, model_axis=tp)
+            flow = propagate_jaxpr(closed, specs, layout, param_flags=flags)
+            findings += shard_findings(flow, source=source)
+            cost["shard_flow"] = flow_report(flow)
+            apply_roofline(
+                cost, comm_bytes=cost["collectives"]["bytes"]
+                + cost["shard_flow"]["comm_bytes_per_step"])
+    except Exception:
+        pass
 
     # DT202 at admission: the pjit eqn records the donation actually
     # requested; a requested donation with ZERO aliased bytes in the

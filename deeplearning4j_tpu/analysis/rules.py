@@ -279,6 +279,77 @@ register_rule(Rule(
     "per dispatch (fit_on_device), remat instead of materializing. Tune "
     "the roofline via DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS.",
 ))
+# ------------------------------------------------------ sharding-flow rules
+# Pass 4 (analysis/shard_flow.py): static sharding propagation over the
+# traced jaxpr, seeded with a MeshLayout's PartitionSpecs. Predicts the
+# collectives GSPMD will insert BEFORE anything compiles; findings carry no
+# source line (suppress via ignore=/--ignore like the DT2xx family). The
+# predicted census is validated against the measured post-SPMD HLO census
+# (BENCH_MODEL=shard, tests/test_shard_flow.py).
+register_rule(Rule(
+    "DT300", "implicit full all-gather of a sharded tensor", "warning", "ir",
+    "Sharding propagation predicts GSPMD will materialize the FULL tensor "
+    "from a sharded one (an activation gathered at a dot whose contraction "
+    "dim it shards, a reshape/slice that breaks the sharded dim, ...): the "
+    "per-device HBM saving the spec promised is silently gone for that "
+    "tensor, and the gather bytes move over ICI every step.",
+    "Re-spec the producing layer so consumer and producer agree (shard a "
+    "kept dim, not the contraction dim), or add an explicit "
+    "lax.with_sharding_constraint at the site; the ZeRO param all-gather "
+    "under fsdp is expected and exempt.",
+))
+register_rule(Rule(
+    "DT301", "producer/consumer sharding reshard", "warning", "ir",
+    "Two operands of one eqn arrive with incompatible shardings (the same "
+    "mesh axis on different dims): GSPMD inserts a resharding transfer of "
+    "the smaller operand between producer and consumer, every step.",
+    "Emit both tensors under ONE layout rule (parallel.MeshLayout) instead "
+    "of hand-placing them; inside jit, align specs with "
+    "lax.with_sharding_constraint at the producer.",
+))
+register_rule(Rule(
+    "DT302", "oversized contraction all-reduce", "warning", "ir",
+    "A contraction over a non-batch-axis-sharded dim (tensor-parallel "
+    "matmul) all-reduces an ACTIVATION-sized payload every step — larger "
+    "than any gradient sync, and it scales with batch x features, not with "
+    "the model. This is the Megatron lesson: tp layouts live or die on "
+    "which activations get all-reduced.",
+    "Pair column-parallel with row-parallel projections so only one "
+    "all-reduce survives per block, shard the other operand's kept dim, or "
+    "drop tp for this layer (fsdp alone avoids activation collectives).",
+))
+register_rule(Rule(
+    "DT303", "batch axis dropped — compute replicated", "warning", "ir",
+    "Propagation predicts the batch axis is gathered off an activation "
+    "(a reshape merging batch into features, a spec conflict resolved "
+    "against the batch dim): everything downstream runs identically on "
+    "every device — the parallel speedup silently becomes 1x.",
+    "Keep the batch dim major through reshapes (reshape (B,T,F)->(B*T,F) "
+    "keeps it; (T,B,F)->(T*B,F) does not), and check the layout's "
+    "batch_sharding() reaches the loss.",
+))
+register_rule(Rule(
+    "DT304", "per-step collective inside scan", "warning", "ir",
+    "A collective sits inside a scan body, so it runs once per TIME STEP, "
+    "not once per optimizer step: the payload multiplies by the trip count "
+    "(T x per step), and each one is a latency-bound small transfer — the "
+    "worst shape for ICI.",
+    "Hoist the resharding out of the loop (gather/reshard once before the "
+    "scan), make the offending operand a loop-invariant const, or re-spec "
+    "so the carry stays sharded the same way the body produces it.",
+))
+register_rule(Rule(
+    "DT305", "head-aware tp spec would eliminate this collective", "info",
+    "ir",
+    "The layout shards attention/LSTM-gate kernels over their flat last dim "
+    "(the generic tp rule), splitting heads/gates across devices: the "
+    "predicted census shows per-step tp collectives on those activations "
+    "that a head-aware spec (whole heads/gates per device) would not need.",
+    "Shard the head dim (reshape kernels to [in, heads, d_head] and spec "
+    "P(None, 'tp', None)) or gate dim for LSTM kernels, so each device "
+    "computes whole heads locally — the ROADMAP 'head-aware tp specs' item.",
+))
+
 register_rule(Rule(
     "DT207", "per-step collective volume", "info", "ir",
     "The step contains cross-device collectives (psum/all_gather/"

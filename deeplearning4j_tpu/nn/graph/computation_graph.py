@@ -157,8 +157,10 @@ class ComputationGraph:
         """DT2xx IR lint + static roofline cost model over this graph's real
         train step — ``jax.make_jaxpr`` over ShapeDtypeStruct shells, zero
         device dispatches. Returns ``{"findings": [...], "static_cost":
-        {...}}``; suppress rules with ``ignore=("DT204", ...)``. See
-        docs/static_analysis.md (DT2xx) and docs/performance.md (roofline).
+        {...}}``; suppress rules with ``ignore=("DT204", ...)``. With
+        ``layout=MeshLayout(...)`` the DT3xx sharding-flow pass joins in
+        (predicted collective census + communication roofline). See
+        docs/static_analysis.md (DT2xx/DT3xx) and docs/distributed.md.
         """
         from ...analysis.ir_checks import check_network_ir
 

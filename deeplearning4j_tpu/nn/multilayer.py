@@ -253,8 +253,12 @@ class MultiLayerNetwork:
         """DT2xx IR lint + static roofline cost model over this net's real
         train step — ``jax.make_jaxpr`` over ShapeDtypeStruct shells, zero
         device dispatches. Returns ``{"findings": [...], "static_cost":
-        {...}}``; suppress rules with ``ignore=("DT204", ...)``. See
-        docs/static_analysis.md (DT2xx) and docs/performance.md (roofline).
+        {...}}``; suppress rules with ``ignore=("DT204", ...)``. With
+        ``layout=MeshLayout(...)`` the DT3xx sharding-flow pass joins in:
+        the report gains ``"shard_flow"`` (predicted collective census,
+        per-step ICI bytes) and the roofline covers communication-bound.
+        See docs/static_analysis.md (DT2xx/DT3xx), docs/performance.md
+        (roofline) and docs/distributed.md (predicting your collectives).
         """
         from ..analysis.ir_checks import check_network_ir
 

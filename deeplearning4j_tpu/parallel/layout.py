@@ -101,7 +101,7 @@ class MeshLayout:
 
     def __init__(self, data: Optional[int] = None, fsdp: int = 1, tp: int = 1,
                  *, devices: Optional[Sequence] = None,
-                 params_dtype: Optional[str] = None):
+                 params_dtype: Optional[str] = None, zero_stage: int = 3):
         import jax
         from jax.sharding import Mesh
 
@@ -119,17 +119,45 @@ class MeshLayout:
                 f"devices, have {len(devs)}")
         arr = np.array(devs[:need]).reshape(data, fsdp, tp)
         self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp"))
-        self._batch_axes = tuple(
-            a for a in ("data", "fsdp") if self.mesh.shape[a] > 1)
-        self._fsdp_axis = "fsdp" if fsdp > 1 else None
-        self._tp_axis = "tp" if tp > 1 else None
-        self._expert_axis = None
+        self._init_axes({"data": data, "fsdp": fsdp, "tp": tp},
+                        params_dtype=params_dtype, zero_stage=zero_stage)
+
+    def _init_axes(self, sizes: dict, *, params_dtype: Optional[str],
+                   zero_stage: int, canonical: bool = True,
+                   model_axis: Optional[str] = None,
+                   expert_axis: Optional[str] = None) -> None:
+        if int(zero_stage) not in (1, 3):
+            raise ValueError(
+                f"zero_stage must be 1 (moments-only fsdp sharding) or 3 "
+                f"(params+grads+moments), got {zero_stage}")
+        self._axis_sizes = {str(a): int(s) for a, s in sizes.items()}
+        if canonical:
+            # the canonical dp x fsdp x tp mesh: size-1 axes collapse out
+            self._batch_axes = tuple(
+                a for a in ("data", "fsdp") if self._axis_sizes.get(a, 1) > 1)
+            self._fsdp_axis = "fsdp" if self._axis_sizes.get("fsdp", 1) > 1 \
+                else None
+            self._tp_axis = "tp" if self._axis_sizes.get("tp", 1) > 1 else None
+            self._expert_axis = None
+        else:
+            # legacy from_mesh semantics: every non-model/expert axis is a
+            # batch axis, size-1 included (spec spellings feed cache keys)
+            self._batch_axes = tuple(
+                a for a in self._axis_sizes
+                if a not in (model_axis, expert_axis))
+            self._fsdp_axis = "fsdp" if (
+                self._axis_sizes.get("fsdp", 1) > 1
+                and "fsdp" not in (model_axis, expert_axis)) else None
+            self._tp_axis = model_axis
+            self._expert_axis = expert_axis
+        self.zero_stage = int(zero_stage)
         self.precision = PrecisionPolicy(params_dtype=params_dtype)
 
     @classmethod
     def from_mesh(cls, mesh, model_axis: Optional[str] = None,
                   expert_axis: Optional[str] = None,
-                  params_dtype: Optional[str] = None) -> "MeshLayout":
+                  params_dtype: Optional[str] = None,
+                  zero_stage: int = 3) -> "MeshLayout":
         """Wrap an existing mesh (the legacy ParallelWrapper construction
         path): ``model_axis`` plays the tp role, ``expert_axis`` enables the
         MoE expert-stacked rule, every other axis is a batch axis. A named
@@ -142,23 +170,34 @@ class MeshLayout:
                 raise ValueError(
                     f"{label} '{ax}' not in mesh axes {tuple(mesh.shape)}")
         self.mesh = mesh
-        self._batch_axes = tuple(
-            a for a in mesh.axis_names if a not in (model_axis, expert_axis))
-        self._fsdp_axis = "fsdp" if (
-            "fsdp" in mesh.shape and mesh.shape["fsdp"] > 1
-            and "fsdp" not in (model_axis, expert_axis)) else None
-        self._tp_axis = model_axis
-        self._expert_axis = expert_axis
-        self.precision = PrecisionPolicy(params_dtype=params_dtype)
+        self._init_axes(dict(mesh.shape), params_dtype=params_dtype,
+                        zero_stage=zero_stage, canonical=False,
+                        model_axis=model_axis, expert_axis=expert_axis)
+        return self
+
+    @classmethod
+    def abstract(cls, data: int = 1, fsdp: int = 1, tp: int = 1, *,
+                 params_dtype: Optional[str] = None,
+                 zero_stage: int = 3) -> "MeshLayout":
+        """A device-less layout: pure spec algebra (``param_spec``,
+        ``batch_spec``, the sharding-flow pass) with NO jax mesh behind it —
+        the CLI ``--mesh`` flag analyzes a 64-chip layout from a laptop.
+        Methods that place real data (``sharding``/``put``/``apply``)
+        raise."""
+        self = cls.__new__(cls)
+        self.mesh = None
+        self._init_axes({"data": int(data), "fsdp": int(fsdp),
+                         "tp": int(tp)},
+                        params_dtype=params_dtype, zero_stage=zero_stage)
         return self
 
     # ------------------------------------------------------------ geometry
     @property
     def axis_sizes(self) -> dict:
-        return {str(a): int(s) for a, s in self.mesh.shape.items()}
+        return dict(self._axis_sizes)
 
     def _size(self, axis: Optional[str]) -> int:
-        return int(self.mesh.shape[axis]) if axis is not None else 1
+        return int(self._axis_sizes.get(axis, 1)) if axis is not None else 1
 
     @property
     def batch_axes(self) -> Tuple[str, ...]:
@@ -172,6 +211,9 @@ class MeshLayout:
 
     @property
     def num_devices(self) -> int:
+        if self.mesh is None:  # abstract layout: the sizes ARE the geometry
+            return int(np.prod(list(self._axis_sizes.values()),
+                               dtype=np.int64))
         return int(self.mesh.devices.size)
 
     # ---------------------------------------------------------------- specs
@@ -188,7 +230,7 @@ class MeshLayout:
         return P(None, self._batch_axes) if self._batch_axes else P()
 
     def param_spec(self, shape) -> "Any":
-        """The fsdp/tp/expert rule set for one parameter (or moment) shape:
+        """The fsdp/tp/expert rule set for one parameter shape:
 
         - exactly-3-D leaves whose dim 0 divides an expert axis (MoE
           expert-stacked ``[E, F, H]``) shard dim 0 over it;
@@ -200,13 +242,29 @@ class MeshLayout:
           declared specs: zero warm recompiles), else over ``tp`` when
           divisible (legacy parity);
         - everything else replicates.
+
+        Under ``zero_stage=1`` params (and so grads) skip the fsdp rule and
+        stay replicated over the fsdp axis — only optimizer moments shard
+        (:meth:`opt_spec`): the cheaper default for small meshes where the
+        per-step ZeRO param all-gather costs more than it saves.
         """
+        return self._shape_spec(
+            shape, with_fsdp=(self.zero_stage >= 3))
+
+    def opt_spec(self, shape) -> "Any":
+        """Spec for one optimizer-moment leaf: the FULL fsdp/tp rule at
+        every zero stage — ZeRO-1 shards the moments even while params
+        replicate (that is its entire point: Adam moments are 2x param
+        bytes and nothing in the step needs them gathered)."""
+        return self._shape_spec(shape, with_fsdp=True)
+
+    def _shape_spec(self, shape, *, with_fsdp: bool) -> "Any":
         from jax.sharding import PartitionSpec as P
 
         shape = tuple(int(s) for s in shape)
         esize = self._size(self._expert_axis)
         tsize = self._size(self._tp_axis)
-        fsize = self._size(self._fsdp_axis)
+        fsize = self._size(self._fsdp_axis) if with_fsdp else 1
         if (self._expert_axis and len(shape) == 3 and esize > 1
                 and shape[0] % esize == 0 and shape[0] >= esize):
             return P(self._expert_axis, *([None] * (len(shape) - 1)))
@@ -234,6 +292,11 @@ class MeshLayout:
     def sharding(self, spec):
         from jax.sharding import NamedSharding
 
+        if self.mesh is None:
+            raise RuntimeError(
+                "this MeshLayout is abstract (MeshLayout.abstract): it can "
+                "compute specs and run the sharding-flow analysis but has "
+                "no devices to build a NamedSharding on")
         return NamedSharding(self.mesh, spec)
 
     def replicated(self):
@@ -260,8 +323,7 @@ class MeshLayout:
 
     def param_specs(self, tree):
         """PartitionSpec pytree for params — or any shape-mirroring tree
-        (optimizer moments land on their param's spec by the shape rule;
-        scalar bookkeeping replicates)."""
+        (scalar bookkeeping replicates)."""
         import jax
 
         return jax.tree_util.tree_map(
@@ -272,6 +334,21 @@ class MeshLayout:
 
         return jax.tree_util.tree_map(
             lambda a: self.sharding(self.param_spec(np.shape(a))), tree)
+
+    def opt_specs(self, tree):
+        """PartitionSpec pytree for optimizer state (moments follow their
+        param's shape rule at zero_stage=3; ZeRO-1 shards them over fsdp
+        while the params replicate)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: self.opt_spec(np.shape(a)), tree)
+
+    def opt_shardings(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: self.sharding(self.opt_spec(np.shape(a))), tree)
 
     # -------------------------------------------------------------- devices
     def put(self, arr, sharding=None):
@@ -291,6 +368,17 @@ class MeshLayout:
         return jax.tree_util.tree_map(
             lambda a: global_put(a, self.sharding(
                 self.param_spec(np.shape(a)))), tree)
+
+    def put_opt_state(self, tree):
+        """device_put optimizer state on its moment specs (= param specs at
+        zero_stage=3; fsdp-sharded even under ZeRO-1)."""
+        import jax
+
+        from .mesh import global_put
+
+        return jax.tree_util.tree_map(
+            lambda a: global_put(a, self.sharding(
+                self.opt_spec(np.shape(a)))), tree)
 
     def put_replicated(self, tree):
         import jax
@@ -312,7 +400,7 @@ class MeshLayout:
         self.precision.apply_to_net(net)
         net.params = self.put_params(net.params)
         if net.opt_state is not None:
-            net.opt_state = self.put_params(net.opt_state)
+            net.opt_state = self.put_opt_state(net.opt_state)
         if jax.tree_util.tree_leaves(net.state):
             net.state = self.put_replicated(net.state)
         net._mesh_layout = self
@@ -337,7 +425,8 @@ class MeshLayout:
         return check_partition_specs(specs, self.mesh, params, source=source)
 
     # ------------------------------------------------------- fsdp HBM math
-    def _leaf_bytes(self, leaf, *, storage: bool, sharded: bool) -> float:
+    def _leaf_bytes(self, leaf, *, storage: bool, sharded: bool,
+                    spec_fn=None) -> float:
         import jax.numpy as jnp
 
         shape = getattr(leaf, "shape", None)
@@ -351,30 +440,55 @@ class MeshLayout:
         if not sharded:
             return n
         factor = 1
-        for entry in tuple(self.param_spec(shape)):
+        for entry in tuple((spec_fn or self.param_spec)(shape)):
             if entry is None:
                 continue
             for ax in (entry if isinstance(entry, (tuple, list)) else (entry,)):
                 factor *= self._size(ax)
         return n / factor
 
-    def sharded_totals(self, net, report: dict) -> dict:
+    def _activation_factor(self, shape, activation_factors=None) -> int:
+        """Shard factor of one activation shape: the propagated spec from
+        the sharding-flow pass when available (tp-sharded hidden dims count
+        — the PR 9 preflight bugfix), else the batch factor."""
+        shape = tuple(int(s) for s in shape or ())
+        if activation_factors:
+            f = activation_factors.get(shape)
+            if f:
+                return int(f)
+        return self.batch_factor
+
+    def sharded_totals(self, net, report: dict,
+                       activation_factors: Optional[dict] = None) -> dict:
         """Per-device byte projection of a :func:`telemetry.memory_report`
         under this layout — the fsdp HBM math ``preflight(layout=...)``
         checks against the budget:
 
-        - params/grads/moments divide by each leaf's spec factor (and drop
-          to the storage dtype under the precision policy);
-        - activations and inputs divide by the batch factor (data×fsdp).
+        - params/grads divide by each leaf's ``param_spec`` factor (under
+          ZeRO-1 that factor has no fsdp term — params replicate), moments
+          by their ``opt_spec`` factor, and both drop to the storage dtype
+          under the precision policy;
+        - activations divide by their PROPAGATED shard factor when the
+          sharding-flow pass supplied one (``activation_factors``: shape ->
+          factor — a tp-sharded hidden activation counts its tp split, the
+          bug the old batch-factor-only projection had), else by the batch
+          factor; inputs divide by the batch factor.
         """
         import jax
 
         p_pd = sum(self._leaf_bytes(l, storage=True, sharded=True)
                    for l in jax.tree_util.tree_leaves(net.params))
-        o_pd = sum(self._leaf_bytes(l, storage=True, sharded=True)
+        o_pd = sum(self._leaf_bytes(l, storage=True, sharded=True,
+                                    spec_fn=self.opt_spec)
                    for l in jax.tree_util.tree_leaves(net.opt_state))
         bf = self.batch_factor
-        act_pd = report["totals"]["activation_bytes"] / bf
+        act_pd = 0.0
+        rows = report.get("layers") or []
+        for row in rows:
+            act_pd += row["activation_bytes"] / self._activation_factor(
+                row.get("activation_shape"), activation_factors)
+        if not rows:
+            act_pd = report["totals"]["activation_bytes"] / bf
         in_pd = report["totals"]["input_bytes"] / bf
         projected = 2 * p_pd + o_pd + act_pd + in_pd
         return {
@@ -385,6 +499,7 @@ class MeshLayout:
             "input_bytes": int(in_pd),
             "projected_peak_bytes": int(projected),
             "batch_factor": bf,
+            "zero_stage": self.zero_stage,
         }
 
     # ---------------------------------------------------------------- misc
@@ -397,6 +512,7 @@ class MeshLayout:
             "tp_axis": self._tp_axis,
             "expert_axis": self._expert_axis,
             "devices": self.num_devices,
+            "zero_stage": self.zero_stage,
             "precision": self.precision.describe(),
         }
 

@@ -436,6 +436,10 @@ class CompileManager:
         # program host-side (dwarfed by the XLA compile it just paid);
         # findings land in dl4jtpu_ir_findings_total{rule} + the flight
         # recorder, the cost report next to the memory record in stats().
+        # Programs admitted with mesh-sharded args additionally get the
+        # DT3xx sharding-flow pass (predicted collective census + the
+        # DL4JTPU_ICI_GBPS communication roofline term) inside the same
+        # admission_check call.
         # Disable with DL4JTPU_IR_CHECKS=0; analysis must never break
         # compilation, so any failure degrades to cost=None.
         cost = None
@@ -475,6 +479,10 @@ class CompileManager:
                 static_flops=(cost or {}).get("flops"),
                 predicted_step_seconds=(cost or {}).get(
                     "roofline", {}).get("predicted_step_seconds"),
+                # sharding-flow predicted per-step ICI volume (only present
+                # when the program was admitted with mesh-sharded args)
+                predicted_comm_bytes=(cost or {}).get(
+                    "shard_flow", {}).get("comm_bytes_per_step"),
                 kernel_selections=len(kernels_here))
         except Exception:
             pass

@@ -380,28 +380,41 @@ def preflight(net, batch_or_struct=None, *, limit_bytes: Optional[int] = None,
     device's HBM passes preflight when the layout makes its per-device
     share fit — the capability jump fsdp exists for."""
     report = memory_report(net, batch_or_struct)
-    if layout is not None:
-        # fsdp HBM math (docs/distributed.md): what ONE device holds
-        net.init()
-        report["layout"] = layout.describe()
-        report["totals"]["per_device"] = layout.sharded_totals(net, report)
     source = "explicit limit_bytes"
     if limit_bytes is None:
         limit_bytes, source = _hbm_limit()
     # fold in the DT2xx IR scan + static roofline cost: "donation dropped,
     # step predicted HBM-bound" belongs in the same pre-dispatch report as
-    # "will not fit". Advisory — a failed scan never blocks preflight.
+    # "will not fit". With a layout the scan also runs the DT3xx
+    # sharding-flow pass — its predicted collective census lands in the
+    # report and its propagated activation specs drive the per-device
+    # activation projection below (a tp-sharded hidden activation counts
+    # its tp split, not just the batch factor). Advisory — a failed scan
+    # never blocks preflight.
+    activation_factors = None
     try:
-        ir = net.analyze_ir(batch_or_struct)
+        ir = net.analyze_ir(batch_or_struct, layout=layout) \
+            if layout is not None else net.analyze_ir(batch_or_struct)
         report["ir"] = {
             "findings": [f.to_dict() for f in ir["findings"]],
             "static_cost": ir["static_cost"],
         }
+        if "shard_flow" in ir:
+            report["ir"]["shard_flow"] = ir["shard_flow"]
+            activation_factors = {
+                tuple(r["shape"]): r["factor"]
+                for r in ir["shard_flow"].get("activation_factors", [])}
         from ..analysis.ir_checks import record_findings  # noqa: PLC0415
 
         record_findings(ir["findings"], registry=registry, flight=flight)
     except Exception as e:  # no input type / exotic net: note and move on
         report["ir"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if layout is not None:
+        # fsdp HBM math (docs/distributed.md): what ONE device holds
+        net.init()
+        report["layout"] = layout.describe()
+        report["totals"]["per_device"] = layout.sharded_totals(
+            net, report, activation_factors)
     if flight is not None:
         try:
             flight.attach_memory_report(report)

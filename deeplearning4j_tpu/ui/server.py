@@ -26,8 +26,10 @@ Pages:
 - ``/api/flightrecorder`` — the anomaly flight recorder's event ring
   (``?last=N``) and the dump bundles written so far.
 - ``/api/ircost``     — the IR lint / static roofline view: per-executable
-  ``static_cost`` reports from the compile cache, DT2xx finding counters,
-  and the configured roofline (DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS).
+  ``static_cost`` reports from the compile cache, DT2xx/DT3xx finding
+  counters, the predicted collective census of every executable admitted
+  with mesh-sharded args (the sharding-flow pass), and the configured
+  roofline (DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS / DL4JTPU_ICI_GBPS).
 - ``/api/serving``    — serving snapshot: per-model traffic counters, exact
   p50/p99 request latency, batch fill, queue depth, decode sessions.
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
@@ -451,11 +453,19 @@ class _Handler(BaseHTTPRequestHandler):
             if fam is not None:
                 for key, child in fam._items():
                     counts[key[0] if key else ""] = child.value
+            records = cm.cost_records()
+            # sharding-flow view: every admitted executable compiled with
+            # mesh-sharded args carries its predicted collective census
+            # (kind, mesh axes, per-device bytes) next to the roofline
+            shard_flow = {
+                label: rec["shard_flow"]
+                for label, rec in records.items() if rec.get("shard_flow")}
             return self._send(200, json.dumps({
                 "roofline": roofline_params(),
-                "cost_records": cm.cost_records(),
+                "cost_records": records,
                 "summary": cm.stats()["static_cost"],
                 "findings_total": counts,
+                "shard_flow": shard_flow,
                 "kernels": kernel_select.stats(),
             }, default=str).encode())
         if path == "/api/flightrecorder":

@@ -23,6 +23,7 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/datasets/bucketing.py \
     deeplearning4j_tpu/serving/ \
     deeplearning4j_tpu/parallel/layout.py \
+    deeplearning4j_tpu/analysis/shard_flow.py \
     --fail-on warning
 
 echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
@@ -237,6 +238,91 @@ print(f"mesh-layout self-scan OK: {len(layouts)} layouts DT008-clean, "
       f"admission DT008=0")
 PY
 
+echo "== shard-flow self-scan: DT3xx clean/expected on the canonical layouts + census parity"
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" python - <<'PY'
+# ISSUE 9 acceptance smoke: (1) the static sharding-flow pass over the four
+# canonical PR 8 layouts must come back DT3xx-clean on the dense self-scan
+# net (fsdp's ZeRO param gathers and grad all-reduces are the documented
+# cost, not findings), with tp allowed only its expected advisories;
+# (2) predicted census == measured post-SPMD census (same kinds/axes,
+# bytes within 1.5x) for dp and fsdp, compiled on the forced 4-device CPU
+# mesh; (3) ZeRO-1 layouts are collective-free on the forward pass.
+from __graft_entry__ import _force_cpu_mesh
+
+_force_cpu_mesh(4)
+
+import numpy as np
+import jax
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.analysis.shard_flow import (
+    check_network_shard_flow, compare_census, hlo_collective_census)
+from deeplearning4j_tpu.parallel import MeshLayout
+
+net = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[DenseLayer(n_out=1024, activation="relu"),
+            DenseLayer(n_out=1024, activation="relu"),
+            OutputLayer(n_out=16, activation="softmax", loss="mcxent")],
+    input_type=InputType.feed_forward(784),
+    updater=UpdaterConfig(updater="adam", learning_rate=1e-3))).init()
+
+layouts = {
+    "dp": MeshLayout(data=4),
+    "dp_fsdp": MeshLayout(data=2, fsdp=2),
+    "dp_tp": MeshLayout(data=2, tp=2),
+    "fsdp_bf16": MeshLayout(data=1, fsdp=4, params_dtype="bfloat16"),
+}
+for name, lo in layouts.items():
+    flow = check_network_shard_flow(net, 64, lo)
+    rules = sorted({f.rule_id for f in flow["findings"]})
+    assert not rules, (name, rules,
+                       [f.format_human() for f in flow["findings"]])
+    if lo._fsdp_axis or lo.batch_factor > 1:
+        assert flow["census"], (name, "expected a non-empty census")
+print("  DT3xx self-scan clean on", ", ".join(layouts))
+
+# census parity, compiled: dp (grad all-reduce only) + fsdp (param
+# all-gather + grad all-reduce), measured from the post-SPMD HLO
+rng = np.random.default_rng(0)
+x = rng.normal(size=(64, 784)).astype(np.float32)
+y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, 64)]
+for name, lo in (("dp", MeshLayout(data=4)),
+                 ("fsdp", MeshLayout(data=1, fsdp=4))):
+    n2 = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=1024, activation="relu"),
+                OutputLayer(n_out=16, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(784),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3))).init()
+    lo.apply(n2)
+    step = n2._build_train_step()
+    hlo = step.lower(n2.params, n2.opt_state, n2.state,
+                     lo.put(x, lo.batch_sharding()),
+                     lo.put(y, lo.batch_sharding()),
+                     n2._rng, None, None).compile().as_text()
+    measured = hlo_collective_census(hlo, lo)
+    predicted = check_network_shard_flow(n2, 64, lo)["census"]
+    res = compare_census(predicted, measured)
+    assert res["ok"], (name, res["problems"], predicted, measured)
+    kinds = sorted({r["kind"] for r in measured})
+    if name == "dp":
+        assert kinds == ["all_reduce"], kinds
+    else:
+        assert "all_gather" in kinds and "all_reduce" in kinds, kinds
+    print(f"  census parity {name}: ratio {res['total_ratio']} "
+          f"({len(measured)} measured rows)")
+
+# ZeRO-1: moments shard, params replicate, forward collective-free
+z1 = MeshLayout(data=1, fsdp=4, zero_stage=1)
+from jax.sharding import PartitionSpec as P
+assert z1.param_spec((1024, 1024)) == P()
+assert z1.opt_spec((1024, 1024)) == P("fsdp")
+fwd = check_network_shard_flow(net, 64, z1, train=False)
+assert fwd["census"] == [], fwd["census"]
+print("  ZeRO-1 forward collective-free, moments sharded / params replicated")
+print("shard-flow self-scan OK")
+PY
+
 echo "== compile-count smoke: varying steps/tails must not recompile"
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_compile_manager.py::TestRecompileElimination
@@ -410,6 +496,17 @@ assert ratio is not None, "shard bench carried no HBM records"
 assert ratio < 0.6, f"fsdp+bf16 per-device HBM ratio {ratio} >= 0.6x replicated"
 print(f"shard HBM gate OK: fsdp+bf16 runs at {ratio:.3f}x the replicated "
       f"f32 per-device footprint")
+
+# ISSUE 9 acceptance: per-variant predicted-vs-measured census parity —
+# the static sharding-flow pass must match the post-SPMD ground truth
+# (same major collective kinds + mesh axes, byte totals within 1.5x)
+for name, variant in d["variants"].items():
+    col = variant.get("collectives") or {}
+    assert "error" not in col, (name, col.get("error"))
+    match = col.get("match") or {}
+    assert match.get("ok"), (name, match.get("problems"), col)
+    print(f"census parity gate OK [{name}]: predicted/measured byte ratio "
+          f"{match['total_ratio']}")
 PY
 
 echo "== tier-1 tests"

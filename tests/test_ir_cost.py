@@ -589,10 +589,14 @@ class TestPreflightFolding:
 class TestRuleCatalog:
     def test_every_ir_rule_has_a_fixture_in_this_file(self):
         """Every shipped DT2xx rule is exercised above; a new IR rule must
-        bring a fixture (mirrors test_analysis' per-scope guarantees)."""
+        bring a fixture (mirrors test_analysis' per-scope guarantees).
+        The DT3xx sharding-flow family has its per-rule firing + clean
+        fixtures in tests/test_shard_flow.py."""
         ir_rules = {rid for rid, r in RULES.items() if r.scope == "ir"}
         assert ir_rules == {"DT200", "DT201", "DT202", "DT203", "DT204",
-                            "DT205", "DT206", "DT207"}
+                            "DT205", "DT206", "DT207",
+                            "DT300", "DT301", "DT302", "DT303", "DT304",
+                            "DT305"}
 
     def test_ir_rules_registered_with_hints(self):
         for rid, rule in RULES.items():
