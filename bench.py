@@ -857,6 +857,140 @@ def bench_serve(feature_dim: int = 256, hidden: int = 512, classes: int = 10,
     return result
 
 
+def bench_online(feature_dim: int = 32, hidden: int = 64, classes: int = 8,
+                 batch: int = 32, stage: int = 4, records: int = 6144,
+                 warm_records: int = 1024) -> dict:
+    """Sustained-ingest online-learning throughput (ISSUE 10 acceptance):
+    an :class:`runtime.online.OnlineTrainer` drains a producer-fed
+    ``QueueSource`` into staged ``fit_on_device`` windows, with a versioned
+    checkpoint + live hot-swap into an :class:`serving.InferenceService`
+    fired MID-RUN. Reports records/sec over the post-warmup phase, pins the
+    recompile story (steady-state ingest must admit zero new programs) and
+    records whether the swap changed served predictions without a restart.
+    Select with BENCH_MODEL=online."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+    from deeplearning4j_tpu.runtime.online import OnlineTrainer
+    from deeplearning4j_tpu.serving import InferenceService
+    from deeplearning4j_tpu.streaming import QueueSource
+    from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=hidden, activation="relu"),
+            OutputLayer(n_out=classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(feature_dim),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        seed=11,
+    )).init()
+    store = CheckpointStore(tempfile.mkdtemp(prefix="dl4jtpu_bench_ckpt_"),
+                            retain=3, registry=reg)
+    svc = InferenceService(registry=reg, max_delay_ms=0.5)
+    source = QueueSource(maxsize=16384)
+    trainer = OnlineTrainer(net, source, batch=batch, stage=stage,
+                            linger=0.05, name="bench-online",
+                            checkpoint_store=store,
+                            checkpoint_every_steps=0,  # swaps are explicit
+                            service=svc, serve_as="bench-live",
+                            registry=reg)
+    rng = np.random.default_rng(3)
+    true_w = rng.normal(size=(feature_dim, classes))
+    eye = np.eye(classes, dtype=np.float32)
+
+    def produce(n: int) -> None:
+        for _ in range(n):
+            x = rng.normal(size=feature_dim).astype(np.float32)
+            source.put(x, eye[int(np.argmax(x @ true_w))])
+
+    def wait_until(pred, deadline_s: float = 120.0) -> bool:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    trainer.start()
+    cm = get_compile_manager()
+    probe = rng.normal(size=(4, feature_dim)).astype(np.float32)
+    try:
+        # warm phase: the window programs AND the serving buckets compile
+        # here — everything after the mark must be a cache hit
+        produce(warm_records)
+        warmed = wait_until(
+            lambda: trainer.stats()["records_total"] >= warm_records)
+        svc.warmup("bench-live", probe[:1])
+        served_before = np.asarray(svc.predict("bench-live", probe,
+                                               timeout_s=60))
+        compiles_before = cm.compiles.value
+        # timed phase, with a checkpoint + hot-swap fired mid-run
+        feeder = threading.Thread(target=produce, args=(records,),
+                                  daemon=True)
+        t0 = time.perf_counter()
+        feeder.start()
+        wait_until(lambda: trainer.stats()["records_total"]
+                   >= warm_records + records // 2)
+        swap_version = trainer.checkpoint_now(swap=True)
+        done = wait_until(lambda: trainer.stats()["records_total"]
+                          >= warm_records + records)
+        dt = time.perf_counter() - t0
+        feeder.join(timeout=10)
+        served_after = np.asarray(svc.predict("bench-live", probe,
+                                              timeout_s=60))
+        warm_compiles = cm.compiles.value - compiles_before
+        stats = trainer.stats()
+    finally:
+        trainer.stop(checkpoint=False)
+        svc.stop()
+    value = round(records / dt, 1) if done else 0.0
+    result = {
+        "metric": "online_ingest_samples_per_sec",
+        "value": value,
+        "unit": "records/sec",
+        "records": records,
+        "seconds": round(dt, 4),
+        "completed": bool(done and warmed),
+        "warm_compiles": warm_compiles,
+        "swap": {
+            "version": int(swap_version),
+            "served_changed": bool(
+                np.abs(served_after - served_before).max() > 0),
+            "swaps_total": stats["swaps_total"],
+        },
+        "windows_total": stats["windows_total"],
+        "steps_total": stats["steps_total"],
+        "checkpoint_versions": [
+            v["version"] for v in (stats["checkpoints"] or
+                                   {"versions": []})["versions"]],
+        "shape": {"feature_dim": feature_dim, "hidden": hidden,
+                  "classes": classes, "batch": batch, "stage": stage},
+    }
+    result["telemetry"] = _telemetry_block(
+        [dt / max(stats["steps_total"], 1)],
+        extra_gauges={
+            "bench_samples_per_sec": value,
+            "bench_online_windows": stats["windows_total"],
+            "bench_compiles_total": cm.stats()["compiles_total"],
+        })
+    result["telemetry"]["compile"] = cm.stats()
+    result["memory"] = _memory_block()
+    result["kernels"] = _kernels_block()
+    return result
+
+
 def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
                 classes: int = 10, steps: int = 12, groups: int = 2) -> dict:
     """Sharding-layout throughput + per-device HBM (ISSUE 8 acceptance):
@@ -1108,6 +1242,10 @@ def _tpu_child_main() -> int:
     elif os.environ.get("BENCH_MODEL") == "serve":
         result = bench_serve(max_rows=_ienv("BENCH_SERVE_ROWS", 8),
                              max_batch=_ienv("BENCH_SERVE_BATCH", 64))
+    elif os.environ.get("BENCH_MODEL") == "online":
+        result = bench_online(batch=_ienv("BENCH_BATCH", 32),
+                              stage=_ienv("BENCH_STAGE", 4),
+                              records=_ienv("BENCH_RECORDS", 6144))
     elif os.environ.get("BENCH_MODEL") == "shard":
         # raises on a single-device backend: the parent then falls back to
         # the forced 4-device CPU mesh, which is the meaningful measurement
@@ -1246,6 +1384,11 @@ if __name__ == "__main__":
                 result = bench_serve()
             elif mode == "shard":
                 result = bench_shard()
+            elif mode == "online":
+                # like serve/shard: the online trainer measures the
+                # host-side ingest/staging machinery, meaningful on CPU —
+                # the check.sh online gate runs exactly this
+                result = bench_online()
             else:
                 result = bench_mlp_mnist()
             # The tunnel was unavailable THIS run; surface the most recent

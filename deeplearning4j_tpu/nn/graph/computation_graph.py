@@ -335,8 +335,15 @@ class ComputationGraph:
         and device-scalar step/batch counts (changing them reuses one
         executable). ``xmasks``/``ymasks``: per-input features masks and
         per-output labels masks (None entries allowed), stacked ``[K, ...]``
-        — the bucketed stager's padded batches flow through here."""
+        — the bucketed stager's padded batches flow through here.
+
+        Layout-applied graphs pin output placements to the declared specs
+        (see MultiLayerNetwork._staged_out_constraint — the ZeRO-1 updated-
+        params drift fix)."""
+        from ..multilayer import MultiLayerNetwork
+
         tx = self._tx
+        constrain = MultiLayerNetwork._staged_out_constraint(self)
 
         def run(params, opt_state, state, rng, n_steps, n_batches,
                 xs_list, ys_list, xmasks, ymasks):
@@ -390,6 +397,8 @@ class ComputationGraph:
             (params, opt_state, state, rng, losses, mvecs) = jax.lax.fori_loop(
                 0, n_steps, body,
                 (params, opt_state, state, rng, losses0, mvecs0))
+            if constrain is not None:
+                params, opt_state = constrain(params, opt_state)
             if with_telemetry:
                 return params, opt_state, state, rng, losses, mvecs
             return params, opt_state, state, rng, losses

@@ -418,8 +418,16 @@ class MultiLayerNetwork:
         constants — changing either reuses one executable. Only ``steps_cap``
         (the static per-step-output buffer size, a power-of-two bucket) and
         the staged array shapes are baked into the program.
+
+        Sharded nets additionally pin the OUTPUT placements to the layout's
+        declared specs: unconstrained, GSPMD is free to return updated
+        params at whatever sharding propagation favors — under
+        ``MeshLayout(zero_stage=1)`` the fsdp-sharded moments pulled the
+        (declared-replicated) params out fsdp-sharded, so the next dispatch
+        saw new input shardings and paid one extra compile.
         """
         tx = self._tx
+        constrain = self._staged_out_constraint()
 
         def run(params, opt_state, state, rng, n_steps, n_batches, xs, ys,
                 xmasks, ymasks):
@@ -463,12 +471,33 @@ class MultiLayerNetwork:
             (params, opt_state, state, rng, losses, mvecs) = jax.lax.fori_loop(
                 0, n_steps, body,
                 (params, opt_state, state, rng, losses0, mvecs0))
+            if constrain is not None:
+                params, opt_state = constrain(params, opt_state)
             if with_telemetry:
                 return params, opt_state, state, rng, losses, mvecs
             return params, opt_state, state, rng, losses
 
         donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
+
+    def _staged_out_constraint(self):
+        """Output-sharding pin for the staged step of a layout-applied net:
+        updated params/opt-state leave the program at the layout's DECLARED
+        specs (``with_sharding_constraint``), so the next dispatch's input
+        signature is a fixed point — zero warm compiles even where GSPMD's
+        own propagation would prefer a different placement (ZeRO-1)."""
+        layout = getattr(self, "_mesh_layout", None)
+        if layout is None or layout.mesh is None \
+                or layout.mesh.devices.size <= 1:
+            return None
+        p_sh = layout.param_shardings(self.params)
+        o_sh = layout.opt_shardings(self.opt_state)
+
+        def constrain(params, opt_state):
+            return (jax.lax.with_sharding_constraint(params, p_sh),
+                    jax.lax.with_sharding_constraint(opt_state, o_sh))
+
+        return constrain
 
     def _staged_executable(self, steps_cap: int, with_masks: bool,
                            with_telemetry: bool, args):

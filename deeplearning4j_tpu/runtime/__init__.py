@@ -13,20 +13,25 @@ from .native_loader import (
     native_csv_read,
     native_idx_read,
 )
+from .checkpoint import CheckpointStore
 from .compile_manager import (
     CompileManager,
     enable_persistent_cache,
     get_compile_manager,
 )
 from .inference import canonicalize_input, fast_path_enabled
+from .online import OnlineTrainer, get_online_trainers
 
 __all__ = [
+    "CheckpointStore",
     "CompileManager",
     "NativeDataSetIterator",
+    "OnlineTrainer",
     "canonicalize_input",
     "enable_persistent_cache",
     "fast_path_enabled",
     "get_compile_manager",
+    "get_online_trainers",
     "native_available",
     "native_csv_read",
     "native_idx_read",

@@ -51,10 +51,16 @@ def _percentile(values, q: float):
 
 
 class _ModelEntry:
-    def __init__(self, name: str, net, batcher: MicroBatcher):
+    def __init__(self, name: str, net, batcher: MicroBatcher,
+                 argmax_batcher: MicroBatcher):
         self.name = name
         self.net = net
         self.batcher = batcher
+        # class-index requests coalesce separately: logits and int32-argmax
+        # dispatches can never share a transfer, but argmax traffic still
+        # deserves the latency-budget batching (they dispatched direct
+        # before — the ISSUE 10 serving-hardening satellite)
+        self.argmax_batcher = argmax_batcher
         self.decoder: Optional[DecodeServer] = None
         self.lock = threading.Lock()
         self.latencies: "deque[float]" = deque(maxlen=2048)
@@ -63,6 +69,15 @@ class _ModelEntry:
         self.batches = 0
         self.fill_sum = 0.0
         self.last_dispatch: Optional[dict] = None
+        self.version: Optional[int] = None  # hot-swap bookkeeping
+        self.swapped_at: Optional[float] = None
+        self.swaps = 0
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        self.argmax_batcher.stop()
+        if self.decoder is not None:
+            self.decoder.stop()
 
 
 class InferenceService:
@@ -102,6 +117,19 @@ class InferenceService:
             "dl4jtpu_serve_batch_fill_ratio",
             "real rows / pow2 bucket rows of the last dispatch, by model",
             labelnames=("model",))
+        # request-size classes: the distribution DL4JTPU_SERVE_MAX_BATCH
+        # tuning needs (a cap far above the p99 request size wastes bucket
+        # warmup; far below it splits bursts) — pow2 buckets to match the
+        # compiled bucket family
+        self.request_rows = registry.histogram(
+            "dl4jtpu_serve_request_rows",
+            "rows per inference request, by model",
+            labelnames=("model",),
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        self.swaps_total = registry.counter(
+            "dl4jtpu_serve_swaps_total",
+            "live hot-swaps of a served model's parameters, by model",
+            labelnames=("model",))
 
     # ------------------------------------------------------------ registry
     @staticmethod
@@ -133,29 +161,72 @@ class InferenceService:
         def dispatch(feats: np.ndarray) -> np.ndarray:
             return self._run_model(entry_holder[0], feats, argmax=False)
 
+        def dispatch_argmax(feats: np.ndarray) -> np.ndarray:
+            return self._run_model(entry_holder[0], feats, argmax=True)
+
         batcher = MicroBatcher(
             dispatch,
             max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
             on_batch=lambda **kw: self._record_batch(name, **kw),
             on_request=lambda s: self._record_request(name, s))
-        entry = _ModelEntry(name, net, batcher)
+        argmax_batcher = MicroBatcher(
+            dispatch_argmax,
+            max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
+            on_batch=lambda **kw: self._record_batch(name, kind="argmax",
+                                                     **kw),
+            on_request=lambda s: self._record_request(name, s))
+        entry = _ModelEntry(name, net, batcher, argmax_batcher)
         entry_holder.append(entry)
         with self._lock:
             old = self._models.get(name)
             self._models[name] = entry
         if old is not None:
-            old.batcher.stop()
-            if old.decoder is not None:
-                old.decoder.stop()
+            old.stop()
         return self
 
     def unregister(self, name: str) -> None:
         with self._lock:
             entry = self._models.pop(name, None)
         if entry is not None:
-            entry.batcher.stop()
-            if entry.decoder is not None:
-                entry.decoder.stop()
+            entry.stop()
+
+    def hot_swap(self, name: str, params=None, *, net=None, state=None,
+                 version: Optional[int] = None) -> None:
+        """Swap a served model's parameters live — the train→serve handoff.
+
+        A pure pointer flip behind the entry lock: the served net keeps its
+        compile-manager token and its abstract signature (same config, same
+        shapes/dtypes), so every cached executable still matches — no
+        restart, no warm-compile storm. In-flight dispatches already passed
+        the old pytree into their executable and complete bit-exactly on
+        it; every dispatch after the flip sees the new pytree, never a mix.
+
+        Pass ``params`` (and optionally ``state``) directly — snapshot
+        copies, not the live training buffers, when the trainer donates —
+        or ``net`` to copy the references from another model object.
+        ``version`` tags the swap in :meth:`stats`/flight events.
+        """
+        entry = self._entry(name)
+        if params is None:
+            if net is None:
+                raise ValueError("hot_swap needs params= or net=")
+            params, state = net.params, net.state
+        with entry.lock:
+            entry.net.params = params
+            if state is not None:
+                entry.net.state = state
+            entry.version = version
+            entry.swapped_at = time.time()
+            entry.swaps += 1
+        self.swaps_total.labels(model=name).inc()
+        try:
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            get_flight_recorder().record(
+                "serve_swap", model=name,
+                version=None if version is None else int(version))
+        except Exception:  # observability must never fail a swap
+            pass
 
     def models(self):
         with self._lock:
@@ -206,22 +277,19 @@ class InferenceService:
     def predict(self, name: str, features, *, argmax: bool = False,
                 timeout_s: float = 30.0) -> np.ndarray:
         """Serve one request through the model's micro-batcher. ``argmax``
-        requests bypass coalescing only in shape (they share the same
-        compiled bucket family via the fused-argmax variant)."""
+        requests coalesce on their OWN batcher (mixing them with logits
+        requests would force two device transfers per batch) and dispatch
+        on the fused-argmax executable — only int32 class indices cross
+        the device boundary, same as the old direct path."""
         entry = self._entry(name)
-        if argmax:
-            # class-index requests dispatch directly on the fused-argmax
-            # executable: coalescing mixed argmax/logits requests would
-            # force two transfers per batch
-            t0 = time.perf_counter()
-            out = self._run_model(entry, np.asarray(features), argmax=True)
-            lat = time.perf_counter() - t0
-            self._record_request(name, lat)
-            self._record_batch(name, rows=int(np.asarray(features).shape[0]),
-                               requests=1, seconds=lat, queue_depth=0)
-            return out
-        fut = entry.batcher.submit(features)
-        self.queue_depth.labels(model=name).set(entry.batcher.queue_depth())
+        features = np.asarray(features)
+        if features.ndim >= 1:
+            self.request_rows.labels(model=name).observe(
+                int(features.shape[0]))
+        batcher = entry.argmax_batcher if argmax else entry.batcher
+        fut = batcher.submit(features)
+        self.queue_depth.labels(model=name).set(
+            entry.batcher.queue_depth() + entry.argmax_batcher.queue_depth())
         return fut.result(timeout=timeout_s)
 
     # ----------------------------------------------------------- decode
@@ -299,7 +367,11 @@ class InferenceService:
                 "requests_total": e.requests,
                 "rows_total": e.rows,
                 "batches_total": e.batches,
-                "queue_depth": e.batcher.queue_depth(),
+                "version": e.version,
+                "swaps_total": e.swaps,
+                "swapped_at": e.swapped_at,
+                "queue_depth": (e.batcher.queue_depth()
+                                + e.argmax_batcher.queue_depth()),
                 "mean_batch_fill_ratio": (
                     round(e.fill_sum / e.batches, 4) if e.batches else None),
                 "latency_seconds": {
@@ -326,9 +398,7 @@ class InferenceService:
             entries = list(self._models.values())
             self._models.clear()
         for e in entries:
-            e.batcher.stop()
-            if e.decoder is not None:
-                e.decoder.stop()
+            e.stop()
 
 
 _GLOBAL: Optional[InferenceService] = None

@@ -231,6 +231,24 @@ class StreamingPipeline:
                 if buf and (len(buf) >= self.batch or now >= (deadline or now)):
                     self._flush(buf)
                     buf, deadline = [], None
+            # stop() drains: records the source already buffered but the
+            # pump never polled were silently dropped before — a producer
+            # that put N records and called stop() lost the tail whenever
+            # the pump was behind. Poll the source dry (bounded, in case a
+            # producer is still live), flushing through the same batch and
+            # label-boundary rules, THEN flush the residual partial buffer.
+            drain_deadline = time.monotonic() + 5.0
+            while time.monotonic() < drain_deadline:
+                rec = self.source.poll(timeout=0)
+                if rec is None:
+                    break
+                if buf and (rec[1] is None) != (buf[0][1] is None):
+                    self._flush(buf)
+                    buf = []
+                buf.append(rec)
+                if len(buf) >= self.batch:
+                    self._flush(buf)
+                    buf = []
             if buf:
                 self._flush(buf)
         except BaseException as e:  # surfaced on stop()/raise_if_failed()

@@ -47,6 +47,8 @@ from .session import Telemetry
 from .spans import Span, SpanRecorder, get_recorder, span
 from .watchdog import (
     EXPLODING_GRAD_NORM,
+    INPUT_SHIFT,
+    LOSS_DRIFT,
     NAN_LOSS,
     STALLED_STEP_TIME,
     AnomalyEvent,
@@ -70,6 +72,8 @@ __all__ = [
     "NAN_LOSS",
     "EXPLODING_GRAD_NORM",
     "STALLED_STEP_TIME",
+    "LOSS_DRIFT",
+    "INPUT_SHIFT",
     "FlightRecorder",
     "get_flight_recorder",
     "install_crash_hook",

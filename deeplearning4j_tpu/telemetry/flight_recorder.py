@@ -253,6 +253,15 @@ def get_flight_recorder() -> FlightRecorder:
         return _GLOBAL
 
 
+def set_flight_recorder(recorder: Optional[FlightRecorder]) -> None:
+    """Swap the process-wide recorder (soak harnesses / tests want a
+    private dump dir); ``None`` resets to lazy re-creation of the
+    default."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = recorder
+
+
 def install_crash_hook(recorder: Optional[FlightRecorder] = None) -> FlightRecorder:
     """Dump on an unhandled exception (``sys.excepthook`` wrap) and, at
     interpreter exit, when anomalies were ringed but never dumped — the

@@ -32,6 +32,11 @@ logger = logging.getLogger(__name__)
 NAN_LOSS = "nan-loss"
 EXPLODING_GRAD_NORM = "exploding-grad-norm"
 STALLED_STEP_TIME = "stalled-step-time"
+# online-learning drift kinds (emitted by runtime/online.py through
+# Watchdog.emit — the same sink/counter/flight-dump plumbing as the
+# per-step kinds above; see docs/streaming.md)
+LOSS_DRIFT = "loss-drift"
+INPUT_SHIFT = "input-shift"
 
 
 @dataclass(frozen=True)
@@ -98,6 +103,15 @@ class Watchdog:
                 sink(event)
             except Exception:  # a broken sink must never kill the train loop
                 logger.exception("telemetry watchdog sink failed")
+
+    def emit(self, kind: str, iteration: int, value: float,
+             threshold: float, message: str) -> None:
+        """Emit a caller-detected anomaly through the watchdog's sinks and
+        counter — the hook the online-learning drift detectors use (their
+        signals live in window statistics the per-step ``observe`` path
+        never sees)."""
+        self._emit(str(kind), int(iteration), float(value), float(threshold),
+                   str(message))
 
     def observe(self, iteration: int, loss: float, grad_norm: float,
                 nonfinite: float = 0.0,

@@ -32,6 +32,9 @@ Pages:
   roofline (DL4JTPU_PEAK_FLOPS / DL4JTPU_HBM_GBPS / DL4JTPU_ICI_GBPS).
 - ``/api/serving``    — serving snapshot: per-model traffic counters, exact
   p50/p99 request latency, batch fill, queue depth, decode sessions.
+- ``/api/online``     — online-learning snapshot: per-trainer ingest rate,
+  window/step counters, drift/rollback state, hot-swap history, and the
+  checkpoint store's version listing (see docs/streaming.md).
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
   and continuous-decode endpoints over the process serving front-end
   (``serving.get_service()``; see docs/serving.md).
@@ -485,6 +488,16 @@ class _Handler(BaseHTTPRequestHandler):
 
             return self._send(200, json.dumps(
                 get_service().stats(), default=str).encode())
+        if path == "/api/online":
+            # online-learning snapshot: every OnlineTrainer's ingest/window
+            # counters, drift state, rollbacks/swaps, and its checkpoint
+            # store's version listing (docs/streaming.md)
+            from ..runtime.online import get_online_trainers  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                {"trainers": {name: t.stats()
+                              for name, t in get_online_trainers().items()}},
+                default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]

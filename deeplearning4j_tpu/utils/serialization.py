@@ -59,7 +59,14 @@ def _load_leaves(zf: zipfile.ZipFile, name: str, like_tree: Any) -> Any:
 
 
 def write_model(model, path: str) -> None:
-    """Save a MultiLayerNetwork/ComputationGraph (reference: ModelSerializer.writeModel)."""
+    """Save a MultiLayerNetwork/ComputationGraph (reference: ModelSerializer.writeModel).
+
+    ``model`` may also be a lightweight snapshot proxy (anything carrying
+    conf/params/opt_state/state/iteration and a ``model_class`` attribute
+    naming the real class) — the checkpoint store's non-blocking writer
+    captures leaf references on the training thread and serializes them
+    here without holding the live model.
+    """
     model.init()
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", model.conf.to_json())
@@ -70,7 +77,8 @@ def write_model(model, path: str) -> None:
             "meta.json",
             json.dumps(
                 {
-                    "model_class": type(model).__name__,
+                    "model_class": getattr(model, "model_class",
+                                           type(model).__name__),
                     "iteration": model.iteration,
                     "epoch": getattr(model, "epoch", 0),
                 }

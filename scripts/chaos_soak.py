@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Chaos soak for the online-learning loop (ISSUE 10 acceptance).
+
+Runs an :class:`~deeplearning4j_tpu.runtime.online.OnlineTrainer` against a
+deliberately hostile stream and asserts the PRODUCTION outcome, not the
+happy path: the trainer must end ALIVE, having rolled back to the last good
+checkpoint, with a flight-recorder bundle — not a stack trace — as the
+artifact, and steady-state ingest must have paid zero warm compiles.
+
+Injected chaos:
+
+- **Ragged shapes** — sequence records with lengths drawn from a pool (pow2
+  time buckets absorb them) and ragged trailing micro-batches.
+- **Source disconnect/reconnect** — the source raises ``ConnectionError``
+  for an outage window every N polls; the trainer must back off and resume.
+- **NaN batches** — bursts of all-NaN features; the watchdog hook must
+  pause, roll back, dump, resume.
+- **Slow consumers** — serving clients that hold the swapped model while
+  dripping requests, while checkpoints keep hot-swapping under them.
+
+Usage (the check.sh short soak uses the in-process entry ``run_soak``)::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--records 4096]
+        [--batch 32] [--stage 4] [--nan-bursts 3] [--outages 3]
+        [--seq] [--deadline 300]
+
+Exit 0 and a one-line JSON summary on success; exit 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_DIR not in sys.path:
+    sys.path.insert(0, REPO_DIR)
+
+
+class FlakySource:
+    """RecordSource wrapper that simulates broker outages: every
+    ``outage_every`` successful polls, ``poll`` raises ``ConnectionError``
+    for ``outage_polls`` consecutive calls, then recovers. Buffered records
+    survive the outage (a real broker redelivers)."""
+
+    def __init__(self, inner, outage_every: int = 400, outage_polls: int = 4):
+        self.inner = inner
+        self.outage_every = int(outage_every)
+        self.outage_polls = int(outage_polls)
+        self._ok_polls = 0
+        self._down_left = 0
+        self.outages = 0
+
+    def poll(self, timeout: float = 0.1):
+        if self._down_left > 0:
+            self._down_left -= 1
+            raise ConnectionError("chaos: source disconnected")
+        self._ok_polls += 1
+        if self.outage_every > 0 and self._ok_polls % self.outage_every == 0:
+            self._down_left = self.outage_polls
+            self.outages += 1
+        return self.inner.poll(timeout=timeout)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+def run_soak(records: int = 4096, batch: int = 32, stage: int = 4,
+             feature_dim: int = 16, classes: int = 4, hidden: int = 32,
+             nan_bursts: int = 3, outages: bool = True, seq: bool = False,
+             slow_consumers: int = 2, deadline_s: float = 300.0,
+             flight_dir: str | None = None, seed: int = 0) -> dict:
+    """The in-process soak (also the check.sh self-scan / slow-test entry).
+    Returns the summary dict; raises AssertionError when the contract is
+    violated."""
+    from deeplearning4j_tpu.telemetry.flight_recorder import (
+        FlightRecorder, set_flight_recorder)
+
+    if flight_dir is None:
+        flight_dir = tempfile.mkdtemp(prefix="dl4jtpu_soak_flight_")
+    # a private recorder with no rate limit between DIFFERENT reasons and a
+    # dedicated dump dir — the bundle path is the soak's artifact
+    recorder = FlightRecorder(dump_dir=flight_dir)
+    set_flight_recorder(recorder)
+    try:
+        return _run_soak_inner(
+            records, batch, stage, feature_dim, classes, hidden, nan_bursts,
+            outages, seq, slow_consumers, deadline_s, flight_dir, seed)
+    finally:
+        set_flight_recorder(None)
+
+
+def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
+                    nan_bursts, outages, seq, slow_consumers, deadline_s,
+                    flight_dir, seed) -> dict:
+    from deeplearning4j_tpu import (DenseLayer, GravesLSTM, InputType,
+                                    MultiLayerConfiguration,
+                                    MultiLayerNetwork, OutputLayer,
+                                    RnnOutputLayer, UpdaterConfig)
+    from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+    from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+    from deeplearning4j_tpu.runtime.online import OnlineTrainer
+    from deeplearning4j_tpu.serving import InferenceService
+    from deeplearning4j_tpu.streaming import QueueSource
+    from deeplearning4j_tpu.telemetry.flight_recorder import (
+        get_flight_recorder)
+
+    rng = np.random.default_rng(seed)
+    if seq:
+        conf = MultiLayerConfiguration(
+            layers=[GravesLSTM(n_out=hidden),
+                    RnnOutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent")],
+            input_type=InputType.recurrent(feature_dim),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=seed)
+        lengths = (5, 7, 8, 11, 13, 16)  # → pow2 buckets 8 and 16
+
+        def make_record(nan=False):
+            t = int(rng.choice(lengths))
+            x = rng.normal(size=(t, feature_dim)).astype(np.float32)
+            if nan:
+                x[:] = np.nan
+            y = np.eye(classes, dtype=np.float32)[
+                rng.integers(0, classes, t)]
+            return x, y
+    else:
+        conf = MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=hidden, activation="tanh"),
+                    OutputLayer(n_out=classes, activation="softmax",
+                                loss="mcxent")],
+            input_type=InputType.feed_forward(feature_dim),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+            seed=seed)
+        true_w = rng.normal(size=(feature_dim, classes))
+
+        def make_record(nan=False):
+            x = rng.normal(size=feature_dim).astype(np.float32)
+            if nan:
+                x[:] = np.nan
+            y = np.eye(classes, dtype=np.float32)[int(np.argmax(x @ true_w))]
+            return x, y
+
+    net = MultiLayerNetwork(conf).init()
+    store = CheckpointStore(
+        tempfile.mkdtemp(prefix="dl4jtpu_soak_ckpt_"), retain=4)
+    svc = InferenceService(max_delay_ms=0.5)
+    queue = QueueSource(maxsize=8192)
+    source = FlakySource(queue, outage_every=300 if outages else 0)
+    trainer = OnlineTrainer(
+        net, source, batch=batch, stage=stage, linger=0.05,
+        name="chaos-soak", checkpoint_store=store,
+        checkpoint_every_steps=2 * stage, service=svc, serve_as="soak-live")
+    trainer.start()
+    cm = get_compile_manager()
+    recorder = get_flight_recorder()
+    stop_consumers = threading.Event()
+    consumer_errors: list = []
+
+    def slow_consumer():
+        probe = np.zeros((2, feature_dim), np.float32)
+        if seq:
+            probe = np.zeros((2, 8, feature_dim), np.float32)
+        while not stop_consumers.is_set():
+            try:
+                svc.predict("soak-live", probe, timeout_s=60)
+            except Exception as e:  # noqa: BLE001 - surfaced at the end
+                consumer_errors.append(f"{type(e).__name__}: {e}")
+            stop_consumers.wait(0.25)  # slow: hold the model, drip requests
+
+    consumers = [threading.Thread(target=slow_consumer, daemon=True)
+                 for _ in range(slow_consumers)]
+
+    def wait_for(pred, seconds):
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return False
+
+    t_start = time.monotonic()
+    warm = max(4 * batch * stage, 256)
+    for _ in range(warm):
+        queue.put(*make_record())
+    assert wait_for(lambda: trainer.stats()["records_total"] >= warm,
+                    deadline_s / 3), "soak: warm phase never completed"
+    # serving buckets compile ahead too: everything past the mark is warm
+    probe0 = (np.zeros((1, 8, feature_dim), np.float32) if seq
+              else np.zeros((1, feature_dim), np.float32))
+    svc.warmup("soak-live", probe0)
+    for th in consumers:
+        th.start()
+    compiles_mark = cm.compiles.value
+
+    produced = warm
+    burst_at = np.linspace(records * 0.2, records * 0.9,
+                           max(nan_bursts, 1)).astype(int) \
+        if nan_bursts else np.array([], int)
+    next_burst = list(burst_at)
+    n = 0
+    while n < records and time.monotonic() - t_start < deadline_s:
+        if next_burst and n >= next_burst[0]:
+            next_burst.pop(0)
+            for _ in range(2 * batch):  # a NaN window's worth
+                queue.put(*make_record(nan=True))
+                produced += 1
+        queue.put(*make_record())
+        produced += 1
+        n += 1
+        if n % 512 == 0:
+            time.sleep(0.05)  # producer jitter: forces ragged tails
+    assert wait_for(
+        lambda: (trainer.stats()["records_total"] >= produced
+                 or not trainer.alive),
+        deadline_s - (time.monotonic() - t_start) + 5), \
+        "soak: ingest never drained the stream"
+    elapsed = time.monotonic() - t_start
+    # quiesce, then final swap under the slow consumers
+    final_version = trainer.checkpoint_now(swap=True)
+    stop_consumers.set()
+    for th in consumers:
+        th.join(timeout=10)
+    stats = trainer.stats()
+    warm_compiles = cm.compiles.value - compiles_mark
+    summary = {
+        "alive": trainer.alive,
+        "records": int(stats["records_total"]),
+        "steps": int(stats["steps_total"]),
+        "windows": int(stats["windows_total"]),
+        "samples_per_sec": round(stats["records_total"] / elapsed, 1),
+        "nan_bursts": int(nan_bursts),
+        "rollbacks": int(stats["rollbacks_total"]),
+        "outages": source.outages,
+        "reconnects": int(stats["reconnects_total"]),
+        "source_errors": int(stats["source_errors_total"]),
+        "swaps": int(stats["swaps_total"]),
+        "final_version": int(final_version),
+        "checkpoint_versions": [v["version"] for v in
+                                stats["checkpoints"]["versions"]],
+        "warm_compiles": float(warm_compiles),
+        "flight_bundles": list(recorder.dumps),
+        "consumer_errors": consumer_errors[:5],
+        "anomalies": stats["anomalies"],
+    }
+    trainer.stop(checkpoint=False)
+    svc.stop()
+    # ------------------------------------------------------- the contract
+    assert summary["alive"], "trainer died under chaos"
+    assert not consumer_errors, f"serving failed under swaps: {consumer_errors[:3]}"
+    if nan_bursts:
+        assert summary["rollbacks"] >= 1, "NaN bursts produced no rollback"
+        assert summary["flight_bundles"], "no flight bundle artifact"
+    if outages:
+        assert summary["reconnects"] >= 1, "outages produced no reconnect"
+    assert summary["warm_compiles"] == 0, (
+        f"{warm_compiles} compiles paid by steady-state ingest")
+    assert summary["swaps"] >= 1 and summary["final_version"] >= 1
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos_soak")
+    ap.add_argument("--records", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--stage", type=int, default=4)
+    ap.add_argument("--nan-bursts", type=int, default=3)
+    ap.add_argument("--no-outages", action="store_true")
+    ap.add_argument("--seq", action="store_true",
+                    help="ragged sequence records (LSTM) instead of rows")
+    ap.add_argument("--deadline", type=float, default=300.0)
+    ap.add_argument("--flight-dir", default=None)
+    ap.add_argument("--no-force-cpu", action="store_true",
+                    help="keep the env's pinned backend (default forces the "
+                         "CPU backend like the rest of the check harness)")
+    args = ap.parse_args(argv)
+    if not args.no_force_cpu:
+        from __graft_entry__ import _force_cpu_mesh
+
+        _force_cpu_mesh(1)
+    summary = run_soak(records=args.records, batch=args.batch,
+                       stage=args.stage, nan_bursts=args.nan_bursts,
+                       outages=not args.no_outages, seq=args.seq,
+                       deadline_s=args.deadline, flight_dir=args.flight_dir)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,10 +16,12 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ 
 echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
 
-echo "== dl4jtpu-check: compile/bucketing/serving/layout modules held to --fail-on warning"
+echo "== dl4jtpu-check: compile/bucketing/serving/layout/online modules held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/runtime/compile_manager.py \
     deeplearning4j_tpu/runtime/inference.py \
+    deeplearning4j_tpu/runtime/online.py \
+    deeplearning4j_tpu/runtime/checkpoint.py \
     deeplearning4j_tpu/datasets/bucketing.py \
     deeplearning4j_tpu/serving/ \
     deeplearning4j_tpu/parallel/layout.py \
@@ -461,6 +463,30 @@ print(f"serving smoke OK: {int(m['requests_total'])} requests, 0 warm "
       f"{m['mean_batch_fill_ratio']}, /api/serving + /metrics populated")
 PY
 
+echo "== online-learning self-scan: short chaos soak (ingest → snapshot → hot-swap → NaN → rollback)"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 10 acceptance smoke: the in-process soak drives the whole live
+# loop — staged ingest, versioned checkpoint, train→serve hot-swap, a NaN
+# burst, watchdog rollback, source outage/reconnect — and run_soak itself
+# asserts the contract: trainer alive, >=1 rollback, a flight bundle as
+# the artifact, ZERO steady-state compiles, swaps served.
+from __graft_entry__ import _force_cpu_mesh
+
+_force_cpu_mesh(1)
+
+import sys
+
+sys.path.insert(0, "scripts")
+from chaos_soak import run_soak
+
+summary = run_soak(records=1024, nan_bursts=1, deadline_s=180)
+print(f"online self-scan OK: {summary['records']} records at "
+      f"{summary['samples_per_sec']}/s, {summary['rollbacks']} rollback(s), "
+      f"{summary['reconnects']} reconnect(s), {summary['swaps']} swap(s), "
+      f"{summary['warm_compiles']:.0f} warm compiles, "
+      f"{len(summary['flight_bundles'])} flight bundle(s)")
+PY
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
@@ -479,6 +505,24 @@ rm -f /tmp/_bench_gate_serve.json
 BENCH_FORCE_CPU=1 BENCH_MODEL=serve BENCH_DEADLINE_S=240 python bench.py \
     | tail -1 > /tmp/_bench_gate_serve.json
 python scripts/bench_gate.py /tmp/_bench_gate_serve.json
+
+echo "== bench regression gate (online mode vs BENCH_BASELINE.json)"
+rm -f /tmp/_bench_gate_online.json
+BENCH_FORCE_CPU=1 BENCH_MODEL=online BENCH_DEADLINE_S=240 python bench.py \
+    | tail -1 > /tmp/_bench_gate_online.json
+python scripts/bench_gate.py /tmp/_bench_gate_online.json
+python - <<'PY'
+# ISSUE 10 acceptance: sustained ingest completes at zero warm compiles and
+# the mid-run hot-swap changed served predictions without a restart
+import json
+
+d = json.load(open("/tmp/_bench_gate_online.json"))
+assert d.get("completed"), d
+assert d.get("warm_compiles") == 0, f"warm_compiles={d.get('warm_compiles')}"
+assert d["swap"]["served_changed"] and d["swap"]["swaps_total"] >= 1, d["swap"]
+print(f"online gate OK: {d['value']} records/sec sustained, 0 warm "
+      f"compiles, swap v{d['swap']['version']} changed served predictions")
+PY
 
 echo "== bench regression gate (shard mode vs BENCH_BASELINE.json + HBM ratio)"
 rm -f /tmp/_bench_gate_shard.json

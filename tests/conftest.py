@@ -35,6 +35,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/chaos tests, excluded from tier-1 "
+        "(pytest -m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)

@@ -209,6 +209,38 @@ class TestZeroWarmCompiles:
         assert cm.compiles.value - before == 0
         assert np.all(np.isfinite(l1)) and np.all(np.isfinite(l2))
 
+    def test_zero1_out_shardings_pinned_no_drift(self):
+        """ISSUE 10 satellite: under MeshLayout(zero_stage=1) the staged
+        step's updated params must come OUT replicated (the declared spec),
+        not drift to fsdp-sharded via GSPMD propagation from the sharded
+        moments — the drift cost one extra compile on every second
+        dispatch."""
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            get_compile_manager,
+        )
+
+        net = MultiLayerNetwork(_conf()).init()
+        lo = MeshLayout(data=1, fsdp=4, zero_stage=1, devices=_devices())
+        w = ParallelWrapper(net, layout=lo)
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(2, 16, 16)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, 16))]
+        cm = get_compile_manager()
+        w.fit_on_device(xs, ys, steps=4)  # warm-up: pays the compile
+        # updated params left the program at the DECLARED placement
+        assert tuple(net.params[0]["W"].sharding.spec) == ()
+        # ...while the moments keep their ZeRO-1 fsdp sharding
+        moment_specs = {
+            str(l.sharding.spec)
+            for l in jax.tree_util.tree_leaves(net.opt_state)
+            if hasattr(l, "sharding") and np.ndim(l) == 2}
+        assert any("fsdp" in s for s in moment_specs), moment_specs
+        before = cm.compiles.value
+        losses = w.fit_on_device(xs, ys, steps=4)
+        assert cm.compiles.value - before == 0
+        assert tuple(net.params[0]["W"].sharding.spec) == ()
+        assert np.all(np.isfinite(losses))
+
     def test_signature_separates_shardings(self):
         """Two placements of the same abstract shapes must NOT share an
         executable: the canonical key carries the mesh sharding."""

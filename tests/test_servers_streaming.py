@@ -250,7 +250,33 @@ def test_streaming_route_error_surfaces_and_put_does_not_hang():
         pipeline.stop()
 
 
-def test_streaming_mixed_label_batches_split():
+def test_streaming_stop_drains_source_tail():
+    """ISSUE 10 satellite regression: records the source buffered but the
+    pump had not yet polled were silently dropped by stop(). A producer
+    that puts a NON-DIVISIBLE record count and stops immediately must see
+    every record delivered."""
+    from deeplearning4j_tpu.streaming import QueueSource, Route, StreamingPipeline
+
+    class Collect(Route):
+        def __init__(self):
+            self.rows = 0
+            self.batches = []
+
+        def on_batch(self, features, labels):
+            self.rows += features.shape[0]
+            self.batches.append(features.shape[0])
+
+    source = QueueSource()
+    route = Collect()
+    pipeline = StreamingPipeline(source, [route], batch=8, linger=5.0)
+    pipeline.start()
+    # stop races the pump: most of these 21 records (21 = 2*8 + 5, the
+    # non-divisible tail) are still in the source queue when stop() lands
+    for _ in range(21):
+        source.put(np.ones(3), np.ones(2))
+    pipeline.stop()
+    assert route.rows == 21, route.batches
+    assert sum(route.batches) == 21
     from deeplearning4j_tpu.streaming import QueueSource, Route, StreamingPipeline
 
     class Collect(Route):

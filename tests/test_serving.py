@@ -229,6 +229,90 @@ class TestInferenceService:
         finally:
             svc.stop()
 
+    def test_argmax_requests_coalesce_through_the_batcher(self, rng):
+        """ISSUE 10 satellite: fused-argmax requests dispatched DIRECT
+        before; now they coalesce on their own batcher (never mixed with
+        logits requests) and still return int-only, bit-exact classes."""
+        net = _mlp(seed=23)
+        svc = InferenceService(registry=MetricsRegistry(), max_delay_ms=25)
+        try:
+            svc.register("m", net)
+            svc.warmup("m", np.zeros((1, 5), np.float32), argmax=True)
+            xs = [rng.normal(size=(2, 5)).astype(np.float32)
+                  for _ in range(8)]
+            outs = [None] * len(xs)
+
+            def client(i):
+                outs[i] = np.asarray(svc.predict("m", xs[i], argmax=True))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(xs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = svc.stats()["models"]["m"]
+            # coalesced: strictly fewer dispatches than requests
+            assert stats["batches_total"] < len(xs)
+            assert stats["last_dispatch"]["kind"] == "argmax"
+            assert stats["last_dispatch"]["requests"] >= 2
+            from deeplearning4j_tpu.runtime import inference as _inf
+
+            for x, out in zip(xs, outs):
+                assert np.issubdtype(out.dtype, np.integer)
+                np.testing.assert_array_equal(
+                    out, _inf.mln_output(net, x, argmax=True))
+        finally:
+            svc.stop()
+
+    def test_request_rows_histogram_feeds_max_batch_tuning(self, rng):
+        reg = MetricsRegistry()
+        svc = InferenceService(registry=reg, max_delay_ms=1)
+        try:
+            svc.register("m", _mlp(seed=29))
+            for rows in (1, 2, 2, 5):
+                svc.predict("m", rng.normal(size=(rows, 5)).astype(
+                    np.float32))
+            svc.predict("m", rng.normal(size=(3, 5)).astype(np.float32),
+                        argmax=True)
+            fam = reg.get("dl4jtpu_serve_request_rows")
+            child = fam.labels(model="m")
+            assert child.count == 5  # argmax requests are size-classed too
+            assert child.summary()["sum"] == 1 + 2 + 2 + 5 + 3
+        finally:
+            svc.stop()
+
+    def test_hot_swap_flips_params_without_recompiling(self, rng):
+        """ISSUE 10: the train→serve handoff — a params-pointer flip behind
+        the service lock changes served predictions, keeps executables."""
+        net_a, net_b = _mlp(seed=31), _mlp(seed=37)
+        svc = InferenceService(registry=MetricsRegistry(), max_delay_ms=1)
+        try:
+            svc.register("m", net_a)
+            svc.warmup("m", np.zeros((1, 5), np.float32))
+            x = rng.normal(size=(3, 5)).astype(np.float32)
+            out_a = np.asarray(svc.predict("m", x))
+            cm = get_compile_manager()
+            before = cm.compiles.value
+            svc.hot_swap("m", net=net_b, version=7)
+            out_b = np.asarray(svc.predict("m", x))
+            assert cm.compiles.value - before == 0
+            assert np.abs(out_b - out_a).max() > 0
+            from deeplearning4j_tpu.runtime import inference as _inf
+
+            np.testing.assert_array_equal(out_b, _inf.mln_output(net_b, x))
+            stats = svc.stats()["models"]["m"]
+            assert stats["version"] == 7 and stats["swaps_total"] == 1
+            from deeplearning4j_tpu.telemetry.flight_recorder import (
+                get_flight_recorder,
+            )
+
+            events = [e for e in get_flight_recorder().events
+                      if e["kind"] == "serve_swap"]
+            assert events and events[-1]["version"] == 7
+        finally:
+            svc.stop()
+
     def test_multi_model_tenancy_shares_the_lru(self, rng):
         cm = get_compile_manager()
         svc = InferenceService(registry=MetricsRegistry(), max_delay_ms=1)
