@@ -650,6 +650,53 @@ def bench_mlp_mnist(batch: int = 512, steps: int = 50, warmup: int = 5) -> dict:
     return result
 
 
+def bench_autotune(budget_s: float = None) -> dict:
+    """Closed-loop autopilot A/B (ISSUE 12 acceptance): a short
+    fit-objective search on the bench MLP through the real tuner
+    (roofline-pruned successive halving, compile-pinned trials), then the
+    default and the winning config re-measured at EQUAL fidelity. Reports
+    tuned/default as the gated ratio — the loop only stays green while the
+    autopilot returns configs at least as fast as the hand-picked
+    defaults. Select with BENCH_MODEL=autotune."""
+    import tempfile
+
+    from deeplearning4j_tpu.tune.search import MlpFitWorkload, run_autotune
+
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_AUTOTUNE_BUDGET_S", "75"))
+    workload = MlpFitWorkload()
+    store_path = os.environ.get("DL4JTPU_TUNED_PATH") or os.path.join(
+        tempfile.mkdtemp(prefix="dl4jtpu_tuned_"), "TUNED.json")
+    space = {"train_batch": (32, 256, 512), "stage_window": (2, 4, 8),
+             "telemetry_fetch_every": (10, 50)}
+    search = run_autotune(
+        model="mlp", objective="fit", budget_s=budget_s, space=space,
+        workload=workload, store_path=store_path, fidelities=(1, 2))
+    # equal-fidelity A/B: the search's own rungs ran at mixed fidelity, so
+    # the headline ratio re-measures both configs back to back
+    fid = int(os.environ.get("BENCH_AUTOTUNE_AB_FIDELITY", "2"))
+    default_sps = workload.measure(search.default.config, fid)["value"]
+    tuned_sps = workload.measure(search.best.config, fid)["value"]
+    measured = [t for t in search.trials if t.measured is not None]
+    return {
+        "metric": "autotune_tuned_over_default_ratio",
+        "value": round(tuned_sps / default_sps, 4),
+        "unit": "x",
+        "default_samples_per_sec": round(default_sps, 1),
+        "tuned_samples_per_sec": round(tuned_sps, 1),
+        "best_config": search.best.config,
+        "trials_measured": len(measured),
+        "trials_pruned_by_prior": len(search.pruned),
+        "compiles_in_timed_regions": sum(
+            t.compiles_measured for t in measured),
+        "env_ok": search.env_ok,
+        "tuned_store": search.store_path,
+        "tuned_key": search.key,
+        "search_elapsed_s": round(search.elapsed_s, 1),
+        "memory": _memory_block(),
+    }
+
+
 def bench_ragged(batch: int = 512, tail: int = 196, full_batches: int = 10,
                  stage: int = 4, epochs: int = 4, hidden: int = 1024) -> dict:
     """Ragged-epoch throughput (ISSUE 3 acceptance): every epoch ends in a
@@ -1251,6 +1298,8 @@ def _tpu_child_main() -> int:
         # the forced 4-device CPU mesh, which is the meaningful measurement
         result = bench_shard(batch=_ienv("BENCH_BATCH", 256),
                              steps=_ienv("BENCH_STEPS", 12))
+    elif os.environ.get("BENCH_MODEL") == "autotune":
+        result = bench_autotune()
     elif os.environ.get("BENCH_MODEL") == "attention":
         result = bench_attention(seq=_ienv("BENCH_SEQ", 4096))
         if result["shape"]["seq"] != 4096:
@@ -1389,6 +1438,11 @@ if __name__ == "__main__":
                 # host-side ingest/staging machinery, meaningful on CPU —
                 # the check.sh online gate runs exactly this
                 result = bench_online()
+            elif mode == "autotune":
+                # the autopilot A/B is a RATIO (tuned/default on the same
+                # backend), so the CPU fallback is as meaningful as TPU —
+                # the check.sh autotune gate runs exactly this
+                result = bench_autotune()
             else:
                 result = bench_mlp_mnist()
             # The tunnel was unavailable THIS run; surface the most recent

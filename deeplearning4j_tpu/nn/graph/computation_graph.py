@@ -321,7 +321,10 @@ class ComputationGraph:
                         _tdev.step_stats(loss, grads))
             return new_params, new_opt, new_state, loss
 
-        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        from ...tune.knobs import donation_enabled
+
+        donate = ((0, 1, 2) if jax.default_backend() != "cpu"
+                  and donation_enabled() else ())
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------- on-device multi-step
@@ -403,7 +406,10 @@ class ComputationGraph:
                 return params, opt_state, state, rng, losses, mvecs
             return params, opt_state, state, rng, losses
 
-        donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+        from ...tune.knobs import donation_enabled
+
+        donate = ((0, 1, 2, 3) if jax.default_backend() != "cpu"
+                  and donation_enabled() else ())
         return jax.jit(run, donate_argnums=donate)
 
     @staticmethod
@@ -477,6 +483,9 @@ class ComputationGraph:
         """Compile-ahead for the staged path (see MultiLayerNetwork.warmup);
         arrays may be real data or ``jax.ShapeDtypeStruct`` shells."""
         self.init()
+        from ...tune import store as _tuned
+
+        _tuned.auto_apply(self, "warmup")  # tuned telemetry cadence etc.
         if not isinstance(features, (list, tuple)):
             features = [features]
         if not isinstance(labels, (list, tuple)):
@@ -582,14 +591,17 @@ class ComputationGraph:
             self.staged_step_time = None
         return losses
 
-    def fit(self, data, epochs: int = 1, stage_on_device: int = 0,
+    def fit(self, data, epochs: int = 1,
+            stage_on_device: Optional[int] = None,
             bucketing: bool = True) -> "ComputationGraph":
         """Train (reference: ComputationGraph.fit(MultiDataSet):743).
 
         ``data``: MultiDataSet, DataSet, (x, y) tuple, or an iterator of any.
 
         ``stage_on_device=K``: buffer K batches and run the window as ONE
-        on-device dispatch, double-buffered (see MultiLayerNetwork.fit).
+        on-device dispatch, double-buffered (see MultiLayerNetwork.fit);
+        left unset, a matching TUNED.json staging window auto-applies
+        (explicit values — including 0 — always win).
         With ``bucketing`` (default) ragged/masked batches stay on the
         staged path — trailing partial batches pad up with masked rows,
         variable sequence lengths pad to power-of-two time buckets, and the
@@ -603,6 +615,13 @@ class ComputationGraph:
         self.init()
         if self._train_step is None:
             self._train_step = self._step_callable()
+        from ...tune import store as _tuned
+
+        tuned = _tuned.auto_apply(
+            self, "fit",
+            explicit=() if stage_on_device is None else ("stage_window",))
+        if stage_on_device is None:
+            stage_on_device = int(tuned.get("stage_window", 0))
         stage = int(stage_on_device)
         if stage > 1 and (
             self.conf.backprop_type == "tbptt"

@@ -396,7 +396,10 @@ class MultiLayerNetwork:
                         _tdev.step_stats(loss, grads))
             return new_params, new_opt, new_state, loss
 
-        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        from ..tune.knobs import donation_enabled
+
+        donate = ((0, 1, 2) if jax.default_backend() != "cpu"
+                  and donation_enabled() else ())
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------- on-device multi-step
@@ -477,7 +480,10 @@ class MultiLayerNetwork:
                 return params, opt_state, state, rng, losses, mvecs
             return params, opt_state, state, rng, losses
 
-        donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+        from ..tune.knobs import donation_enabled
+
+        donate = ((0, 1, 2, 3) if jax.default_backend() != "cpu"
+                  and donation_enabled() else ())
         return jax.jit(run, donate_argnums=donate)
 
     def _staged_out_constraint(self):
@@ -552,6 +558,9 @@ class MultiLayerNetwork:
         shells — only shapes/dtypes matter. The compile lands in the same
         cache (and telemetry counters) fit_on_device uses."""
         self.init()
+        from ..tune import store as _tuned
+
+        _tuned.auto_apply(self, "warmup")  # tuned telemetry cadence etc.
         def _shell(a):
             if a is None or isinstance(a, jax.ShapeDtypeStruct):
                 return a
@@ -633,12 +642,18 @@ class MultiLayerNetwork:
             self.staged_step_time = None
         return losses
 
-    def fit(self, data, epochs: int = 1, stage_on_device: int = 0,
+    def fit(self, data, epochs: int = 1,
+            stage_on_device: Optional[int] = None,
             bucketing: bool = True) -> "MultiLayerNetwork":
         """Train (reference: MultiLayerNetwork.fit(DataSetIterator):917).
 
         ``data``: (x, y) tuple, a DataSet, or a DataSetIterator. Iterators are
         auto-wrapped in async prefetch (reference :920-924) unless already async.
+
+        ``stage_on_device`` left unset auto-applies a matching TUNED.json
+        staging window when the autopilot has tuned this model (tune/store.py)
+        and otherwise trains per-batch; an explicit value — including 0 —
+        always wins.
 
         ``stage_on_device=K`` (TPU fast path): buffer K batches, stack them
         in HBM, and run the whole window as ONE dispatch via
@@ -660,6 +675,13 @@ class MultiLayerNetwork:
         self.init()
         if self._train_step is None:
             self._train_step = self._step_callable()
+        from ..tune import store as _tuned
+
+        tuned = _tuned.auto_apply(
+            self, "fit",
+            explicit=() if stage_on_device is None else ("stage_window",))
+        if stage_on_device is None:
+            stage_on_device = int(tuned.get("stage_window", 0))
         stage = int(stage_on_device)
         if stage > 1 and (
             self.conf.backprop_type == "tbptt"

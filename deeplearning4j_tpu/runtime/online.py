@@ -132,7 +132,8 @@ class OnlineTrainer:
     input shift is observability-only unless opted in.
     """
 
-    def __init__(self, net, source, *, batch: int = 32, stage: int = 4,
+    def __init__(self, net, source, *, batch: int = 32,
+                 stage: Optional[int] = None,
                  linger: float = 0.25, flush_idle: Optional[float] = None,
                  name: str = "online",
                  checkpoint_store=None, checkpoint_every_steps: int = 0,
@@ -149,6 +150,22 @@ class OnlineTrainer:
         from ..telemetry import Watchdog, get_registry  # noqa: PLC0415
         from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
 
+        # tuned-config auto-apply (tune/store.py): a matching TUNED.json
+        # entry supplies the staging window / bucket boundaries unless the
+        # caller chose them explicitly — explicit settings always win
+        from ..tune import store as _tuned  # noqa: PLC0415
+
+        tuned = _tuned.auto_apply(net, "online", explicit=[
+            knob for knob, user_set in (
+                ("stage_window", stage is not None),
+                ("bucket_boundaries", time_boundaries is not None),
+            ) if user_set])
+        if stage is None:
+            stage = int(tuned.get("stage_window", 4))
+        if time_boundaries is None:
+            tb = tuned.get("bucket_boundaries")
+            if isinstance(tb, (list, tuple)):
+                time_boundaries = tuple(int(t) for t in tb)
         if int(batch) < 1:
             raise ValueError(f"batch must be >= 1, got {batch}")
         if int(stage) < 2:
@@ -199,6 +216,7 @@ class OnlineTrainer:
         self._last_good_version: Optional[int] = None
         self._steps_since_checkpoint = 0
         self._loss_baseline: Optional[float] = None
+        self._loss_var: Optional[float] = None  # EMA of within-window loss variance
         self._baseline_windows = 0
         self._recent_losses: "deque[float]" = deque(maxlen=self.drift_window)
         self._shift = _ShiftStats()
@@ -458,15 +476,29 @@ class OnlineTrainer:
         if baseline is not None and self._baseline_windows \
                 >= self.drift_min_windows:
             recent = float(np.mean(list(self._recent_losses)[-3:]))
-            limit = self.drift_factor * max(abs(baseline), 1e-6)
+            # adaptive band: the threshold scales with the EMA of the
+            # WITHIN-window loss variance, so benign noise widens the band
+            # instead of tripping it, while a between-window trend (drift)
+            # cannot widen it and still trips. With degenerate variance
+            # (sigma -> 0) the floor reproduces the old static rule
+            # exactly: baseline + (f-1)|baseline| == f * baseline.
+            sigma = (float(np.sqrt(self._loss_var))
+                     if self._loss_var else 0.0)
+            sigma_floor = (max(self.drift_factor - 1.0, 0.0)
+                           / max(self.drift_factor, 1e-6)
+                           * max(abs(baseline), 1e-6))
+            limit = baseline + self.drift_factor * max(sigma, sigma_floor)
             if recent > limit:
                 self._handle_anomaly(
                     "loss-drift", recent, limit,
-                    f"online loss trend {recent:.4g} exceeds "
-                    f"{self.drift_factor}x the healthy baseline "
-                    f"{baseline:.4g}")
+                    f"online loss trend {recent:.4g} exceeds the adaptive "
+                    f"band {limit:.4g} (baseline {baseline:.4g} + "
+                    f"{self.drift_factor} x sigma {max(sigma, sigma_floor):.4g})")
                 return
-        # healthy window: fold into the baseline EMA
+        # healthy window: fold into the baseline + noise-variance EMAs
+        wvar = float(np.var(losses))
+        self._loss_var = (wvar if self._loss_var is None
+                          else 0.9 * self._loss_var + 0.1 * wvar)
         self._loss_baseline = (mean if baseline is None
                                else 0.9 * baseline + 0.1 * mean)
         self._baseline_windows += 1
@@ -753,6 +785,8 @@ class OnlineTrainer:
             "swaps_total": self._m_swaps.n,
             "ingest_samples_per_sec": self._rate_value,
             "loss_baseline": self._loss_baseline,
+            "loss_sigma": (None if self._loss_var is None
+                           else float(np.sqrt(self._loss_var))),
             "recent_window_losses": [round(x, 6)
                                      for x in self._recent_losses],
             "last_anomaly": self._last_anomaly,

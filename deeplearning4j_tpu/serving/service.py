@@ -26,6 +26,7 @@ exposes over HTTP (POST ``/serving/predict``, POST ``/serving/rnn``, GET
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -33,7 +34,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .batcher import MicroBatcher
+from .batcher import MAX_BATCH_ENV, MAX_DELAY_ENV, MicroBatcher
 from .decode import DecodeServer
 
 __all__ = ["InferenceService", "get_service", "set_service"]
@@ -156,6 +157,24 @@ class InferenceService:
         net.init()
         if layout is not None:
             layout.apply(net)
+        # tuned-config auto-apply (tune/store.py): a matching TUNED.json
+        # entry supplies the batcher knobs — unless the user already chose
+        # them, by service ctor arg OR by process env (explicit wins)
+        from ..tune import store as _tuned  # noqa: PLC0415
+
+        tuned = _tuned.auto_apply(net, "serve", explicit=[
+            knob for knob, user_set in (
+                ("serve_max_delay_ms",
+                 self.max_delay_ms is not None
+                 or os.environ.get(MAX_DELAY_ENV) is not None),
+                ("serve_max_batch",
+                 self.max_batch is not None
+                 or os.environ.get(MAX_BATCH_ENV) is not None),
+            ) if user_set])
+        delay_ms = (self.max_delay_ms if self.max_delay_ms is not None
+                    else tuned.get("serve_max_delay_ms"))
+        rows_cap = (self.max_batch if self.max_batch is not None
+                    else tuned.get("serve_max_batch"))
         entry_holder: list = []
 
         def dispatch(feats: np.ndarray) -> np.ndarray:
@@ -166,12 +185,12 @@ class InferenceService:
 
         batcher = MicroBatcher(
             dispatch,
-            max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
+            max_delay_ms=delay_ms, max_batch=rows_cap,
             on_batch=lambda **kw: self._record_batch(name, **kw),
             on_request=lambda s: self._record_request(name, s))
         argmax_batcher = MicroBatcher(
             dispatch_argmax,
-            max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
+            max_delay_ms=delay_ms, max_batch=rows_cap,
             on_batch=lambda **kw: self._record_batch(name, kind="argmax",
                                                      **kw),
             on_request=lambda s: self._record_request(name, s))

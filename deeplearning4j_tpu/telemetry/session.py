@@ -35,10 +35,12 @@ class Telemetry:
     # staticmethod indirection so tests can count host fetches
     _fetch = staticmethod(np.asarray)
 
+    DEFAULT_FETCH_EVERY = 10
+
     def __init__(
         self,
         registry: Optional[MetricsRegistry] = None,
-        fetch_every: int = 10,
+        fetch_every: Optional[int] = None,
         watchdog: Optional[Watchdog] = None,
         prefix: str = "dl4jtpu_train",
         flight_recorder=None,
@@ -47,7 +49,12 @@ class Telemetry:
         from .flight_recorder import get_flight_recorder  # noqa: PLC0415
 
         self.registry = registry if registry is not None else get_registry()
-        self.fetch_every = max(1, int(fetch_every))
+        # None = library default AND tunable: the tuned-config auto-apply
+        # (tune/store.py) may retarget the cadence; an explicit value is a
+        # user setting and always wins
+        self.fetch_every_explicit = fetch_every is not None
+        self.fetch_every = max(1, int(
+            self.DEFAULT_FETCH_EVERY if fetch_every is None else fetch_every))
         self.watchdog = watchdog
         # black box: step rows ring into the flight recorder at fetch time,
         # and the recorder rides the watchdog as a sink so an anomaly dumps

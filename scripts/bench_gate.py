@@ -16,13 +16,27 @@ Usage:
     --tolerance F fail when value < F * baseline (default 0.75, env
                   BENCH_GATE_TOLERANCE — generous because CPU-fallback
                   numbers jitter; the r03->r04 drop was 0.70)
-    --refresh     explicitly move the stored baselines to this run's values
-                  (the ONLY way an existing baseline changes)
+    --refresh [ANCHOR]
+                  move stored baselines to this run's values (the ONLY way
+                  an existing baseline changes). Bare ``--refresh`` moves
+                  every gated metric; ``--refresh <anchor>`` moves just that
+                  one (repeatable) — so re-anchoring a noisy serve number no
+                  longer silently re-anchors mlp too.
+
+Baseline entries are either a bare number or an object carrying a
+per-anchor tolerance override (serve/online anchors are noisier than mlp)::
+
+    {"mlp_mnist_train_samples_per_sec": 5132.6,
+     "serve_offered_load_samples_per_sec": {"value": 20000.0,
+                                            "tolerance": 0.6}}
 
 Semantics, chosen to be safe in CI:
 - a metric with no stored baseline is RECORDED (first run anchors) and passes;
 - a metric at/above its band passes and the baseline is left untouched —
   improvements do NOT auto-ratchet (refresh deliberately);
+- per-anchor tolerance (the object form) wins over --tolerance/env;
+- refresh preserves the entry's shape — an object entry keeps its tolerance
+  override, only its value moves;
 - ``bench_error`` / ``bench_skip`` lines fail the gate (a bench that cannot
   measure must not look green);
 - a malformed baseline file is treated as empty rather than crashing the CI.
@@ -39,6 +53,8 @@ REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.environ.get(
     "BENCH_BASELINE_PATH", os.path.join(REPO_DIR, "BENCH_BASELINE.json"))
 DEFAULT_TOLERANCE = float(os.environ.get("BENCH_GATE_TOLERANCE", "0.75"))
+
+REFRESH_ALL = True  # sentinel: refresh every metric (bare --refresh)
 
 
 def load_baselines(path: str) -> dict:
@@ -58,6 +74,29 @@ def save_baselines(path: str, data: dict) -> None:
     os.replace(tmp, path)
 
 
+def baseline_value(entry):
+    """A stored baseline is a number, or {"value": x, "tolerance": t}."""
+    if isinstance(entry, dict):
+        entry = entry.get("value")
+    return entry if isinstance(entry, (int, float)) else None
+
+
+def baseline_tolerance(entry, default: float) -> float:
+    if isinstance(entry, dict):
+        tol = entry.get("tolerance")
+        if isinstance(tol, (int, float)) and 0 < tol <= 1:
+            return float(tol)
+    return float(default)
+
+
+def _refreshed(entry, value):
+    """New stored form after a refresh: object entries keep their shape
+    (and their tolerance override), bare numbers stay bare."""
+    if isinstance(entry, dict):
+        return {**entry, "value": value}
+    return value
+
+
 def iter_results(paths):
     for p in paths:
         text = sys.stdin.read() if p == "-" else open(p).read()
@@ -73,12 +112,21 @@ def iter_results(paths):
                 yield parsed
 
 
-def gate(results, baselines: dict, tolerance: float, refresh: bool):
-    """Returns (ok, messages, new_baselines)."""
+def gate(results, baselines: dict, tolerance: float, refresh):
+    """Returns (ok, messages, new_baselines).
+
+    ``refresh``: falsy = never move baselines; ``REFRESH_ALL`` (or True) =
+    move every gated metric; a set/sequence of metric names = move exactly
+    those anchors and leave the rest untouched.
+    """
     ok = True
     messages = []
     new = dict(baselines)
     seen_any = False
+    refresh_names = None
+    if refresh and refresh is not REFRESH_ALL and refresh is not True:
+        refresh_names = set(refresh)
+    seen_names = set()
     for r in results:
         metric, value = r["metric"], r.get("value")
         if metric in ("bench_error", "bench_skip") or not isinstance(
@@ -88,25 +136,34 @@ def gate(results, baselines: dict, tolerance: float, refresh: bool):
                             f"({r.get('error', r.get('unit', '?'))})")
             continue
         seen_any = True
-        base = baselines.get(metric)
-        if not isinstance(base, (int, float)) or base <= 0:
-            new[metric] = value
+        seen_names.add(metric)
+        entry = baselines.get(metric)
+        base = baseline_value(entry)
+        if base is None or base <= 0:
+            new[metric] = _refreshed(entry, value) if isinstance(
+                entry, dict) else value
             messages.append(f"ANCHOR {metric}: {value} recorded as baseline")
             continue
-        floor = tolerance * base
+        tol = baseline_tolerance(entry, tolerance)
+        floor = tol * base
         if value < floor:
             ok = False
             messages.append(
                 f"FAIL {metric}: {value} < {floor:.1f} "
-                f"({tolerance:.0%} of baseline {base}) — "
+                f"({tol:.0%} of baseline {base}) — "
                 f"regression; fix it or re-anchor with --refresh")
         else:
             messages.append(
                 f"OK {metric}: {value} vs baseline {base} "
                 f"({value / base:.2f}x, floor {floor:.1f})")
-        if refresh:
-            new[metric] = value
+        if refresh and (refresh_names is None or metric in refresh_names):
+            new[metric] = _refreshed(entry, value)
             messages.append(f"REFRESH {metric}: baseline -> {value}")
+    if refresh_names:
+        for name in sorted(refresh_names - seen_names):
+            ok = False
+            messages.append(f"FAIL --refresh {name}: no such metric in "
+                            "this run's results")
     if not seen_any and ok:
         ok = False
         messages.append("FAIL: no parseable bench metric found")
@@ -118,12 +175,20 @@ def main(argv=None) -> int:
     ap.add_argument("results", nargs="+", metavar="RESULT")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE)
-    ap.add_argument("--refresh", action="store_true")
+    ap.add_argument("--refresh", nargs="?", const="__ALL__", default=None,
+                    action="append", metavar="ANCHOR",
+                    help="bare: refresh every metric; with a name: refresh "
+                         "just that anchor (repeatable)")
     args = ap.parse_args(argv)
+
+    refresh = None
+    if args.refresh:
+        refresh = (REFRESH_ALL if "__ALL__" in args.refresh
+                   else set(args.refresh))
 
     baselines = load_baselines(args.baseline)
     ok, messages, new = gate(iter_results(args.results), baselines,
-                             args.tolerance, args.refresh)
+                             args.tolerance, refresh)
     for m in messages:
         print(m)
     if new != baselines:

@@ -16,7 +16,7 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ 
 echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
 
-echo "== dl4jtpu-check: compile/bucketing/serving/layout/online modules held to --fail-on warning"
+echo "== dl4jtpu-check: compile/bucketing/serving/layout/online/tune modules held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/runtime/compile_manager.py \
     deeplearning4j_tpu/runtime/inference.py \
@@ -26,6 +26,7 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/serving/ \
     deeplearning4j_tpu/parallel/layout.py \
     deeplearning4j_tpu/analysis/shard_flow.py \
+    deeplearning4j_tpu/tune/ \
     --fail-on warning
 
 echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
@@ -202,8 +203,13 @@ for name, lo in layouts.items():
     assert findings == [], (name, [f.format_human() for f in findings])
 
 # param+grad+opt ≈ 4 × 7.2 MiB ≈ 29 MiB > the 24 MiB synthetic limit;
-# fsdp=4 + bf16 storage lands the per-device share well under it
-os.environ["DL4JTPU_HBM_LIMIT_BYTES"] = str(24 << 20)
+# fsdp=4 + bf16 storage lands the per-device share well under it.
+# DL4JTPU_* mutations go through the restore-on-exit scope, never raw
+# os.environ writes (tune/knobs.py is the one sanctioned path).
+from deeplearning4j_tpu.tune.knobs import EnvScope
+
+_hbm_scope = EnvScope()
+_hbm_scope.set("DL4JTPU_HBM_LIMIT_BYTES", 24 << 20)
 try:
     net.preflight(32)
     raise SystemExit("unsharded preflight unexpectedly fit the limit")
@@ -233,7 +239,8 @@ if fam is not None:  # family key = label-value tuple in ("rule",) order
     dt008 = sum(child.value for key, child in fam._items()
                 if key and key[0] == "DT008")
 assert dt008 == 0, f"{dt008} DT008 finding(s) from the layout self-scan"
-del os.environ["DL4JTPU_HBM_LIMIT_BYTES"]
+_hbm_scope.restore()
+assert "DL4JTPU_HBM_LIMIT_BYTES" not in os.environ
 print(f"mesh-layout self-scan OK: {len(layouts)} layouts DT008-clean, "
       f"preflight {msg.split(';')[0][:60]!r} -> fsdp per-device "
       f"{per_dev >> 20} MiB fits, trained sharded bf16 to finite loss, "
@@ -487,6 +494,73 @@ print(f"online self-scan OK: {summary['records']} records at "
       f"{len(summary['flight_bundles'])} flight bundle(s)")
 PY
 
+echo "== autopilot self-scan: short mlp search, env bit-identical, tuned config auto-applies"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 12 acceptance smoke: a short real autotune over a tiny MLP must
+# (1) finish with a measured winner no worse than the default within noise,
+# (2) pay ZERO compiles inside any timed trial region, (3) leave os.environ
+# bit-identical to the pre-search snapshot, (4) persist TUNED.json, and
+# (5) prove the startup half of the loop: a FRESH InferenceService.register
+# of a matching model picks the tuned batcher knobs up, counted by
+# dl4jtpu_tuned_config_applied_total.
+import os
+import tempfile
+
+from deeplearning4j_tpu.tune import TunedStore, run_autotune, scoped_env
+from deeplearning4j_tpu.tune import store as tuned_store
+from deeplearning4j_tpu.tune.search import MlpFitWorkload
+
+tuned_path = os.path.join(
+    tempfile.mkdtemp(prefix="dl4jtpu_check_tuned_"), "TUNED.json")
+with scoped_env(DL4JTPU_TUNED_PATH=tuned_path):
+    env_before = dict(os.environ)
+    wl = MlpFitWorkload(hidden=64, features=32, classes=8)
+    result = run_autotune(
+        workload=wl, budget_s=45.0, rungs=1, fidelities=(2,),
+        space={"train_batch": (16, 64, 128), "stage_window": (2, 4)},
+        log=lambda m: print(f"  {m}"))
+    assert dict(os.environ) == env_before, "search leaked env state"
+    assert result.env_ok
+    default, best = result.default.measured, result.best.measured
+    assert default and default > 0, "default config was never measured"
+    assert best >= 0.8 * default, \
+        f"tuned {best:.1f} worse than default {default:.1f} beyond noise"
+    assert all(t.compiles_measured == 0 for t in result.trials
+               if t.measured is not None), "compile inside a timed region"
+    entry = TunedStore(tuned_path).get(wl.key())
+    assert entry and "train_batch" in entry["config"], entry
+
+    # startup half: seed serve knobs under the SAME key, register fresh
+    from deeplearning4j_tpu.serving import InferenceService
+    from deeplearning4j_tpu.telemetry import MetricsRegistry, get_registry
+
+    assert os.environ.get("DL4JTPU_SERVE_MAX_DELAY_MS") is None
+    serve_net = wl._build_net("float32")  # serve signature differs from the
+    #                                       bf16 fit net: key off THIS model
+    TunedStore(tuned_path).put(
+        tuned_store.key_for(serve_net),
+        {"serve_max_delay_ms": 0.5, "serve_max_batch": 32},
+        objective="serve")
+    counter = get_registry().counter(
+        "dl4jtpu_tuned_config_applied_total",
+        "tuned-config knobs auto-applied at startup, by context",
+        labelnames=("context",)).labels(context="serve")
+    before = counter.value
+    service = InferenceService(registry=MetricsRegistry())
+    service.register("autopilot", serve_net)
+    batcher = service.stats()["models"]["autopilot"]["batcher"]
+    assert batcher["max_delay_ms"] == 0.5 and batcher["max_batch"] == 32, \
+        batcher
+    assert counter.value == before + 2, (before, counter.value)
+    service.unregister("autopilot")
+assert "DL4JTPU_TUNED_PATH" not in os.environ or \
+    os.environ["DL4JTPU_TUNED_PATH"] != tuned_path
+print(f"autopilot self-scan OK: {len([t for t in result.trials if t.measured is not None])} "
+      f"measured trial(s), {len(result.pruned)} prior-pruned, tuned/default "
+      f"{best / default:.2f}x, 0 timed-region compiles, env restored, "
+      f"auto-apply counted +2")
+PY
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
@@ -551,6 +625,27 @@ for name, variant in d["variants"].items():
     assert match.get("ok"), (name, match.get("problems"), col)
     print(f"census parity gate OK [{name}]: predicted/measured byte ratio "
           f"{match['total_ratio']}")
+PY
+
+echo "== bench regression gate (autotune mode vs BENCH_BASELINE.json)"
+rm -f /tmp/_bench_gate_autotune.json
+BENCH_FORCE_CPU=1 BENCH_MODEL=autotune BENCH_DEADLINE_S=240 \
+    BENCH_AUTOTUNE_BUDGET_S=60 python bench.py | tail -1 \
+    > /tmp/_bench_gate_autotune.json
+python scripts/bench_gate.py /tmp/_bench_gate_autotune.json
+python - <<'PY'
+# ISSUE 12 acceptance: the tuned-vs-default ratio is measured at equal
+# fidelity with zero compiles in timed regions and a bit-identical env
+import json
+
+d = json.load(open("/tmp/_bench_gate_autotune.json"))
+assert d.get("env_ok"), d
+assert d.get("compiles_in_timed_regions") == 0, d
+assert d.get("tuned_key"), d
+print(f"autotune gate OK: tuned/default {d['value']}x "
+      f"(default {d['default_samples_per_sec']}, tuned "
+      f"{d['tuned_samples_per_sec']} samples/sec), best {d['best_config']}, "
+      f"key {d['tuned_key']}")
 PY
 
 echo "== tier-1 tests"

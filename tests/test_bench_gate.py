@@ -1,0 +1,166 @@
+"""Bench-gate tests (ISSUE 12 satellite): per-anchor tolerance overrides in
+BENCH_BASELINE.json (object entries beat the global --tolerance) and the
+single-anchor ``--refresh <name>`` flow that re-anchors one noisy metric
+without silently moving the others."""
+
+import json
+
+import pytest
+
+from scripts.bench_gate import (
+    REFRESH_ALL,
+    baseline_tolerance,
+    baseline_value,
+    gate,
+    main,
+)
+
+
+def _r(metric, value, **extra):
+    return {"metric": metric, "value": value, **extra}
+
+
+# ----------------------------------------------------------- entry parsing
+def test_entry_forms():
+    assert baseline_value(100.0) == 100.0
+    assert baseline_value({"value": 100.0, "tolerance": 0.6}) == 100.0
+    assert baseline_value({"tolerance": 0.6}) is None
+    assert baseline_value("nope") is None
+    assert baseline_tolerance(100.0, 0.75) == 0.75
+    assert baseline_tolerance({"value": 1, "tolerance": 0.6}, 0.75) == 0.6
+    # out-of-range overrides fall back to the default
+    assert baseline_tolerance({"value": 1, "tolerance": 0.0}, 0.75) == 0.75
+    assert baseline_tolerance({"value": 1, "tolerance": 1.5}, 0.75) == 0.75
+
+
+# ------------------------------------------------------------------- gating
+def test_per_anchor_tolerance_beats_global():
+    baselines = {
+        "noisy_metric": {"value": 100.0, "tolerance": 0.5},
+        "tight_metric": 100.0,
+    }
+    # 60 is 0.6x: fails the global 0.75 band but passes noisy's own 0.5
+    ok, msgs, new = gate(
+        [_r("noisy_metric", 60.0), _r("tight_metric", 80.0)],
+        baselines, tolerance=0.75, refresh=None)
+    assert ok
+    assert new == baselines
+    # the same 60 on the TIGHT metric fails
+    ok, msgs, _ = gate([_r("tight_metric", 60.0)], baselines,
+                       tolerance=0.75, refresh=None)
+    assert not ok
+    assert any("FAIL tight_metric" in m for m in msgs)
+
+
+def test_first_run_anchors_and_passes():
+    ok, msgs, new = gate([_r("fresh_metric", 42.0)], {}, 0.75, None)
+    assert ok
+    assert new["fresh_metric"] == 42.0
+    assert any(m.startswith("ANCHOR fresh_metric") for m in msgs)
+
+
+def test_improvement_does_not_auto_ratchet():
+    baselines = {"m": 100.0}
+    ok, _, new = gate([_r("m", 500.0)], baselines, 0.75, None)
+    assert ok
+    assert new["m"] == 100.0  # refresh is deliberate, never implicit
+
+
+def test_refresh_all_moves_every_metric():
+    baselines = {"a": 100.0, "b": {"value": 200.0, "tolerance": 0.6}}
+    ok, _, new = gate([_r("a", 110.0), _r("b", 190.0)], baselines,
+                      0.75, REFRESH_ALL)
+    assert ok
+    assert new["a"] == 110.0
+    # object entries keep their shape (tolerance override survives)
+    assert new["b"] == {"value": 190.0, "tolerance": 0.6}
+
+
+def test_single_anchor_refresh_leaves_others():
+    baselines = {"a": 100.0, "b": {"value": 200.0, "tolerance": 0.6}}
+    ok, msgs, new = gate([_r("a", 110.0), _r("b", 190.0)], baselines,
+                         0.75, {"b"})
+    assert ok
+    assert new["a"] == 100.0  # untouched
+    assert new["b"] == {"value": 190.0, "tolerance": 0.6}
+    assert any(m.startswith("REFRESH b") for m in msgs)
+    assert not any(m.startswith("REFRESH a") for m in msgs)
+
+
+def test_unknown_refresh_anchor_fails():
+    ok, msgs, _ = gate([_r("a", 110.0)], {"a": 100.0}, 0.75, {"typo_name"})
+    assert not ok
+    assert any("FAIL --refresh typo_name" in m for m in msgs)
+
+
+def test_bench_error_lines_fail():
+    ok, msgs, _ = gate([_r("bench_error", None, error="boom")],
+                       {}, 0.75, None)
+    assert not ok
+
+
+def test_empty_results_fail():
+    ok, msgs, _ = gate([], {"a": 1.0}, 0.75, None)
+    assert not ok
+
+
+# ------------------------------------------------------------ CLI plumbing
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(o) for o in (
+        obj if isinstance(obj, list) else [obj])) + "\n")
+    return str(p)
+
+
+def test_main_gates_and_persists_anchor(tmp_path):
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps(
+        {"m": {"value": 100.0, "tolerance": 0.5}}))
+    results = _write(tmp_path, "r.json", _r("m", 60.0))
+    rc = main([results, "--baseline", str(baseline), "--tolerance", "0.9"])
+    assert rc == 0  # per-anchor 0.5 beat the CLI 0.9
+    assert json.loads(baseline.read_text())["m"]["value"] == 100.0
+
+
+def test_main_single_anchor_refresh(tmp_path):
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps(
+        {"a": 100.0, "b": {"value": 200.0, "tolerance": 0.6}}))
+    results = _write(tmp_path, "r.json", [_r("a", 111.0), _r("b", 222.0)])
+    rc = main([results, "--baseline", str(baseline), "--refresh", "b"])
+    assert rc == 0
+    data = json.loads(baseline.read_text())
+    assert data["a"] == 100.0
+    assert data["b"] == {"value": 222.0, "tolerance": 0.6}
+
+
+def test_main_bare_refresh_moves_all(tmp_path):
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps({"a": 100.0, "b": 200.0}))
+    results = _write(tmp_path, "r.json", [_r("a", 111.0), _r("b", 222.0)])
+    rc = main([results, "--baseline", str(baseline), "--refresh"])
+    assert rc == 0
+    data = json.loads(baseline.read_text())
+    assert data == {"a": 111.0, "b": 222.0}
+
+
+def test_main_regression_exits_nonzero(tmp_path):
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps({"a": 100.0}))
+    results = _write(tmp_path, "r.json", _r("a", 10.0))
+    assert main([results, "--baseline", str(baseline)]) == 1
+
+
+def test_shipped_baseline_file_parses():
+    """The repo's own BENCH_BASELINE.json must stay loadable and every
+    entry must be a valid bare-number or object form."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_BASELINE.json")) as f:
+        data = json.load(f)
+    assert data, "shipped baseline must not be empty"
+    for name, entry in data.items():
+        v = baseline_value(entry)
+        assert v is not None and v > 0, name
+        assert 0 < baseline_tolerance(entry, 0.75) <= 1, name

@@ -472,8 +472,11 @@ class TestBenchGate:
         assert g.main([str(res), "--baseline", str(base)]) == 0
         res.write_text(json.dumps(self._result(1000.0)) + "\n")
         assert g.main([str(res), "--baseline", str(base)]) == 1
-        # repo baseline file exists and is gate-parseable
+        # repo baseline file exists and every entry is gate-parseable
+        # (bare number or {"value": x, "tolerance": t} override form)
         repo_base = g.load_baselines(os.path.join(REPO,
                                                   "BENCH_BASELINE.json"))
-        assert repo_base and all(isinstance(v, (int, float))
-                                 for v in repo_base.values())
+        assert repo_base and all(
+            isinstance(g.baseline_value(v), (int, float))
+            and 0 < g.baseline_tolerance(v, 0.75) <= 1
+            for v in repo_base.values())

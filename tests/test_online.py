@@ -335,6 +335,63 @@ class TestDriftAndRollback:
         assert not trainer.paused  # default policy auto-resumes
         assert flight.dumps
 
+    def test_adaptive_band_tolerates_heavy_tailed_noise(self, flight,
+                                                        tmp_path):
+        """Regression for the static-multiplier rule: a converged model with
+        heavy-tailed per-example loss (mean ~.005, one mild outlier per
+        window) hits a single hard-example window (mean .05). The old rule
+        ``recent > factor * baseline`` fires on that window (.02 > 3 x .005
+        = .015); the adaptive band scales with the EMA of the WITHIN-window
+        variance the calm windows already exhibited, so it stays healthy."""
+        store = CheckpointStore(str(tmp_path / "ckpt"),
+                                registry=MetricsRegistry())
+        trainer, _, _, net = _make(name="t-noise", checkpoint_store=store,
+                                   drift_factor=3.0, drift_min_windows=3)
+        info = store.save(net)
+        trainer._last_good_version = info.version
+        calm = np.array([0.0, 0.0, 0.0, 0.02])   # mean .005, std ~.0087
+        hard = np.array([0.0, 0.0, 0.0, 0.2])    # mean .05: one hard example
+        for _ in range(6):
+            trainer._check_window_health(calm)
+        assert trainer.stats()["loss_sigma"] == pytest.approx(
+            float(np.std(calm)), rel=1e-6)
+        baseline = trainer._loss_baseline
+        # prove this scenario is a true distinguisher: the OLD static rule
+        # would have flagged the hard window (trend .02 > 3 x baseline)
+        old_limit = trainer.drift_factor * baseline
+        recent_with_hard = float(np.mean([baseline, baseline, np.mean(hard)]))
+        assert recent_with_hard > old_limit
+        trainer._check_window_health(hard)
+        for _ in range(4):
+            trainer._check_window_health(calm)
+        assert trainer.stats()["rollbacks_total"] == 0
+        assert trainer.stats()["anomalies"] == {}
+
+    def test_adaptive_band_still_catches_slow_drift(self, flight, tmp_path):
+        """A genuine distribution shift moves every example together: window
+        means creep up 1.4x per window while the per-window spread stays
+        flat. The trend cannot widen the within-window band, so the
+        detector fires within a bounded number of windows."""
+        store = CheckpointStore(str(tmp_path / "ckpt"),
+                                registry=MetricsRegistry())
+        trainer, _, _, net = _make(name="t-creep", checkpoint_store=store,
+                                   drift_factor=3.0, drift_min_windows=3)
+        info = store.save(net)
+        trainer._last_good_version = info.version
+        spread = np.array([-0.01, 0.0, 0.0, 0.01])
+        for _ in range(4):
+            trainer._check_window_health(1.0 + spread)
+        level, fired_at = 1.0, None
+        for k in range(30):
+            level *= 1.4
+            trainer._check_window_health(level + spread)
+            if trainer.stats()["anomalies"].get("loss-drift"):
+                fired_at = k
+                break
+        assert fired_at is not None, "slow drift never tripped the band"
+        assert fired_at <= 15
+        assert trainer.stats()["rollbacks_total"] == 1
+
     def test_pause_on_policy_needs_explicit_resume(self, flight, tmp_path):
         store = CheckpointStore(str(tmp_path / "ckpt"),
                                 registry=MetricsRegistry())
