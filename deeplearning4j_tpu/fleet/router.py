@@ -1,0 +1,605 @@
+"""Fleet router: spawn, supervise and front N serving workers.
+
+The router is deliberately thin — it never imports the model, never
+touches jax. It owns three loops:
+
+- **supervision**: each worker is a real OS process (spawned with the
+  shared forced-CPU env recipe, ``utils.subproc.forced_cpu_env``, unless
+  the deployment passes its own env with per-worker accelerator
+  visibility). A worker that dies or stops answering ``/healthz`` is
+  respawned with exponential backoff; a respawned worker warm-boots from
+  the bundle, so the fleet's compiled-program guarantee survives churn.
+- **routing**: POST ``/predict`` proxies to the alive, ready,
+  not-rolling worker with the least outstanding requests. A worker-side
+  admission shed (429) propagates to the client with its Retry-After;
+  when EVERY worker is saturated past ``shed_outstanding`` the router
+  sheds at the front door without burdening workers further.
+- **rollout**: when the CheckpointStore publishes a newer version, the
+  router rolls it across the fleet one worker at a time — take the
+  worker out of rotation, wait for its outstanding requests to land,
+  POST ``/swap``, put it back. No restarts, no recompiles (hot_swap is
+  a pointer flip); clients only ever see version N or N+1 responses,
+  never a torn mix.
+
+``/api/fleet`` aggregates per-worker liveness/version/queue depth and
+merges the workers' bounded latency rings into EXACT fleet-wide
+p50/p99; ``/metrics`` exposes the router's own ``dl4jtpu_fleet_*``
+series. In-process routers register process-globally
+(:func:`get_fleet_routers`) so ``ui/server.py`` can surface them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.subproc import forced_cpu_env
+from .worker import READY_SENTINEL
+
+__all__ = ["FleetRouter", "get_fleet_routers", "main"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class WorkerHandle:
+    """Router-side state for one supervised worker process."""
+
+    def __init__(self, wid: int):
+        self.wid = wid
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.alive = False
+        self.ready = False
+        self.rolling = False  # out of rotation for a version swap
+        self.version = 0
+        self.queue_depth = 0
+        self.outstanding = 0
+        self.respawns = 0
+        self.backoff_s = 0.0
+        self.next_spawn_at = 0.0
+        self.latency_samples: List[float] = []
+        self.last_health: dict = {}
+        self.lock = threading.Lock()
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.wid,
+            "pid": self.proc.pid if self.proc else None,
+            "port": self.port,
+            "alive": self.alive,
+            "ready": self.ready,
+            "rolling": self.rolling,
+            "version": self.version,
+            "queue_depth": self.queue_depth,
+            "outstanding": self.outstanding,
+            "respawns": self.respawns,
+            "compiles_since_ready":
+                self.last_health.get("compiles_since_ready"),
+            "bundle_installed": self.last_health.get("bundle_installed"),
+        }
+
+
+class FleetRouter:
+    def __init__(self, store_dir: str, *, model: str = "default",
+                 workers: int = 2, port: int = 0,
+                 worker_args: Optional[dict] = None,
+                 spawn_env: Optional[dict] = None,
+                 force_cpu: bool = True,
+                 respawn: bool = True,
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 10.0,
+                 poll_s: float = 0.5,
+                 shed_outstanding: int = 64,
+                 boot_timeout_s: float = 120.0,
+                 registry=None):
+        if registry is None:
+            from ..telemetry import get_registry  # noqa: PLC0415
+
+            registry = get_registry()
+        self.registry = registry
+        self.store_dir = str(store_dir)
+        self.model = model
+        self.n_workers = int(workers)
+        self.port = int(port)
+        self.worker_args = dict(worker_args or {})
+        self.spawn_env = spawn_env
+        self.force_cpu = force_cpu
+        self.respawn = respawn
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.poll_s = float(poll_s)
+        self.shed_outstanding = int(shed_outstanding)
+        self.boot_timeout_s = float(boot_timeout_s)
+
+        self.workers: List[WorkerHandle] = [
+            WorkerHandle(i) for i in range(self.n_workers)]
+        self.target_version = 0
+        self.rollouts = 0
+        self.requests_total = 0
+        self.shed_total = 0
+        self.failed_total = 0
+        self._draining = False
+        self._stop = threading.Event()
+        self._route_cv = threading.Condition()
+        self._httpd = None
+
+        self._m_requests = registry.counter(
+            "dl4jtpu_fleet_requests_total",
+            "requests routed to fleet workers, by worker")
+        self._m_shed = registry.counter(
+            "dl4jtpu_fleet_shed_total",
+            "requests shed at the router (fleet saturated or worker 429)")
+        self._m_respawns = registry.counter(
+            "dl4jtpu_fleet_respawns_total",
+            "worker processes respawned after death")
+        self._m_rollouts = registry.counter(
+            "dl4jtpu_fleet_rollouts_total",
+            "rolling version rollouts completed across the fleet")
+        self._m_workers_alive = registry.gauge(
+            "dl4jtpu_fleet_workers_alive", "live, ready fleet workers")
+        self._m_version = registry.gauge(
+            "dl4jtpu_fleet_version", "fleet-wide target serving version")
+
+    # ------------------------------------------------------------ spawn
+    def _spawn_env(self) -> dict:
+        env = (dict(self.spawn_env) if self.spawn_env is not None
+               else (forced_cpu_env(1) if self.force_cpu
+                     else dict(os.environ)))
+        env["PYTHONPATH"] = (_REPO_ROOT + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        return env
+
+    def _worker_cmd(self) -> List[str]:
+        cmd = [sys.executable, "-m", "deeplearning4j_tpu.fleet.worker",
+               "--store", self.store_dir, "--model", self.model,
+               "--port", "0", "--no-watch"]
+        flag_map = {"max_delay_ms": "--max-delay-ms",
+                    "max_batch": "--max-batch",
+                    "max_queue_depth": "--max-queue",
+                    "latency_budget_ms": "--latency-budget-ms",
+                    "poll_s": "--poll-s"}
+        for key, flag in flag_map.items():
+            value = self.worker_args.get(key)
+            if value is not None:
+                cmd += [flag, str(value)]
+        if self.worker_args.get("no_bundle"):
+            cmd.append("--no-bundle")
+        return cmd
+
+    def _spawn(self, handle: WorkerHandle) -> bool:
+        handle.proc = subprocess.Popen(
+            self._worker_cmd(), env=self._spawn_env(), cwd=_REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        # watchdog: readline blocks, so a worker hung in boot is killed at
+        # the deadline (readline then returns EOF and the spawn fails)
+        booted = threading.Event()
+        proc = handle.proc
+
+        def _watchdog():
+            if not booted.wait(self.boot_timeout_s) and proc.poll() is None:
+                proc.kill()
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+        line = ""
+        while True:
+            line = handle.proc.stdout.readline()
+            if not line or line.startswith(READY_SENTINEL):
+                break
+        booted.set()
+        if not line.startswith(READY_SENTINEL):
+            if handle.proc.poll() is None:
+                handle.proc.kill()
+                handle.proc.wait()
+            return False
+        fields = dict(kv.split("=", 1) for kv in line.split()[1:])
+        with handle.lock:
+            handle.port = int(fields["port"])
+            handle.version = int(fields.get("version", 0))
+            handle.alive = True
+            handle.ready = True
+            handle.backoff_s = 0.0
+        # the ready pipe stays open; drain it so the worker never blocks
+        threading.Thread(target=handle.proc.stdout.read,
+                         daemon=True).start()
+        return True
+
+    def start(self) -> "FleetRouter":
+        """Spawn every worker (concurrently — boots overlap), start the
+        supervisor/rollout loop and the HTTP front."""
+        from ..runtime.checkpoint import CheckpointStore  # noqa: PLC0415
+
+        self.store = CheckpointStore(self.store_dir)
+        self.target_version = self.store.latest_version()
+        threads = [threading.Thread(target=self._spawn, args=(h,))
+                   for h in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not any(h.ready for h in self.workers):
+            raise RuntimeError(
+                f"no fleet worker came up within {self.boot_timeout_s}s")
+        self._m_workers_alive.set(
+            sum(1 for h in self.workers if h.ready))
+        self._m_version.set(self.target_version)
+        threading.Thread(target=self._supervise_loop, daemon=True,
+                         name="dl4jtpu-fleet-supervisor").start()
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="dl4jtpu-fleet-router-http").start()
+        _register_router(self)
+        return self
+
+    # -------------------------------------------------------- supervise
+    def _health(self, handle: WorkerHandle) -> Optional[dict]:
+        if handle.port is None:
+            return None
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{handle.port}/healthz",
+                    timeout=5) as resp:
+                return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 - unreachable == unhealthy
+            return None
+
+    def _supervise_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            alive = 0
+            for handle in self.workers:
+                self._check_worker(handle)
+                if handle.ready:
+                    alive += 1
+            self._m_workers_alive.set(alive)
+            if not self._draining:
+                try:
+                    self._maybe_rollout()
+                except Exception:  # noqa: BLE001 - retried next tick
+                    pass
+
+    def _check_worker(self, handle: WorkerHandle) -> None:
+        proc = handle.proc
+        dead = proc is None or proc.poll() is not None
+        if not dead:
+            health = self._health(handle)
+            if health is None:
+                dead = True
+            else:
+                with handle.lock:
+                    handle.last_health = health
+                    handle.version = int(health.get("version") or 0)
+                    handle.queue_depth = int(health.get("queue_depth") or 0)
+                    handle.latency_samples = list(
+                        health.get("latency_samples") or [])
+        if dead and handle.alive:
+            with handle.lock:
+                handle.alive = False
+                handle.ready = False
+                handle.backoff_s = (self.backoff_base_s
+                                    if handle.backoff_s == 0 else
+                                    min(self.backoff_cap_s,
+                                        handle.backoff_s * 2))
+                handle.next_spawn_at = time.monotonic() + handle.backoff_s
+        if (dead and self.respawn and not self._draining
+                and time.monotonic() >= handle.next_spawn_at):
+            if self._spawn(handle):
+                handle.respawns += 1
+                self._m_respawns.inc()
+            else:
+                with handle.lock:
+                    handle.backoff_s = min(self.backoff_cap_s,
+                                           max(self.backoff_base_s,
+                                               handle.backoff_s * 2))
+                    handle.next_spawn_at = (time.monotonic()
+                                            + handle.backoff_s)
+
+    # ---------------------------------------------------------- rollout
+    def _maybe_rollout(self) -> None:
+        latest = self.store.latest_version()
+        if latest <= self.target_version:
+            return
+        self.target_version = latest
+        self._m_version.set(latest)
+        self.roll_to(latest)
+        self.rollouts += 1
+        self._m_rollouts.inc()
+
+    def roll_to(self, version: int, *, settle_timeout_s: float = 30.0) -> None:
+        """Roll ``version`` across the fleet, one worker at a time: out of
+        rotation → outstanding lands → POST /swap → back in rotation. A
+        worker that fails the swap is killed (the supervisor respawns it
+        warm-booted at the new version) so a rollout always converges."""
+        for handle in self.workers:
+            if not handle.ready:
+                continue  # a respawn boots straight at the latest version
+            handle.rolling = True
+            try:
+                deadline = time.monotonic() + settle_timeout_s
+                while handle.outstanding > 0 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                body = json.dumps({"version": int(version)}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{handle.port}/swap", body,
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    swapped = json.loads(resp.read())
+                with handle.lock:
+                    handle.version = int(swapped["version"])
+            except Exception:  # noqa: BLE001 - converge via respawn
+                if handle.proc is not None and handle.proc.poll() is None:
+                    handle.proc.kill()
+            finally:
+                handle.rolling = False
+
+    # ------------------------------------------------------------ route
+    def _pick(self) -> Optional[WorkerHandle]:
+        ready = [h for h in self.workers
+                 if h.ready and h.alive and not h.rolling]
+        if not ready:
+            return None
+        return min(ready, key=lambda h: h.outstanding)
+
+    def route_predict(self, payload: dict) -> tuple:
+        """Returns (http_status, body dict, headers dict)."""
+        if self._draining:
+            return 503, {"error": "fleet draining"}, {}
+        last_error = "no ready worker"
+        for _attempt in range(2):  # one failover retry on a dead worker
+            handle = self._pick()
+            if handle is None:
+                break
+            if handle.outstanding >= self.shed_outstanding:
+                # least-loaded worker is saturated => whole fleet is
+                self.shed_total += 1
+                self._m_shed.inc()
+                retry = round(max(0.05, 0.01 * handle.outstanding), 3)
+                return (429, {"error": "fleet saturated",
+                              "retry_after_s": retry},
+                        {"Retry-After": f"{retry:.3f}"})
+            with handle.lock:
+                handle.outstanding += 1
+            try:
+                body = json.dumps(payload).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{handle.port}/predict", body,
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = json.loads(resp.read())
+                self.requests_total += 1
+                self._m_requests.inc()
+                return 200, out, {}
+            except urllib.error.HTTPError as e:
+                detail = {}
+                try:
+                    detail = json.loads(e.read())
+                except Exception:  # noqa: BLE001
+                    pass
+                if e.code == 429:  # propagate the worker's shed verbatim
+                    self.shed_total += 1
+                    self._m_shed.inc()
+                    headers = {}
+                    if e.headers.get("Retry-After"):
+                        headers["Retry-After"] = e.headers["Retry-After"]
+                    return 429, detail or {"error": "worker shed"}, headers
+                if e.code in (400, 404):
+                    return e.code, detail or {"error": str(e)}, {}
+                last_error = detail.get("error", str(e))
+            except Exception as e:  # noqa: BLE001 - dead worker: fail over
+                last_error = str(e)
+                with handle.lock:
+                    handle.alive = False
+                    handle.ready = False
+            finally:
+                with handle.lock:
+                    handle.outstanding = max(0, handle.outstanding - 1)
+        self.failed_total += 1
+        return 503, {"error": f"no worker served the request "
+                              f"({last_error})"}, {}
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        """The /api/fleet payload: per-worker liveness + merged EXACT
+        percentiles over every worker's bounded latency ring."""
+        merged: List[float] = []
+        for handle in self.workers:
+            merged.extend(handle.latency_samples)
+        return {
+            "store": self.store_dir,
+            "model": self.model,
+            "target_version": self.target_version,
+            "rollouts": self.rollouts,
+            "requests_total": self.requests_total,
+            "shed_total": self.shed_total,
+            "failed_total": self.failed_total,
+            "draining": self._draining,
+            "workers": [h.snapshot() for h in self.workers],
+            "latency_seconds": {
+                "p50": _percentile(merged, 50),
+                "p99": _percentile(merged, 99),
+                "samples": len(merged),
+            },
+        }
+
+    # ------------------------------------------------------------ drain
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Fleet-wide graceful drain: stop admitting at the front, drain
+        every worker (their in-flight requests finish), reap processes."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        ok = True
+        for handle in self.workers:
+            if not handle.alive or handle.port is None:
+                continue
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{handle.port}/drain", b"{}",
+                    {"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:  # noqa: BLE001
+                ok = False
+        for handle in self.workers:
+            while (handle.alive and handle.port is not None
+                   and time.monotonic() < deadline):
+                health = self._health(handle)
+                if health is None or health.get("drained"):
+                    break
+                time.sleep(0.05)
+        return ok
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._draining = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        for handle in self.workers:
+            proc = handle.proc
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        for handle in self.workers:
+            proc = handle.proc
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        _unregister_router(self)
+
+    # ------------------------------------------------------------- http
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body, ctype="application/json",
+                      headers: Optional[dict] = None) -> None:
+                data = (body if isinstance(body, bytes)
+                        else json.dumps(body).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/api/fleet":
+                    self._send(200, router.stats())
+                elif self.path == "/metrics":
+                    self._send(200,
+                               router.registry.prometheus_text().encode(),
+                               "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    self._send(200, {"ready": True,
+                                     "draining": router._draining})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON body"})
+                    return
+                if self.path == "/predict":
+                    code, body, headers = router.route_predict(payload)
+                    self._send(code, body, headers=headers)
+                elif self.path == "/rollout":
+                    version = payload.get(
+                        "version", router.store.latest_version())
+                    router.roll_to(int(version))
+                    router.target_version = max(router.target_version,
+                                                int(version))
+                    self._send(200, {"version": int(version)})
+                elif self.path == "/drain":
+                    ok = router.drain()
+                    self._send(200, {"drained": ok})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+        return Handler
+
+
+# --------------------------------------------------------------- registry
+_ROUTERS: List[FleetRouter] = []
+_ROUTERS_LOCK = threading.Lock()
+
+
+def _register_router(router: FleetRouter) -> None:
+    with _ROUTERS_LOCK:
+        if router not in _ROUTERS:
+            _ROUTERS.append(router)
+
+
+def _unregister_router(router: FleetRouter) -> None:
+    with _ROUTERS_LOCK:
+        if router in _ROUTERS:
+            _ROUTERS.remove(router)
+
+
+def get_fleet_routers() -> List[FleetRouter]:
+    """In-process routers (what ui/server.py's /api/fleet aggregates)."""
+    with _ROUTERS_LOCK:
+        return list(_ROUTERS)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.fleet.router",
+        description="fleet routing front (see docs/serving.md § Fleet)")
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--model", default="default")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--shed-outstanding", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--max-queue", type=int, default=None)
+    ap.add_argument("--latency-budget-ms", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    router = FleetRouter(
+        args.store, model=args.model, workers=args.workers,
+        port=args.port, shed_outstanding=args.shed_outstanding,
+        worker_args={"max_batch": args.max_batch,
+                     "max_delay_ms": args.max_delay_ms,
+                     "max_queue_depth": args.max_queue,
+                     "latency_budget_ms": args.latency_budget_ms})
+    router.start()
+    print(f"FLEET_ROUTER_READY port={router.port} "
+          f"workers={sum(1 for h in router.workers if h.ready)}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,391 @@
+"""Fleet worker: one standalone serving process, warm-booted from a store.
+
+``python -m deeplearning4j_tpu.fleet.worker --store DIR [--model NAME]
+[--port P] [--watch/--no-watch] ...`` boots an
+:class:`~deeplearning4j_tpu.serving.InferenceService` from the latest
+:class:`~deeplearning4j_tpu.runtime.checkpoint.CheckpointStore` version,
+installs the warm-boot bundle (fleet/artifacts.py) and compiles every
+warmup bucket BEFORE reporting ready — so the first live request pays
+**zero backend compiles**, pinned by a process-wide ``jax.monitoring``
+listener whose since-ready count every ``/healthz`` reports.
+
+Lifecycle contract (what the router and the tests rely on):
+
+- stdout emits exactly one ``FLEET_WORKER_READY port=P version=V pid=N``
+  line once warm and listening; nothing is served before it.
+- ``--watch`` (standalone default) polls the store and ``hot_swap``s new
+  versions automatically — a pure params pointer flip, zero recompiles.
+  The router spawns workers with ``--no-watch`` and coordinates the
+  rolling rollout itself via POST ``/swap``.
+- graceful drain (SIGTERM or POST ``/drain``): stop admitting (503),
+  finish every queued + in-flight request, deregister, exit. /healthz
+  keeps answering during the drain so supervisors can watch it land.
+
+HTTP endpoints: POST ``/predict`` ``{features, argmax?}`` → ``{output |
+classes, version}`` (429 + Retry-After when admission sheds, 503 while
+draining/not ready), POST ``/swap`` ``{version?}``, POST ``/drain``,
+GET ``/healthz``, GET ``/metrics``, GET ``/api/worker``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["FleetWorker", "main"]
+
+READY_SENTINEL = "FLEET_WORKER_READY"
+
+
+class _CompileCounter:
+    """Process-wide backend_compile event counter (jax.monitoring
+    listeners cannot be unregistered on this jax, so the worker arms
+    exactly one for its whole life)."""
+
+    def __init__(self):
+        from jax import monitoring  # noqa: PLC0415
+
+        self.count = 0
+        monitoring.register_event_duration_secs_listener(self._on_event)
+
+    def _on_event(self, name, *a, **kw):
+        if "backend_compile" in name:
+            self.count += 1
+
+
+class FleetWorker:
+    def __init__(self, store_dir: str, *, model: str = "default",
+                 port: int = 0, watch: bool = False,
+                 poll_s: float = 0.5,
+                 max_delay_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 latency_budget_ms: Optional[float] = None,
+                 use_bundle: bool = True):
+        self.store_dir = str(store_dir)
+        self.model = model
+        self.port = int(port)
+        self.watch = bool(watch)
+        self.poll_s = float(poll_s)
+        self.max_delay_ms = max_delay_ms
+        self.max_batch = max_batch
+        self.max_queue_depth = max_queue_depth
+        self.latency_budget_ms = latency_budget_ms
+        self.use_bundle = use_bundle
+
+        self.ready = False
+        self.version = 0
+        self.bundle_installed = False
+        self.warmed_buckets = 0
+        self.compiles_at_ready = 0
+        self.requests_total = 0
+        self.shed_total = 0
+        self.started_at = time.time()
+        self._swap_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self.store = None
+        self.service = None
+        self.net = None
+        self._loader = None  # spare net swaps load into (pointer-flip safe)
+        self._counter: Optional[_CompileCounter] = None
+        self._httpd = None
+        self._argmax_warm = False
+
+    # ------------------------------------------------------------- boot
+    def boot(self) -> "FleetWorker":
+        """Restore → install bundle → register → warm → arm counter →
+        listen. Nothing is admitted before this returns."""
+        from ..fleet import artifacts  # noqa: PLC0415
+        from ..runtime.checkpoint import CheckpointStore  # noqa: PLC0415
+        from ..serving import InferenceService, set_service  # noqa: PLC0415
+
+        self._counter = _CompileCounter()
+        self.store = CheckpointStore(self.store_dir)
+
+        # install what we can BEFORE the first jax compile (restore
+        # compiles nothing, but the cache pointer and tuned/calibration
+        # state must precede register()'s auto_apply and warmup)
+        bundle = (artifacts.load_bundle(self.store)
+                  if self.use_bundle else None)
+        if bundle is not None:
+            artifacts.install_bundle(bundle)
+            self.bundle_installed = True
+
+        info = self.store.latest()
+        if info is None:
+            raise FileNotFoundError(
+                f"checkpoint store {self.store_dir!r} holds no versions")
+        self.net = self.store.restore(info.version)
+        self.version = int(info.version)
+        if bundle is None and self.use_bundle:
+            bundle = artifacts.load_bundle(self.store, self.net)
+            if bundle is not None:
+                artifacts.install_bundle(bundle)
+                self.bundle_installed = True
+
+        self.service = InferenceService()
+        set_service(self.service, f"fleet-worker:{self.model}")
+        self.service.register(
+            self.model, self.net,
+            max_delay_ms=self.max_delay_ms, max_batch=self.max_batch,
+            max_queue_depth=self.max_queue_depth,
+            latency_budget_ms=self.latency_budget_ms)
+
+        warmup = dict((bundle or {}).get("warmup") or {})
+        if warmup.get("example_shape"):
+            example = np.zeros(
+                (1, *warmup["example_shape"]),
+                np.dtype(warmup.get("example_dtype", "float32")))
+            self._argmax_warm = bool(warmup.get("argmax", False))
+            self.warmed_buckets = self.service.warmup(
+                self.model, example, argmax=self._argmax_warm,
+                max_rows=warmup.get("max_batch"))
+
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True, name="dl4jtpu-fleet-http").start()
+        if self.watch:
+            threading.Thread(target=self._watch_loop, daemon=True,
+                             name="dl4jtpu-fleet-watch").start()
+        self.compiles_at_ready = self._counter.count
+        self.ready = True
+        return self
+
+    # ------------------------------------------------------------- swap
+    def swap_to(self, version: Optional[int] = None) -> int:
+        """Hot-swap the served model to ``version`` (default: latest).
+        load_into keeps the loader's compile token and abstract shapes,
+        hot_swap is a pointer flip — no restart, no recompile."""
+        with self._swap_lock:
+            target = (self.store.latest_version()
+                      if version is None else int(version))
+            if target == self.version:
+                return self.version
+            if self._loader is None:  # lazily built on the first swap
+                self._loader = self.store.restore(target)
+            else:
+                self.store.load_into(self._loader, target)
+            self.service.hot_swap(
+                self.model, params=self._loader.params,
+                state=self._loader.state, version=target)
+            self.version = target
+            return target
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                if self.store.latest_version() > self.version:
+                    self.swap_to()
+            except Exception:  # noqa: BLE001 - watch must outlive blips
+                pass
+
+    # ------------------------------------------------------------ drain
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting, finish queued + in-flight work, deregister."""
+        ok = self.service.drain(timeout_s=timeout_s)
+        self.service.unregister(self.model)
+        self._drained.set()
+        return ok
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    # ------------------------------------------------------------- http
+    def healthz(self) -> dict:
+        entry_stats = {}
+        if self.service is not None:
+            try:
+                entry_stats = self.service.stats()["models"].get(
+                    self.model) or {}
+            except Exception:  # noqa: BLE001
+                entry_stats = {}
+        compiles = self._counter.count if self._counter else 0
+        lat = entry_stats.get("latency_seconds") or {}
+        return {
+            "ready": self.ready,
+            "draining": (self.service.draining
+                         if self.service is not None else False),
+            "drained": self._drained.is_set(),
+            "model": self.model,
+            "version": self.version,
+            "pid": os.getpid(),
+            "port": self.port,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "bundle_installed": self.bundle_installed,
+            "warmed_buckets": self.warmed_buckets,
+            "compiles_total": compiles,
+            "compiles_since_ready": (compiles - self.compiles_at_ready
+                                     if self.ready else None),
+            "requests_total": self.requests_total,
+            "shed_total": self.shed_total,
+            "queue_depth": entry_stats.get("queue_depth", 0),
+            "p50_s": lat.get("p50"),
+            "p99_s": lat.get("p99"),
+            # bounded recent-latency samples: the router merges these
+            # rings across workers into EXACT fleet-wide percentiles
+            "latency_samples": self._latency_samples(),
+        }
+
+    def _latency_samples(self, cap: int = 512):
+        try:
+            entry = self.service._entry(self.model)  # noqa: SLF001
+        except Exception:  # noqa: BLE001
+            return []
+        samples = list(entry.latencies)[-cap:]
+        return [round(s, 6) for s in samples]
+
+    def predict_payload(self, payload: dict) -> dict:
+        features = np.asarray(payload["features"], np.float32)
+        argmax = bool(payload.get("argmax", False))
+        version = self.version  # pre-dispatch tag; body proves the params
+        out = self.service.predict(self.model, features, argmax=argmax)
+        self.requests_total += 1
+        key = "classes" if argmax else "output"
+        return {key: np.asarray(out).tolist(), "version": version}
+
+    def _make_handler(self):
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet: logs ride /metrics
+                pass
+
+            def _send(self, code: int, body: dict,
+                      headers: Optional[dict] = None) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, worker.healthz())
+                elif self.path == "/metrics":
+                    text = worker.service.registry.prometheus_text()
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == "/api/worker":
+                    body = worker.healthz()
+                    body["service"] = worker.service.stats()
+                    self._send(200, body)
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                from ..serving import (AdmissionError,  # noqa: PLC0415
+                                       ServiceDraining)
+
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON body"})
+                    return
+                if self.path == "/predict":
+                    if not worker.ready:
+                        self._send(503, {"error": "not ready"})
+                        return
+                    try:
+                        self._send(200, worker.predict_payload(payload))
+                    except ServiceDraining as e:
+                        self._send(503, {"error": str(e),
+                                         "draining": True})
+                    except AdmissionError as e:
+                        worker.shed_total += 1
+                        self._send(429, {"error": str(e),
+                                         "reason": e.reason,
+                                         "retry_after_s": e.retry_after_s},
+                                   {"Retry-After":
+                                    f"{e.retry_after_s:.3f}"})
+                    except (KeyError, ValueError) as e:
+                        self._send(400, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, {"error": str(e)})
+                elif self.path == "/swap":
+                    try:
+                        version = worker.swap_to(payload.get("version"))
+                        self._send(200, {"version": version})
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, {"error": str(e)})
+                elif self.path == "/drain":
+                    threading.Thread(target=worker.drain, daemon=True,
+                                     name="dl4jtpu-fleet-drain").start()
+                    self._send(200, {"draining": True})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+        return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.fleet.worker",
+        description="fleet serving worker (see docs/serving.md § Fleet)")
+    ap.add_argument("--store", required=True,
+                    help="CheckpointStore directory (the version bus)")
+    ap.add_argument("--model", default="default")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--watch", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="poll the store and hot_swap new versions "
+                         "(the router passes --no-watch and coordinates "
+                         "rollouts itself)")
+    ap.add_argument("--poll-s", type=float, default=0.5)
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-queue", type=int, default=None)
+    ap.add_argument("--latency-budget-ms", type=float, default=None)
+    ap.add_argument("--no-bundle", action="store_true",
+                    help="skip warm-boot bundle install (cold boot)")
+    args = ap.parse_args(argv)
+
+    worker = FleetWorker(
+        args.store, model=args.model, port=args.port, watch=args.watch,
+        poll_s=args.poll_s, max_delay_ms=args.max_delay_ms,
+        max_batch=args.max_batch, max_queue_depth=args.max_queue,
+        latency_budget_ms=args.latency_budget_ms,
+        use_bundle=not args.no_bundle)
+    worker.boot()
+
+    done = threading.Event()
+
+    def _term(signum, frame):
+        threading.Thread(target=lambda: (worker.drain(), done.set()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    print(f"{READY_SENTINEL} port={worker.port} version={worker.version} "
+          f"pid={os.getpid()}", flush=True)
+    done.wait()
+    worker.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

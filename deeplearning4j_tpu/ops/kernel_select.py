@@ -285,6 +285,22 @@ def calibration_factor() -> float:
     return _load_calibration()[1]
 
 
+def calibration_snapshot() -> Tuple[str, dict]:
+    """(path, raw ratios) of the active calibration file — what warm-boot
+    bundles embed so a fresh fleet worker scores kernels with the same
+    measured discounts as the process that built the bundle."""
+    return _calibration_path(), dict(_load_calibration()[0])
+
+
+def site_overrides() -> dict:
+    """The pinned site→variant map (both set_site_override and
+    ``DL4JTPU_KERNELS`` env form), for warm-boot bundle capture."""
+    with _LOCK:
+        pinned = dict(_SITE_OVERRIDES)
+    env_form = _parse_env()[1]
+    return {**env_form, **pinned}
+
+
 def update_calibration(key: str, predicted_vs_measured: float) -> bool:
     """Persist one bench mode's predicted/measured step-time ratio — the
     feedback half of the calibration loop (bench.py calls this from its

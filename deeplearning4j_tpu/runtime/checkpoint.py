@@ -39,6 +39,7 @@ import json
 import os
 import re
 import threading
+import time
 import zipfile
 from typing import Any, List, Optional
 
@@ -175,6 +176,38 @@ class CheckpointStore:
     def latest(self) -> Optional[CheckpointInfo]:
         vs = self.versions()
         return vs[-1] if vs else None
+
+    def latest_version(self) -> int:
+        """Newest version number, 0 when the store is empty — the poll
+        primitive of the fleet's version-propagation bus (workers and the
+        router compare it against what they serve)."""
+        info = self.latest()
+        return 0 if info is None else int(info.version)
+
+    def artifact_path(self, filename: str) -> str:
+        """Path for a sidecar artifact living NEXT TO the checkpoints
+        (warm-boot bundles, notes). Sidecars never match _VERSION_RE, so
+        version scans, retention pruning and restores ignore them."""
+        if _VERSION_RE.match(filename):
+            raise ValueError(
+                f"{filename!r} would shadow a checkpoint version")
+        return os.path.join(self.directory, filename)
+
+    def wait_for_version(self, min_version: int, *,
+                         timeout_s: float = 30.0,
+                         poll_s: float = 0.25) -> Optional[CheckpointInfo]:
+        """Block until the store publishes ``version >= min_version`` (the
+        subscriber half of the checkpoint bus). Returns its info, or None
+        on timeout. Polling, not inotify: the store is also written from
+        other processes/filesystems where watches don't travel."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            info = self.latest()
+            if info is not None and info.version >= min_version:
+                return info
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll_s)
 
     def stats(self) -> dict:
         """JSON-ready store view (the /api/online checkpoint listing)."""

@@ -119,6 +119,7 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
     the ``DL4JTPU_XLA_CACHE_DIR`` env var). Returns True when enabled. A
     process restart then re-reads compiled programs from disk instead of
     recompiling — the cross-process complement of the in-process LRU."""
+    global _PERSISTENT_CACHE_DIR
     cache_dir = cache_dir or os.environ.get(CACHE_DIR_ENV)
     if not cache_dir:
         return False
@@ -127,9 +128,22 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        _PERSISTENT_CACHE_DIR = str(cache_dir)
         return True
     except Exception:
         return False  # older jaxlib without the knob: in-process LRU only
+
+
+_PERSISTENT_CACHE_DIR: Optional[str] = None
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory the persistent XLA cache is ACTIVELY writing to, or
+    None when disabled. This is the export hook warm-boot bundles use
+    (fleet/artifacts.py): a bundle records where this process's compiled
+    programs land so a fresh worker can point its own cache there before
+    its first jax compile."""
+    return _PERSISTENT_CACHE_DIR
 
 
 class CompileManager:

@@ -20,15 +20,23 @@ See docs/serving.md for the endpoint contract and knob semantics.
 
 from .batcher import MAX_BATCH_ENV, MAX_DELAY_ENV, MicroBatcher
 from .decode import DECODE_SLOTS_ENV, DecodeServer
-from .service import InferenceService, get_service, set_service
+from .service import (LATENCY_BUDGET_ENV, MAX_QUEUE_ENV, AdmissionError,
+                      InferenceService, ServiceDraining, get_service,
+                      reset_services, service_names, set_service)
 
 __all__ = [
+    "AdmissionError",
     "DECODE_SLOTS_ENV",
     "DecodeServer",
     "InferenceService",
+    "LATENCY_BUDGET_ENV",
     "MAX_BATCH_ENV",
     "MAX_DELAY_ENV",
+    "MAX_QUEUE_ENV",
     "MicroBatcher",
+    "ServiceDraining",
     "get_service",
+    "reset_services",
+    "service_names",
     "set_service",
 ]

@@ -83,6 +83,8 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: "deque[_Request]" = deque()
+        self._in_flight = 0
+        self._pending = 0  # submitted, future not yet resolved
         self._closed = False
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -101,12 +103,40 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("batcher is stopped")
             self._queue.append(req)
+            self._pending += 1
             self._cv.notify()
         return req.future
 
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def in_flight(self) -> int:
+        """Requests currently inside a dispatch (popped off the queue but
+        futures not yet resolved)."""
+        with self._lock:
+            return self._in_flight
+
+    def pending(self) -> int:
+        """Requests whose future is not yet resolved — queued, held by the
+        collector while it waits for company, or mid-dispatch. This is
+        the drain invariant (queue_depth alone misses the held ones)."""
+        with self._lock:
+            return self._pending
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until every submitted request resolved. The caller is
+        responsible for stopping admission first (the batcher itself
+        keeps accepting — admission policy lives in the service).
+        Returns True when fully drained within the timeout."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return True
+            time.sleep(0.005)
+        with self._lock:
+            return not self._pending
 
     def stop(self) -> None:
         with self._cv:
@@ -117,6 +147,7 @@ class MicroBatcher:
         with self._lock:
             leftover = list(self._queue)
             self._queue.clear()
+            self._pending -= len(leftover)
         for req in leftover:
             req.future.set_exception(RuntimeError("batcher stopped"))
 
@@ -169,28 +200,38 @@ class MicroBatcher:
             group = self._collect()
             if not group:
                 return
-            t0 = time.perf_counter()
-            feats = (group[0].features if len(group) == 1 else
-                     np.concatenate([r.features for r in group]))
+            with self._lock:
+                self._in_flight = len(group)
             try:
-                out = self._dispatch(feats)
-            except Exception as e:  # noqa: BLE001 - reject THIS batch only
-                for req in group:
-                    if not req.future.cancelled():
-                        req.future.set_exception(e)
-                continue
-            seconds = time.perf_counter() - t0
-            out = np.asarray(out)
-            offset = 0
-            done = time.perf_counter()
+                self._dispatch_group(group)
+            finally:
+                with self._lock:
+                    self._in_flight = 0
+                    self._pending -= len(group)
+
+    def _dispatch_group(self, group: List[_Request]) -> None:
+        t0 = time.perf_counter()
+        feats = (group[0].features if len(group) == 1 else
+                 np.concatenate([r.features for r in group]))
+        try:
+            out = self._dispatch(feats)
+        except Exception as e:  # noqa: BLE001 - reject THIS batch only
             for req in group:
-                n = int(req.features.shape[0])
                 if not req.future.cancelled():
-                    req.future.set_result(out[offset:offset + n])
-                if self._on_request is not None:
-                    self._on_request(done - req.enqueued)
-                offset += n
-            if self._on_batch is not None:
-                self._on_batch(rows=int(feats.shape[0]),
-                               requests=len(group), seconds=seconds,
-                               queue_depth=self.queue_depth())
+                    req.future.set_exception(e)
+            return
+        seconds = time.perf_counter() - t0
+        out = np.asarray(out)
+        offset = 0
+        done = time.perf_counter()
+        for req in group:
+            n = int(req.features.shape[0])
+            if not req.future.cancelled():
+                req.future.set_result(out[offset:offset + n])
+            if self._on_request is not None:
+                self._on_request(done - req.enqueued)
+            offset += n
+        if self._on_batch is not None:
+            self._on_batch(rows=int(feats.shape[0]),
+                           requests=len(group), seconds=seconds,
+                           queue_depth=self.queue_depth())

@@ -19,9 +19,17 @@ Multi-model tenancy needs no code here: every model's executables live in
 the process-wide compile-manager LRU next to the training entries, so cold
 models age out under eviction pressure and hot models stay resident.
 
-The process-global service (``get_service()``) is what ``ui/server.py``
-exposes over HTTP (POST ``/serving/predict``, POST ``/serving/rnn``, GET
-``/api/serving``).
+Services are named: ``get_service()`` returns the process-wide default
+(what ``ui/server.py`` exposes over HTTP — POST ``/serving/predict``, POST
+``/serving/rnn``, GET ``/api/serving``), ``get_service("edge")`` creates /
+returns an independent one, and ``reset_services()`` tears the registry
+down between tests so multi-service suites never cross-contaminate.
+
+Admission control (ISSUE 13): each model can carry a queue-depth cap and a
+latency budget. A request that would breach either is **shed** with
+:class:`AdmissionError` (HTTP fronts map it to 429 + Retry-After) instead
+of queueing into a latency spiral; a draining service refuses new traffic
+with :class:`ServiceDraining` (503) while in-flight requests finish.
 """
 
 from __future__ import annotations
@@ -37,7 +45,40 @@ import numpy as np
 from .batcher import MAX_BATCH_ENV, MAX_DELAY_ENV, MicroBatcher
 from .decode import DecodeServer
 
-__all__ = ["InferenceService", "get_service", "set_service"]
+__all__ = ["AdmissionError", "InferenceService", "LATENCY_BUDGET_ENV",
+           "MAX_QUEUE_ENV", "ServiceDraining", "get_service",
+           "reset_services", "service_names", "set_service"]
+
+# service-wide admission defaults (per-model register() args override):
+# how many requests may wait in a model's queues before shedding, and the
+# p99 latency (ms, over the recent ring) beyond which new traffic sheds.
+# 0 = limit disabled.
+MAX_QUEUE_ENV = "DL4JTPU_SERVE_MAX_QUEUE"
+LATENCY_BUDGET_ENV = "DL4JTPU_SERVE_LATENCY_BUDGET_MS"
+
+# recompute the admission p99 at most this often — np.percentile over the
+# 2048-sample ring per request would cost more than the dispatch
+_P99_REFRESH_S = 0.25
+
+
+class AdmissionError(RuntimeError):
+    """Request shed by admission control (HTTP fronts answer 429).
+
+    ``retry_after_s`` is the server's backoff hint: roughly how long the
+    current queue needs to clear at the configured batch cadence.
+    """
+
+    def __init__(self, model: str, reason: str, retry_after_s: float):
+        super().__init__(
+            f"model {model!r}: request shed ({reason}); "
+            f"retry after {retry_after_s:.3f}s")
+        self.model = model
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class ServiceDraining(RuntimeError):
+    """Service is draining: no new admissions, in-flight work finishes."""
 
 # request latencies span sub-ms (warm CPU micro-batch) to seconds (cold
 # accelerator dispatch) — finer low end than the step-time default buckets
@@ -51,12 +92,29 @@ def _percentile(values, q: float):
     return float(np.percentile(np.asarray(values, np.float64), q))
 
 
+def _env_limit(name: str, kind=float):
+    raw = os.environ.get(name)
+    if raw is None:
+        return None
+    try:
+        value = kind(float(raw))
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
 class _ModelEntry:
     def __init__(self, name: str, net, batcher: MicroBatcher,
-                 argmax_batcher: MicroBatcher):
+                 argmax_batcher: MicroBatcher,
+                 max_queue_depth: Optional[int] = None,
+                 latency_budget_ms: Optional[float] = None):
         self.name = name
         self.net = net
         self.batcher = batcher
+        self.max_queue_depth = max_queue_depth
+        self.latency_budget_ms = latency_budget_ms
+        self.shed = 0
+        self._p99_cache = (0.0, None)  # (computed_at, value)
         # class-index requests coalesce separately: logits and int32-argmax
         # dispatches can never share a transfer, but argmax traffic still
         # deserves the latency-budget batching (they dispatched direct
@@ -73,6 +131,20 @@ class _ModelEntry:
         self.version: Optional[int] = None  # hot-swap bookkeeping
         self.swapped_at: Optional[float] = None
         self.swaps = 0
+
+    def depth(self) -> int:
+        return (self.batcher.queue_depth()
+                + self.argmax_batcher.queue_depth())
+
+    def recent_p99(self) -> Optional[float]:
+        """p99 over the latency ring, cached for _P99_REFRESH_S — cheap
+        enough to consult on every admission decision."""
+        now = time.perf_counter()
+        at, value = self._p99_cache
+        if now - at > _P99_REFRESH_S:
+            value = _percentile(list(self.latencies), 99)
+            self._p99_cache = (now, value)
+        return value
 
     def stop(self) -> None:
         self.batcher.stop()
@@ -96,6 +168,7 @@ class InferenceService:
         self.max_batch = max_batch
         self._lock = threading.Lock()
         self._models: Dict[str, _ModelEntry] = {}
+        self._draining = False
         self.requests_total = registry.counter(
             "dl4jtpu_serve_requests_total",
             "inference requests served, by model", labelnames=("model",))
@@ -131,13 +204,22 @@ class InferenceService:
             "dl4jtpu_serve_swaps_total",
             "live hot-swaps of a served model's parameters, by model",
             labelnames=("model",))
+        self.shed_total = registry.counter(
+            "dl4jtpu_serve_shed_total",
+            "requests shed by admission control, by model and reason",
+            labelnames=("model", "reason"))
 
     # ------------------------------------------------------------ registry
     @staticmethod
     def _is_graph(net) -> bool:
         return hasattr(net.conf, "network_inputs")
 
-    def register(self, name: str, net, layout=None) -> "InferenceService":
+    def register(self, name: str, net, layout=None, *,
+                 max_delay_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 latency_budget_ms: Optional[float] = None,
+                 ) -> "InferenceService":
         """Serve ``net`` as ``name``. Graphs must be single-input /
         single-output (the row-concatenating batcher has one features
         tensor per request).
@@ -147,7 +229,14 @@ class InferenceService:
         set (and precision policy) training uses, and the inference fast
         path places request tensors on the layout's mesh. A net that
         arrives already sharded (``MeshLayout.apply`` / ParallelWrapper)
-        keeps its placement without passing anything here."""
+        keeps its placement without passing anything here.
+
+        Per-model knobs (each falls back service-wide when None): the
+        batcher pair ``max_delay_ms``/``max_batch`` (ctor arg → env →
+        TUNED.json → default), and the admission pair ``max_queue_depth``
+        (shed at this many queued requests) / ``latency_budget_ms`` (shed
+        while the ring p99 exceeds it) — env → TUNED.json → disabled.
+        Shed requests raise :class:`AdmissionError` (HTTP: 429)."""
         if self._is_graph(net):
             if (len(net.conf.network_inputs) != 1
                     or len(net.conf.network_outputs) != 1):
@@ -165,16 +254,41 @@ class InferenceService:
         tuned = _tuned.auto_apply(net, "serve", explicit=[
             knob for knob, user_set in (
                 ("serve_max_delay_ms",
-                 self.max_delay_ms is not None
+                 max_delay_ms is not None
+                 or self.max_delay_ms is not None
                  or os.environ.get(MAX_DELAY_ENV) is not None),
                 ("serve_max_batch",
-                 self.max_batch is not None
+                 max_batch is not None
+                 or self.max_batch is not None
                  or os.environ.get(MAX_BATCH_ENV) is not None),
+                ("serve_max_queue_depth",
+                 max_queue_depth is not None
+                 or os.environ.get(MAX_QUEUE_ENV) is not None),
+                ("serve_latency_budget_ms",
+                 latency_budget_ms is not None
+                 or os.environ.get(LATENCY_BUDGET_ENV) is not None),
             ) if user_set])
-        delay_ms = (self.max_delay_ms if self.max_delay_ms is not None
+        if max_delay_ms is None:
+            max_delay_ms = self.max_delay_ms
+        delay_ms = (max_delay_ms if max_delay_ms is not None
                     else tuned.get("serve_max_delay_ms"))
-        rows_cap = (self.max_batch if self.max_batch is not None
+        if max_batch is None:
+            max_batch = self.max_batch
+        rows_cap = (max_batch if max_batch is not None
                     else tuned.get("serve_max_batch"))
+        if max_queue_depth is None:
+            max_queue_depth = _env_limit(MAX_QUEUE_ENV, int)
+            if max_queue_depth is None:
+                max_queue_depth = tuned.get("serve_max_queue_depth")
+        if latency_budget_ms is None:
+            latency_budget_ms = _env_limit(LATENCY_BUDGET_ENV)
+            if latency_budget_ms is None:
+                latency_budget_ms = tuned.get("serve_latency_budget_ms")
+        # 0 / negative means "limit disabled" wherever it came from
+        if max_queue_depth is not None and int(max_queue_depth) <= 0:
+            max_queue_depth = None
+        if latency_budget_ms is not None and float(latency_budget_ms) <= 0:
+            latency_budget_ms = None
         entry_holder: list = []
 
         def dispatch(feats: np.ndarray) -> np.ndarray:
@@ -194,7 +308,12 @@ class InferenceService:
             on_batch=lambda **kw: self._record_batch(name, kind="argmax",
                                                      **kw),
             on_request=lambda s: self._record_request(name, s))
-        entry = _ModelEntry(name, net, batcher, argmax_batcher)
+        entry = _ModelEntry(
+            name, net, batcher, argmax_batcher,
+            max_queue_depth=(None if max_queue_depth is None
+                             else int(max_queue_depth)),
+            latency_budget_ms=(None if latency_budget_ms is None
+                               else float(latency_budget_ms)))
         entry_holder.append(entry)
         with self._lock:
             old = self._models.get(name)
@@ -299,8 +418,17 @@ class InferenceService:
         requests coalesce on their OWN batcher (mixing them with logits
         requests would force two device transfers per batch) and dispatch
         on the fused-argmax executable — only int32 class indices cross
-        the device boundary, same as the old direct path."""
+        the device boundary, same as the old direct path.
+
+        Raises :class:`ServiceDraining` while the service drains and
+        :class:`AdmissionError` when the model's queue-depth cap or
+        latency budget would be breached (shed now beats queueing into a
+        latency spiral — the caller backs off ``retry_after_s``)."""
+        if self._draining:
+            raise ServiceDraining(f"service draining; model {name!r} "
+                                  "not admitting new requests")
         entry = self._entry(name)
+        self._admit(entry)
         features = np.asarray(features)
         if features.ndim >= 1:
             self.request_rows.labels(model=name).observe(
@@ -310,6 +438,48 @@ class InferenceService:
         self.queue_depth.labels(model=name).set(
             entry.batcher.queue_depth() + entry.argmax_batcher.queue_depth())
         return fut.result(timeout=timeout_s)
+
+    def _admit(self, entry: _ModelEntry) -> None:
+        depth = entry.depth()
+        if (entry.max_queue_depth is not None
+                and depth >= entry.max_queue_depth):
+            # backoff hint: cycles needed to clear the queue at the
+            # batcher's cadence (delay budget per coalesced dispatch)
+            cycles = depth / max(1, entry.batcher.max_batch)
+            retry = max(0.05, cycles * max(entry.batcher.max_delay_s,
+                                           0.002))
+            self._shed(entry, "queue_depth", retry)
+        if entry.latency_budget_ms is not None:
+            p99 = entry.recent_p99()
+            if p99 is not None and p99 * 1000.0 > entry.latency_budget_ms:
+                self._shed(entry, "latency_budget",
+                           max(0.05, 2 * entry.latency_budget_ms / 1000.0))
+
+    def _shed(self, entry: _ModelEntry, reason: str,
+              retry_after_s: float) -> None:
+        entry.shed += 1
+        self.shed_total.labels(model=entry.name, reason=reason).inc()
+        raise AdmissionError(entry.name, reason, round(retry_after_s, 3))
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: stop admitting (predict raises
+        :class:`ServiceDraining`), wait for every model's queued AND
+        in-flight requests to finish. Returns True when fully drained.
+        The service stays registered — callers deregister/stop after."""
+        self._draining = True
+        with self._lock:
+            entries = list(self._models.values())
+        deadline = time.perf_counter() + timeout_s
+        ok = True
+        for e in entries:
+            for b in (e.batcher, e.argmax_batcher):
+                remaining = deadline - time.perf_counter()
+                ok = b.drain(timeout_s=max(0.0, remaining)) and ok
+        return ok
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # ----------------------------------------------------------- decode
     def decoder(self, name: str) -> DecodeServer:
@@ -406,9 +576,15 @@ class InferenceService:
                     "max_delay_ms": round(e.batcher.max_delay_s * 1000, 3),
                     "max_batch": e.batcher.max_batch,
                 },
+                "admission": {
+                    "max_queue_depth": e.max_queue_depth,
+                    "latency_budget_ms": e.latency_budget_ms,
+                    "shed_total": e.shed,
+                },
             }
         return {
             "models": models,
+            "draining": self._draining,
             "compile_cache": get_compile_manager().stats(),
         }
 
@@ -420,21 +596,52 @@ class InferenceService:
             e.stop()
 
 
-_GLOBAL: Optional[InferenceService] = None
-_GLOBAL_LOCK = threading.Lock()
+# ---------------------------------------------------------------- registry
+# Named services replace the old single process-global: a process can host
+# independent serving fronts (a fleet worker's own service next to the
+# default UI one) and tests reset the whole registry instead of leaking
+# models into each other through one shared singleton.
+DEFAULT_SERVICE = "default"
+_SERVICES: Dict[str, InferenceService] = {}
+_SERVICES_LOCK = threading.Lock()
 
 
-def get_service() -> InferenceService:
-    """The process-wide serving front-end (what the UI server exposes)."""
-    global _GLOBAL
-    with _GLOBAL_LOCK:
-        if _GLOBAL is None:
-            _GLOBAL = InferenceService()
-        return _GLOBAL
+def get_service(name: str = DEFAULT_SERVICE) -> InferenceService:
+    """The named serving front-end, created on first use. The no-arg call
+    keeps its historic meaning: the process-wide default service (what
+    the UI server exposes)."""
+    with _SERVICES_LOCK:
+        service = _SERVICES.get(name)
+        if service is None:
+            service = _SERVICES[name] = InferenceService()
+        return service
 
 
-def set_service(service: Optional[InferenceService]) -> None:
-    """Swap the process-wide service (tests / custom deployments)."""
-    global _GLOBAL
-    with _GLOBAL_LOCK:
-        _GLOBAL = service
+def set_service(service: Optional[InferenceService],
+                name: str = DEFAULT_SERVICE) -> None:
+    """Install (or, with None, remove) a named service. The no-arg form
+    swaps the process-wide default (tests / custom deployments)."""
+    with _SERVICES_LOCK:
+        if service is None:
+            _SERVICES.pop(name, None)
+        else:
+            _SERVICES[name] = service
+
+
+def service_names():
+    with _SERVICES_LOCK:
+        return sorted(_SERVICES)
+
+
+def reset_services(*, stop: bool = True) -> None:
+    """Test hook: clear the whole service registry (stopping batchers by
+    default) so multi-service suites start from a clean slate."""
+    with _SERVICES_LOCK:
+        services = list(_SERVICES.values())
+        _SERVICES.clear()
+    if stop:
+        for service in services:
+            try:
+                service.stop()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass

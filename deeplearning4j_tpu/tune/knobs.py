@@ -204,6 +204,19 @@ def _register_builtins() -> None:
              env="DL4JTPU_SERVE_MAX_BATCH",
              cost_hint="compute", contexts=("serve",),
              doc="micro-batcher row cap = largest compiled serving bucket"))
+    add(Knob("serve_max_queue_depth", (0, 64, 128, 256, 512), 0, "env",
+             env="DL4JTPU_SERVE_MAX_QUEUE",
+             cost_hint="latency", contexts=("serve",),
+             doc="admission control: shed (429) once this many requests "
+                 "queue for a model; 0 disables the cap (per-model "
+                 "InferenceService.register(max_queue_depth=) overrides)"))
+    add(Knob("serve_latency_budget_ms", (0.0, 25.0, 50.0, 100.0, 250.0),
+             0.0, "env", env="DL4JTPU_SERVE_LATENCY_BUDGET_MS",
+             cost_hint="latency", contexts=("serve",),
+             doc="admission control: shed (429) while the recent-ring p99 "
+                 "exceeds this budget; 0 disables (per-model "
+                 "InferenceService.register(latency_budget_ms=) "
+                 "overrides)"))
     add(Knob("decode_slots", (8, 16, 32, 64), 8, "env",
              env="DL4JTPU_SERVE_DECODE_SLOTS",
              cost_hint="memory", contexts=(),

@@ -185,6 +185,34 @@ class TunedStore:
         return self.get(key_for(net))
 
 
+# ------------------------------------------------- warm-boot bundle slice
+def tuned_slice(key: str, path: Optional[str] = None) -> Optional[dict]:
+    """The raw TUNED.json entry for one config key — what a warm-boot
+    bundle (fleet/artifacts.py) embeds so a fresh worker starts from the
+    same tuned knobs as the process that built the bundle."""
+    return TunedStore(path).get(key)
+
+
+def install_slice(key: str, entry: dict,
+                  path: Optional[str] = None) -> Optional[dict]:
+    """Merge a bundle-carried TUNED.json slice into this process's store
+    (validated, atomic, merge-on-put — same rules as the tuner's own
+    writes). Returns the merged entry, or None when the slice is
+    malformed/unknown-knobbed (a stale bundle must not poison startup)."""
+    config = entry.get("config") if isinstance(entry, dict) else None
+    if not isinstance(config, dict) or not config:
+        return None
+    try:
+        return TunedStore(path).put(
+            key, config,
+            objective=str(entry.get("objective", "fit")),
+            metric=str(entry.get("metric", "")),
+            value=entry.get("value"),
+            trials=entry.get("trials"))
+    except Exception:  # noqa: BLE001 - tolerate foreign/stale slices
+        return None
+
+
 # ------------------------------------------------------------- auto-apply
 def _applied_counter():
     from ..telemetry import get_registry  # noqa: PLC0415

@@ -35,6 +35,9 @@ Pages:
 - ``/api/online``     — online-learning snapshot: per-trainer ingest rate,
   window/step counters, drift/rollback state, hot-swap history, and the
   checkpoint store's version listing (see docs/streaming.md).
+- ``/api/fleet``      — multi-process fleet snapshot: every in-process
+  FleetRouter's per-worker liveness/version/queue view plus merged exact
+  p50/p99 (see docs/serving.md § Fleet).
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
   and continuous-decode endpoints over the process serving front-end
   (``serving.get_service()``; see docs/serving.md).
@@ -497,6 +500,15 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, json.dumps(
                 {"trainers": {name: t.stats()
                               for name, t in get_online_trainers().items()}},
+                default=str).encode())
+        if path == "/api/fleet":
+            # fleet snapshot: every in-process FleetRouter's per-worker
+            # liveness/version/queue view plus merged exact p50/p99
+            # (docs/serving.md § Fleet)
+            from ..fleet import get_fleet_routers  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                {"routers": [r.stats() for r in get_fleet_routers()]},
                 default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()

@@ -11,7 +11,9 @@ driver-broadcast analog) and placed via ``global_put``; collectives ride Gloo.
 Process 0 writes final params for the test to compare against a single-process
 run of the same configuration.
 
-Invoke only via the test (env must force the CPU platform before jax import).
+Invoke only via the test (env must force the CPU platform before jax import —
+build the child env with ``deeplearning4j_tpu.utils.subproc.forced_cpu_env``,
+the one shared recipe; the assert in main() catches a caller that forgot).
 """
 
 import argparse
@@ -23,6 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 
 def main() -> None:
+    assert os.environ.get("JAX_PLATFORMS") == "cpu", (
+        "spawn me with utils.subproc.forced_cpu_env() — the CPU platform "
+        "must be pinned by env before the first jax import")
     ap = argparse.ArgumentParser()
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--num-processes", type=int, required=True)

@@ -13,32 +13,17 @@ only the all-reduce reduction order may differ -> tight allclose).
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.utils.subproc import forced_cpu_env as _worker_env
+from deeplearning4j_tpu.utils.subproc import free_port as _free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "helpers", "multiproc_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _worker_env(local_devices: int) -> dict:
-    env = dict(os.environ)
-    # Same recipe as conftest's _force_cpu_mesh, but via env because each
-    # worker is a fresh interpreter: never let the axon TPU plugin register.
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
-    env.pop("JAX_NUM_PROCESSES", None)
-    return env
 
 
 _MULTIPROC_SUPPORT = None

@@ -390,10 +390,9 @@ def test_socket_streaming_two_process():
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     producer = os.path.join(repo, "tests", "helpers", "streaming_producer.py")
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""      # never let the child touch the TPU
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    from deeplearning4j_tpu.utils.subproc import forced_cpu_env
+
+    env = forced_cpu_env(1)  # never let the child touch the TPU
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 
     net = _toy_net(lr=0.1)
