@@ -6,9 +6,15 @@ touches jax. It owns three loops:
 - **supervision**: each worker is a real OS process (spawned with the
   shared forced-CPU env recipe, ``utils.subproc.forced_cpu_env``, unless
   the deployment passes its own env with per-worker accelerator
-  visibility). A worker that dies or stops answering ``/healthz`` is
-  respawned with exponential backoff; a respawned worker warm-boots from
-  the bundle, so the fleet's compiled-program guarantee survives churn.
+  visibility). A worker that dies, stops answering ``/healthz``, or
+  accepts TCP but never answers within the health ``Deadline`` (hung) is
+  killed and respawned — with the shared ``RetryPolicy``'s exponential
+  backoff and per-worker deterministic jitter, so workers killed
+  together never respawn in lockstep (no thundering herd on the store
+  and compile cache). Respawns are counted by cause in
+  ``dl4jtpu_fleet_respawns_total{reason="crash"|"hung"|"unhealthy"}``.
+  A respawned worker warm-boots from the bundle, so the fleet's
+  compiled-program guarantee survives churn.
 - **routing**: POST ``/predict`` proxies to the alive, ready,
   not-rolling worker with the least outstanding requests. A worker-side
   admission shed (429) propagates to the client with its Retry-After;
@@ -32,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -39,10 +46,11 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime.resilience import Deadline, DeadlinePolicy, RetryPolicy
 from ..utils.subproc import forced_cpu_env
 from .worker import READY_SENTINEL
 
@@ -72,6 +80,8 @@ class WorkerHandle:
         self.queue_depth = 0
         self.outstanding = 0
         self.respawns = 0
+        self.fail_count = 0  # consecutive failures feeding the backoff
+        self.down_reason: Optional[str] = None
         self.backoff_s = 0.0
         self.next_spawn_at = 0.0
         self.latency_samples: List[float] = []
@@ -90,10 +100,20 @@ class WorkerHandle:
             "queue_depth": self.queue_depth,
             "outstanding": self.outstanding,
             "respawns": self.respawns,
+            "down_reason": self.down_reason,
+            "backoff_s": round(self.backoff_s, 4),
             "compiles_since_ready":
                 self.last_health.get("compiles_since_ready"),
             "bundle_installed": self.last_health.get("bundle_installed"),
         }
+
+
+class _NoWorker(Exception):
+    """No ready worker to route to (not retryable — fail fast)."""
+
+
+class _WorkerFailed(Exception):
+    """A picked worker failed the request (retryable: fail over once)."""
 
 
 class FleetRouter:
@@ -107,6 +127,7 @@ class FleetRouter:
                  poll_s: float = 0.5,
                  shed_outstanding: int = 64,
                  boot_timeout_s: float = 120.0,
+                 health_timeout_s: float = 5.0,
                  registry=None):
         if registry is None:
             from ..telemetry import get_registry  # noqa: PLC0415
@@ -126,6 +147,22 @@ class FleetRouter:
         self.poll_s = float(poll_s)
         self.shed_outstanding = int(shed_outstanding)
         self.boot_timeout_s = float(boot_timeout_s)
+        self.health_timeout_s = float(health_timeout_s)
+
+        # shared failure-handling policies (runtime/resilience.py): the
+        # respawn backoff is keyed per worker id, so simultaneous deaths
+        # respawn staggered — deterministically
+        self.respawn_policy = RetryPolicy(
+            "fleet.router.respawn", base_s=self.backoff_base_s,
+            cap_s=self.backoff_cap_s, jitter=0.5, max_attempts=None,
+            registry=registry)
+        self.failover_policy = RetryPolicy(
+            "fleet.router.failover", max_attempts=2, base_s=0.0, cap_s=0.0,
+            jitter=0.0, retry_on=(_WorkerFailed,), registry=registry)
+        self.health_deadline = DeadlinePolicy(
+            "fleet.router.health", self.health_timeout_s)
+        self.boot_deadline = DeadlinePolicy(
+            "fleet.router.boot", self.boot_timeout_s)
 
         self.workers: List[WorkerHandle] = [
             WorkerHandle(i) for i in range(self.n_workers)]
@@ -147,7 +184,8 @@ class FleetRouter:
             "requests shed at the router (fleet saturated or worker 429)")
         self._m_respawns = registry.counter(
             "dl4jtpu_fleet_respawns_total",
-            "worker processes respawned after death")
+            "worker processes respawned, by detected cause",
+            labelnames=("reason",))
         self._m_rollouts = registry.counter(
             "dl4jtpu_fleet_rollouts_total",
             "rolling version rollouts completed across the fleet")
@@ -190,9 +228,10 @@ class FleetRouter:
         # the deadline (readline then returns EOF and the spawn fails)
         booted = threading.Event()
         proc = handle.proc
+        deadline = self.boot_deadline.start()
 
         def _watchdog():
-            if not booted.wait(self.boot_timeout_s) and proc.poll() is None:
+            if not deadline.wait_event(booted) and proc.poll() is None:
                 proc.kill()
 
         threading.Thread(target=_watchdog, daemon=True).start()
@@ -214,6 +253,8 @@ class FleetRouter:
             handle.alive = True
             handle.ready = True
             handle.backoff_s = 0.0
+            handle.fail_count = 0
+            handle.down_reason = None
         # the ready pipe stays open; drain it so the worker never blocks
         threading.Thread(target=handle.proc.stdout.read,
                          daemon=True).start()
@@ -249,16 +290,30 @@ class FleetRouter:
         return self
 
     # -------------------------------------------------------- supervise
-    def _health(self, handle: WorkerHandle) -> Optional[dict]:
+    def _health(self, handle: WorkerHandle) -> Tuple[Optional[dict], bool]:
+        """Probe a worker's /healthz under the health Deadline. Returns
+        ``(health, hung)``: hung=True means the worker accepted TCP but
+        never answered inside the deadline — a live-but-wedged process
+        (crashed/refused connections report hung=False)."""
         if handle.port is None:
-            return None
+            return None, False
+        deadline = self.health_deadline.start()
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{handle.port}/healthz",
-                    timeout=5) as resp:
-                return json.loads(resp.read())
-        except Exception:  # noqa: BLE001 - unreachable == unhealthy
-            return None
+                    timeout=max(0.001, deadline.remaining())) as resp:
+                return json.loads(resp.read()), False
+        except urllib.error.URLError as e:
+            hung = isinstance(getattr(e, "reason", None),
+                              (socket.timeout, TimeoutError))
+            if hung:
+                deadline.note_expired()
+            return None, hung
+        except (socket.timeout, TimeoutError):
+            deadline.note_expired()
+            return None, True
+        except Exception:  # noqa: BLE001 - garbled/partial response
+            return None, False
 
     def _supervise_loop(self) -> None:
         while not self._stop.wait(self.poll_s):
@@ -274,13 +329,29 @@ class FleetRouter:
                 except Exception:  # noqa: BLE001 - retried next tick
                     pass
 
+    def _backoff(self, handle: WorkerHandle) -> None:
+        """Schedule the next respawn attempt: shared exponential policy,
+        jitter keyed by worker id — simultaneous deaths respawn staggered."""
+        handle.fail_count += 1
+        handle.backoff_s = self.respawn_policy.record_failure(
+            key=f"worker-{handle.wid}", attempt=handle.fail_count)
+        handle.next_spawn_at = time.monotonic() + handle.backoff_s
+
     def _check_worker(self, handle: WorkerHandle) -> None:
         proc = handle.proc
+        reason = None
         dead = proc is None or proc.poll() is not None
-        if not dead:
-            health = self._health(handle)
+        if dead:
+            reason = "crash"
+        else:
+            health, hung = self._health(handle)
             if health is None:
                 dead = True
+                reason = "hung" if hung else "unhealthy"
+                if hung and proc.poll() is None:
+                    # a hung process still owns its port; reap it so the
+                    # respawn can bind a fresh worker
+                    proc.kill()
             else:
                 with handle.lock:
                     handle.last_health = health
@@ -292,23 +363,18 @@ class FleetRouter:
             with handle.lock:
                 handle.alive = False
                 handle.ready = False
-                handle.backoff_s = (self.backoff_base_s
-                                    if handle.backoff_s == 0 else
-                                    min(self.backoff_cap_s,
-                                        handle.backoff_s * 2))
-                handle.next_spawn_at = time.monotonic() + handle.backoff_s
+                handle.down_reason = reason
+                self._backoff(handle)
         if (dead and self.respawn and not self._draining
                 and time.monotonic() >= handle.next_spawn_at):
+            cause = handle.down_reason or reason or "crash"
             if self._spawn(handle):
                 handle.respawns += 1
-                self._m_respawns.inc()
+                self._m_respawns.labels(reason=cause).inc()
+                self.respawn_policy.record_success()
             else:
                 with handle.lock:
-                    handle.backoff_s = min(self.backoff_cap_s,
-                                           max(self.backoff_base_s,
-                                               handle.backoff_s * 2))
-                    handle.next_spawn_at = (time.monotonic()
-                                            + handle.backoff_s)
+                    self._backoff(handle)
 
     # ---------------------------------------------------------- rollout
     def _maybe_rollout(self) -> None:
@@ -331,9 +397,9 @@ class FleetRouter:
                 continue  # a respawn boots straight at the latest version
             handle.rolling = True
             try:
-                deadline = time.monotonic() + settle_timeout_s
-                while handle.outstanding > 0 and time.monotonic() < deadline:
-                    time.sleep(0.01)
+                deadline = Deadline(settle_timeout_s)
+                while handle.outstanding > 0 and deadline.pace(0.01):
+                    pass
                 body = json.dumps({"version": int(version)}).encode()
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{handle.port}/swap", body,
@@ -357,14 +423,17 @@ class FleetRouter:
         return min(ready, key=lambda h: h.outstanding)
 
     def route_predict(self, payload: dict) -> tuple:
-        """Returns (http_status, body dict, headers dict)."""
+        """Returns (http_status, body dict, headers dict). The one
+        failover retry on a dead worker routes through the shared
+        ``fleet.router.failover`` RetryPolicy (max_attempts=2, no
+        backoff — a second worker is tried immediately)."""
         if self._draining:
             return 503, {"error": "fleet draining"}, {}
-        last_error = "no ready worker"
-        for _attempt in range(2):  # one failover retry on a dead worker
+
+        def attempt():
             handle = self._pick()
             if handle is None:
-                break
+                raise _NoWorker("no ready worker")
             if handle.outstanding >= self.shed_outstanding:
                 # least-loaded worker is saturated => whole fleet is
                 self.shed_total += 1
@@ -400,18 +469,28 @@ class FleetRouter:
                     return 429, detail or {"error": "worker shed"}, headers
                 if e.code in (400, 404):
                     return e.code, detail or {"error": str(e)}, {}
-                last_error = detail.get("error", str(e))
+                raise _WorkerFailed(detail.get("error", str(e))) from e
+            except _WorkerFailed:
+                raise
             except Exception as e:  # noqa: BLE001 - dead worker: fail over
-                last_error = str(e)
                 with handle.lock:
                     handle.alive = False
                     handle.ready = False
+                raise _WorkerFailed(str(e)) from e
             finally:
                 with handle.lock:
                     handle.outstanding = max(0, handle.outstanding - 1)
-        self.failed_total += 1
-        return 503, {"error": f"no worker served the request "
-                              f"({last_error})"}, {}
+
+        try:
+            return self.failover_policy.run(attempt)
+        except _NoWorker as e:
+            self.failed_total += 1
+            return 503, {"error": f"no worker served the request ({e})"}, {}
+        except Exception as e:  # noqa: BLE001 - RetryError wraps the cause
+            self.failed_total += 1
+            cause = getattr(e, "last", e)
+            return 503, {"error": f"no worker served the request "
+                                  f"({cause})"}, {}
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
@@ -442,7 +521,7 @@ class FleetRouter:
         """Fleet-wide graceful drain: stop admitting at the front, drain
         every worker (their in-flight requests finish), reap processes."""
         self._draining = True
-        deadline = time.monotonic() + timeout_s
+        deadline = Deadline(timeout_s)
         ok = True
         for handle in self.workers:
             if not handle.alive or handle.port is None:
@@ -456,11 +535,11 @@ class FleetRouter:
                 ok = False
         for handle in self.workers:
             while (handle.alive and handle.port is not None
-                   and time.monotonic() < deadline):
-                health = self._health(handle)
+                   and not deadline.expired):
+                health, _ = self._health(handle)
                 if health is None or health.get("drained"):
                     break
-                time.sleep(0.05)
+                deadline.pace(0.05)
         return ok
 
     def stop(self) -> None:
@@ -505,6 +584,9 @@ class FleetRouter:
             def do_GET(self):
                 if self.path == "/api/fleet":
                     self._send(200, router.stats())
+                elif self.path == "/api/resilience":
+                    from ..runtime.resilience import resilience_stats  # noqa: PLC0415
+                    self._send(200, resilience_stats())
                 elif self.path == "/metrics":
                     self._send(200,
                                router.registry.prometheus_text().encode(),
@@ -594,8 +676,7 @@ def main(argv=None) -> int:
           f"workers={sum(1 for h in router.workers if h.ready)}",
           flush=True)
     try:
-        while True:
-            time.sleep(3600)
+        threading.Event().wait()  # serve until interrupted
     except KeyboardInterrupt:
         router.stop()
     return 0

@@ -100,6 +100,20 @@ class FleetWorker:
         self._counter: Optional[_CompileCounter] = None
         self._httpd = None
         self._argmax_warm = False
+        # optional deterministic fault injection (testing/chaos.py): main()
+        # attaches a FaultPlan from DL4JTPU_CHAOS_PLAN; in-process tests
+        # set .chaos directly. The /healthz handler is the explicit hook.
+        self.chaos = None
+
+        # typed failure handling (runtime/resilience.py) for the two loops
+        # that talk to the store: the version watch and the swap itself
+        from ..runtime.resilience import RetryPolicy  # noqa: PLC0415
+
+        self._watch_policy = RetryPolicy(
+            "fleet.worker.watch", base_s=self.poll_s,
+            cap_s=max(4.0, 8 * self.poll_s), jitter=0.25)
+        self._swap_policy = RetryPolicy(
+            "fleet.worker.swap", max_attempts=3, base_s=0.05, cap_s=1.0)
 
     # ------------------------------------------------------------- boot
     def boot(self) -> "FleetWorker":
@@ -121,11 +135,10 @@ class FleetWorker:
             artifacts.install_bundle(bundle)
             self.bundle_installed = True
 
-        info = self.store.latest()
-        if info is None:
-            raise FileNotFoundError(
-                f"checkpoint store {self.store_dir!r} holds no versions")
-        self.net = self.store.restore(info.version)
+        # verified restore with fallback: a corrupt `latest` is quarantined
+        # and the newest good version boots instead (corrupt-latest
+        # survival — the bundle's warmup shapes don't depend on version)
+        self.net, info = self.store.restore_with_info()
         self.version = int(info.version)
         if bundle is None and self.use_bundle:
             bundle = artifacts.load_bundle(self.store, self.net)
@@ -187,9 +200,13 @@ class FleetWorker:
         while not self._stop.wait(self.poll_s):
             try:
                 if self.store.latest_version() > self.version:
-                    self.swap_to()
-            except Exception:  # noqa: BLE001 - watch must outlive blips
-                pass
+                    # the swap itself retries under its own policy (a torn
+                    # read of an in-flight version resolves in ms)
+                    self._swap_policy.run(self.swap_to, stop=self._stop)
+                self._watch_policy.record_success()
+            except Exception as e:  # noqa: BLE001 - watch must outlive blips
+                self._stop.wait(self._watch_policy.record_failure(
+                    error=e, key=f"pid-{os.getpid()}"))
 
     # ------------------------------------------------------------ drain
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -277,7 +294,31 @@ class FleetWorker:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    fault = (worker.chaos.fire("worker.healthz")
+                             if worker.chaos is not None else None)
+                    if fault is not None and fault["fault"] == "hang-worker":
+                        # accepted TCP, never answers: the router's health
+                        # Deadline must declare us hung and respawn
+                        threading.Event().wait(
+                            float(fault.get("seconds", 60.0)))
+                        return
+                    if fault is not None and fault["fault"] == "partial-http":
+                        data = json.dumps(worker.healthz()).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data[:max(1, len(data) // 2)])
+                        self.wfile.flush()
+                        try:
+                            self.connection.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return
                     self._send(200, worker.healthz())
+                elif self.path == "/api/resilience":
+                    from ..runtime.resilience import resilience_stats  # noqa: PLC0415
+                    self._send(200, resilience_stats())
                 elif self.path == "/metrics":
                     text = worker.service.registry.prometheus_text()
                     data = text.encode()
@@ -369,6 +410,9 @@ def main(argv=None) -> int:
         max_batch=args.max_batch, max_queue_depth=args.max_queue,
         latency_budget_ms=args.latency_budget_ms,
         use_bundle=not args.no_bundle)
+    if os.environ.get("DL4JTPU_CHAOS_PLAN"):
+        from ..testing.chaos import FaultPlan  # noqa: PLC0415
+        worker.chaos = FaultPlan.from_env()
     worker.boot()
 
     done = threading.Event()

@@ -13,20 +13,32 @@ from .native_loader import (
     native_csv_read,
     native_idx_read,
 )
-from .checkpoint import CheckpointStore
+from .checkpoint import CheckpointCorruptError, CheckpointStore
 from .compile_manager import (
     CompileManager,
     enable_persistent_cache,
     get_compile_manager,
 )
 from .inference import canonicalize_input, fast_path_enabled
+from .resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlinePolicy,
+    RetryPolicy,
+    resilience_stats,
+)
 from .online import OnlineTrainer, get_online_trainers
 
 __all__ = [
+    "CheckpointCorruptError",
     "CheckpointStore",
+    "CircuitBreaker",
     "CompileManager",
+    "Deadline",
+    "DeadlinePolicy",
     "NativeDataSetIterator",
     "OnlineTrainer",
+    "RetryPolicy",
     "canonicalize_input",
     "enable_persistent_cache",
     "fast_path_enabled",
@@ -35,4 +47,5 @@ __all__ = [
     "native_available",
     "native_csv_read",
     "native_idx_read",
+    "resilience_stats",
 ]

@@ -27,27 +27,58 @@ This store adds the production contract on top of
   (and with it every cached executable) survives, so a rollback costs
   zero recompiles. A net living on a :class:`~..parallel.MeshLayout` gets
   its leaves re-placed on the layout's shardings.
+- **Integrity + quarantine.** Every version carries a sha256-per-entry
+  ``manifest.json`` written atomically with the zip. Restore paths
+  (:meth:`restore`/:meth:`load_into`/worker boot) verify the manifest
+  before deserializing; a corrupt or torn version is **quarantined**
+  (renamed ``*.quarantine``, counted in
+  ``dl4jtpu_checkpoint_corrupt_total``, never re-scanned as a version
+  but still counted by the id scan so version numbers stay monotonic)
+  and the restore falls back to the newest good version. Stale
+  ``.tmp-v*`` files left by a killed writer are swept to quarantine at
+  store construction.
 
 See docs/streaming.md for the on-disk layout and the OnlineTrainer's
-checkpoint/rollback semantics.
+checkpoint/rollback semantics, docs/robustness.md for the integrity and
+quarantine contract.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
 import re
 import threading
-import time
 import zipfile
 from typing import Any, List, Optional
 
 import numpy as np
 
-__all__ = ["CheckpointStore", "CheckpointInfo"]
+from .resilience import Deadline, RetryPolicy
+
+__all__ = ["CheckpointCorruptError", "CheckpointStore", "CheckpointInfo"]
 
 _VERSION_RE = re.compile(r"^model-v(\d{8})\.zip$")
+_QUARANTINE_RE = re.compile(r"^model-v(\d{8})\.zip\.quarantine$")
+_TMP_RE = re.compile(r"^\.tmp-v(\d{8})-(\d+)$")
+
+_MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A stored version failed integrity verification."""
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # e.g. EPERM: someone else's live process
+    return True
 
 
 def _version_filename(version: int) -> str:
@@ -111,11 +142,13 @@ class _Snapshot:
 class CheckpointStore:
     """Directory of monotonic, atomically-written model versions."""
 
-    def __init__(self, directory: str, *, retain: int = 5, registry=None):
+    def __init__(self, directory: str, *, retain: int = 5, registry=None,
+                 chaos=None):
         if int(retain) < 1:
             raise ValueError(f"retain must be >= 1, got {retain}")
         self.directory = str(directory)
         self.retain = int(retain)
+        self.chaos = chaos  # optional testing.chaos.FaultPlan hook
         os.makedirs(self.directory, exist_ok=True)
         self._lock = threading.Lock()
         self._next_version = self._scan_max() + 1
@@ -134,15 +167,47 @@ class CheckpointStore:
         self._m_pruned = registry.counter(
             "dl4jtpu_online_checkpoints_pruned_total",
             "checkpoint versions removed by retention pruning")
+        self._m_corrupt = registry.counter(
+            "dl4jtpu_checkpoint_corrupt_total",
+            "checkpoint versions quarantined after failing verification")
+        self._io = RetryPolicy("checkpoint.io", max_attempts=3, base_s=0.05,
+                               cap_s=1.0, retry_on=(OSError,),
+                               registry=registry)
+        self._sweep_stale_tmp()
 
     # ----------------------------------------------------------- directory
     def _scan_max(self) -> int:
+        """Largest version id on disk — INCLUDING quarantined versions, so
+        a quarantined id is never reissued to a new (different) save."""
         vmax = 0
         for name in os.listdir(self.directory):
-            m = _VERSION_RE.match(name)
+            m = _VERSION_RE.match(name) or _QUARANTINE_RE.match(name)
             if m:
                 vmax = max(vmax, int(m.group(1)))
         return vmax
+
+    def _sweep_stale_tmp(self) -> int:
+        """Quarantine ``.tmp-v*`` files whose writer pid is gone (a killed
+        writer mid-``_write``). A live pid — including our own, which may
+        carry an in-flight async writer from another store over this
+        directory — is left alone. Returns the count swept."""
+        swept = 0
+        for name in sorted(os.listdir(self.directory)):
+            m = _TMP_RE.match(name)
+            if not m:
+                continue
+            if _pid_alive(int(m.group(2))):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                os.replace(path, path + ".quarantine")
+            except OSError:
+                continue
+            swept += 1
+            self._m_corrupt.inc()
+            self._flight("checkpoint_quarantined", file=name,
+                         reason="stale temp file from dead writer")
+        return swept
 
     def path(self, version: int) -> str:
         return os.path.join(self.directory, _version_filename(version))
@@ -200,14 +265,13 @@ class CheckpointStore:
         subscriber half of the checkpoint bus). Returns its info, or None
         on timeout. Polling, not inotify: the store is also written from
         other processes/filesystems where watches don't travel."""
-        deadline = time.monotonic() + timeout_s
+        deadline = Deadline(timeout_s)
         while True:
             info = self.latest()
             if info is not None and info.version >= min_version:
                 return info
-            if time.monotonic() >= deadline:
+            if not deadline.pace(poll_s):
                 return None
-            time.sleep(poll_s)
 
     def stats(self) -> dict:
         """JSON-ready store view (the /api/online checkpoint listing)."""
@@ -227,18 +291,31 @@ class CheckpointStore:
         final = self.path(version)
         tmp = os.path.join(self.directory,
                            f".tmp-v{version:08d}-{os.getpid()}")
-        try:
+
+        def write_once():
             write_model(snapshot, tmp)
-            # the rng key rides as an extra container entry so resume
-            # replays the exact dropout chain
             with zipfile.ZipFile(tmp, "a", zipfile.ZIP_DEFLATED) as zf:
+                # the rng key rides as an extra container entry so resume
+                # replays the exact dropout chain
                 buf = io.BytesIO()
                 np.savez(buf, rng=np.asarray(snapshot.rng))
                 zf.writestr("rng.npz", buf.getvalue())
+                # sha256-per-entry manifest, inside the same atomic zip:
+                # either the whole verified container lands or nothing does
+                entries = {name: hashlib.sha256(zf.read(name)).hexdigest()
+                           for name in zf.namelist()}
+                zf.writestr(_MANIFEST_NAME, json.dumps(
+                    {"algo": "sha256", "entries": entries}, sort_keys=True))
             os.replace(tmp, final)  # atomic: readers never see a torn file
+
+        try:
+            self._io.run(write_once)
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
+        if self.chaos is not None:
+            self.chaos.fire("checkpoint.write", path=final,
+                            directory=self.directory, version=version)
         self._m_saves.inc()
         self._flight("online_checkpoint", version=version,
                      iteration=snapshot.iteration, path=final)
@@ -312,45 +389,141 @@ class CheckpointStore:
             self._m_pruned.inc(removed)
         return removed
 
-    # ------------------------------------------------------------- restore
-    def _open(self, version: Optional[int]) -> tuple:
-        info = None
-        if version is None:
-            info = self.latest()
-            if info is None:
-                raise FileNotFoundError(
-                    f"checkpoint store {self.directory!r} holds no versions")
-            version = info.version
-        path = self.path(int(version))
-        if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"checkpoint version {version} not in {self.directory!r} "
-                f"(have {[v.version for v in self.versions()]})")
-        return int(version), path
+    # ----------------------------------------------------------- integrity
+    def verify(self, version: int) -> str:
+        """Check a stored version against its sha256 manifest.
 
-    def restore(self, version: Optional[int] = None):
+        Returns ``"ok"`` (manifest verified) or ``"legacy"`` (pre-manifest
+        container — accepted, nothing to check against). Raises
+        :class:`CheckpointCorruptError` on a torn zip, a digest mismatch,
+        or a manifest that disagrees with the zip's entry list.
+        """
+        path = self.path(int(version))
+        try:
+            with zipfile.ZipFile(path, "r") as zf:
+                names = set(zf.namelist())
+                if _MANIFEST_NAME not in names:
+                    zf.testzip()
+                    return "legacy"
+                manifest = json.loads(zf.read(_MANIFEST_NAME))
+                entries = dict(manifest.get("entries") or {})
+                extra = names - set(entries) - {_MANIFEST_NAME}
+                missing = set(entries) - names
+                if extra or missing:
+                    raise CheckpointCorruptError(
+                        f"v{version}: manifest/zip mismatch "
+                        f"(extra={sorted(extra)}, missing={sorted(missing)})")
+                for name, digest in entries.items():
+                    got = hashlib.sha256(zf.read(name)).hexdigest()
+                    if got != digest:
+                        raise CheckpointCorruptError(
+                            f"v{version}: sha256 mismatch in {name!r}")
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # BadZipFile, truncated read, bad json...
+            raise CheckpointCorruptError(f"v{version}: unreadable ({e!r})") from e
+        return "ok"
+
+    def quarantine(self, version: int, reason: str = "") -> str:
+        """Rename a version out of the scan set (``*.quarantine``); it is
+        never served again but its id stays claimed (see `_scan_max`)."""
+        path = self.path(int(version))
+        target = path + ".quarantine"
+        try:
+            os.replace(path, target)
+        except FileNotFoundError:
+            # Lost a cross-process race: another store over the same
+            # directory (a sibling fleet worker) quarantined it first.
+            return target
+        self._m_corrupt.inc()
+        self._flight("checkpoint_quarantined", version=int(version),
+                     reason=reason or "verification failed")
+        return target
+
+    def _disk_versions(self) -> List[int]:
+        """Raw version ids on disk, ascending — unlike :meth:`versions`
+        this does NOT silently skip unreadable files, so a fully garbled
+        newest version is still seen (and can be quarantined)."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _VERSION_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _open_verified(self, version: Optional[int], *,
+                       fallback: bool) -> tuple:
+        """Resolve (version, path), verifying integrity first. A corrupt
+        version is quarantined; with ``fallback`` the walk continues to
+        the next-newest good version, without it the corruption raises."""
+        if version is not None:
+            path = self.path(int(version))
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"checkpoint version {version} not in {self.directory!r} "
+                    f"(have {self._disk_versions()})")
+            try:
+                self.verify(int(version))
+                return int(version), path
+            except CheckpointCorruptError as e:
+                self.quarantine(int(version), reason=str(e))
+                if not fallback:
+                    raise
+        for v in reversed(self._disk_versions()):
+            try:
+                self.verify(v)
+                return v, self.path(v)
+            except CheckpointCorruptError as e:
+                self.quarantine(v, reason=str(e))
+        raise FileNotFoundError(
+            f"checkpoint store {self.directory!r} holds no intact versions")
+
+    # ------------------------------------------------------------- restore
+    def restore(self, version: Optional[int] = None, *,
+                fallback: Optional[bool] = None):
         """Rebuild a FRESH model from a stored version (default: latest) —
-        ``utils.serialization.restore_model`` plus the stored rng key."""
+        ``utils.serialization.restore_model`` plus the stored rng key.
+        Verifies integrity first; a corrupt version is quarantined and,
+        when no explicit version was pinned (or ``fallback=True``), the
+        newest remaining good version is restored instead."""
+        return self.restore_with_info(version, fallback=fallback)[0]
+
+    def restore_with_info(self, version: Optional[int] = None, *,
+                          fallback: Optional[bool] = None):
+        """:meth:`restore`, returning ``(model, CheckpointInfo)`` — the
+        fleet worker boot path, which must know WHICH version survived
+        verification to advertise it on the bus."""
         from ..utils.serialization import restore_model  # noqa: PLC0415
 
-        version, path = self._open(version)
+        if fallback is None:
+            fallback = version is None
+        version, path = self._open_verified(version, fallback=fallback)
         model = restore_model(path)
         self._load_rng(model, path)
         self._m_restores.inc()
-        return model
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("meta.json"))
+        return model, CheckpointInfo(version, path, meta,
+                                     os.path.getsize(path))
 
-    def load_into(self, model, version: Optional[int] = None) -> int:
+    def load_into(self, model, version: Optional[int] = None, *,
+                  fallback: Optional[bool] = None) -> int:
         """Roll a LIVE model back to a stored version in place.
 
         Loads params/opt-state/state/iteration/rng without ``init(force)``,
         so the model keeps its compile-manager token — every cached
         executable still matches (same abstract shapes) and the rollback
         pays zero recompiles. When the model lives on a MeshLayout the
-        loaded leaves are re-placed on its shardings. Returns the version.
+        loaded leaves are re-placed on its shardings. Verifies integrity
+        first (corrupt → quarantine, and with ``fallback`` — the default
+        when no version is pinned — the next good version loads instead).
+        Returns the version actually loaded.
         """
         from ..utils.serialization import _load_leaves  # noqa: PLC0415
 
-        version, path = self._open(version)
+        if fallback is None:
+            fallback = version is None
+        version, path = self._open_verified(version, fallback=fallback)
         model.init()
         with zipfile.ZipFile(path, "r") as zf:
             meta = json.loads(zf.read("meta.json"))

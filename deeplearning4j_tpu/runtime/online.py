@@ -214,6 +214,12 @@ class OnlineTrainer:
         self._ckpt_result: Optional[int] = None
         self._source_down = False
         self._last_good_version: Optional[int] = None
+        # replay bookkeeping: the source cursor + iteration at the last
+        # good checkpoint bound the poisoned span on rollback
+        self._last_good_cursor: Optional[int] = None
+        self._last_good_iteration = 0
+        self._last_replay: Optional[dict] = None
+        self.replay_max_records = 2048
         self._steps_since_checkpoint = 0
         self._loss_baseline: Optional[float] = None
         self._loss_var: Optional[float] = None  # EMA of within-window loss variance
@@ -253,12 +259,27 @@ class OnlineTrainer:
         self._m_swaps = _Count(reg.counter(
             "dl4jtpu_online_swaps_total",
             "live model versions hot-swapped into serving"))
+        self._m_replays = _Count(reg.counter(
+            "dl4jtpu_online_replays_total",
+            "poisoned-span replays validated after rollback"))
         self._m_paused = reg.gauge(
             "dl4jtpu_online_paused",
             "1 while ingestion is paused (anomaly policy or pause())")
         self._m_rate = reg.gauge(
             "dl4jtpu_online_ingest_samples_per_sec",
             "recent record ingest rate of the online trainer")
+
+        # typed failure handling for the source poll loop (runtime/
+        # resilience.py): deterministic exponential backoff on consecutive
+        # failures, a breaker that stops hammering a hard-down broker
+        from .resilience import CircuitBreaker, RetryPolicy  # noqa: PLC0415
+
+        self._source_policy = RetryPolicy(
+            f"online.source[{self.name}]", base_s=self.source_retry_s,
+            cap_s=max(2.0, 8 * self.source_retry_s), jitter=0.25,
+            registry=reg)
+        self._source_breaker = CircuitBreaker(
+            f"online.source[{self.name}].circuit", registry=reg)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "OnlineTrainer":
@@ -274,6 +295,8 @@ class OnlineTrainer:
             self._last_good_version = info.version
         elif self.store is not None and self._last_good_version is None:
             self._last_good_version = self.store.latest().version
+        self._last_good_cursor = self._source_cursor()
+        self._last_good_iteration = int(self.net.iteration)
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"dl4j-online-{self.name}")
@@ -390,6 +413,8 @@ class OnlineTrainer:
         version = self.store.save_async(snap)
         self._steps_since_checkpoint = 0
         self._last_good_version = version
+        self._last_good_cursor = self._source_cursor()
+        self._last_good_iteration = int(self.net.iteration)
         do_swap = self.swap_on_checkpoint if swap is None else bool(swap)
         if do_swap and self._service is not None \
                 and self._serve_name is not None:
@@ -451,15 +476,121 @@ class OnlineTrainer:
             self.flight.record("online_rollback_skipped", trainer=self.name,
                                reason=reason, cause="no stored versions")
             return False
-        self.store.load_into(self.net, target)
+        rollback_step = int(self.net.iteration)
+        rollback_cursor = self._source_cursor()
+        # a corrupt target quarantines and falls back to the newest good
+        # version rather than wedging the recovery path
+        loaded = self.store.load_into(self.net, target, fallback=True)
         self._m_rollbacks.inc()
         # the drifted/poisoned window means must not re-trigger on the
         # restored model; the healthy baseline survives
         self._recent_losses.clear()
         self.flight.record("online_rollback", trainer=self.name,
-                           reason=reason, version=int(target),
+                           reason=reason, version=int(loaded),
                            iteration=int(self.net.iteration))
+        span = {"start_step": int(self.net.iteration),
+                "end_step": rollback_step,
+                "start_cursor": self._last_good_cursor,
+                "end_cursor": rollback_cursor}
+        self.flight.record("online_poisoned_span", trainer=self.name,
+                           reason=reason, **span)
+        self._replay_span(span, reason)
         return True
+
+    # --------------------------------------------------------------- replay
+    def _source_cursor(self) -> Optional[int]:
+        """The source's replay cursor, or None when unsupported."""
+        fn = getattr(self.source, "replay_cursor", None)
+        if not callable(fn):
+            return None
+        try:
+            return int(fn())
+        except Exception:
+            return None
+
+    def _replay_span(self, span: dict, reason: str) -> None:
+        """Re-ingest the poisoned span through a validation-only pass.
+
+        For replayable sources (streaming.ReplayableSource contract) the
+        span's records are re-fetched and scored — loss only, no optimizer
+        updates — against the same adaptive loss band the drift detector
+        uses. The outcome (``clean``/``poisoned``) lands in the flight
+        bundle next to the rollback; a poisoned verdict means the span's
+        data itself was bad and is dropped for good. Non-replayable
+        sources record an explicit ``replay: unsupported`` event and keep
+        the pre-replay behavior.
+        """
+        replay = getattr(self.source, "replay", None)
+        if (not callable(replay) or span["start_cursor"] is None
+                or span["end_cursor"] is None):
+            self._last_replay = {"outcome": "unsupported", "reason": reason,
+                                 **span}
+            self.flight.record("online_replay_unsupported",
+                               trainer=self.name, reason=reason,
+                               replay="unsupported", **span)
+            return
+        try:
+            records = list(replay(span["start_cursor"], span["end_cursor"]))
+        except Exception as e:  # noqa: BLE001 - replay must not kill recovery
+            self._last_replay = {"outcome": "error", "reason": reason,
+                                 "error": repr(e), **span}
+            self.flight.record("online_replay_error", trainer=self.name,
+                               reason=reason, error=repr(e))
+            return
+        records = records[:self.replay_max_records]
+        losses = []
+        checked = 0
+        buf: list = []
+        key = None
+
+        def score(batch):
+            f = np.stack([b[0] for b in batch])
+            l = np.stack([b[1] for b in batch])
+            return float(self.net.loss_fn(self.net.params, f, l))
+
+        for raw in records:
+            rec = self._norm_record(raw)
+            if rec is None:
+                continue
+            k = (rec[0].shape, rec[1].shape)  # exact-shape groups: no padding
+            if key is not None and (k != key or len(buf) >= self.batch):
+                try:
+                    losses.append(score(buf))
+                    checked += len(buf)
+                except Exception:
+                    pass
+                buf = []
+            key = k
+            buf.append(rec)
+        if buf:
+            try:
+                losses.append(score(buf))
+                checked += len(buf)
+            except Exception:
+                pass
+        mean = float(np.mean(losses)) if losses else None
+        baseline = self._loss_baseline
+        sigma = float(np.sqrt(self._loss_var)) if self._loss_var else 0.0
+        sigma_floor = (max(self.drift_factor - 1.0, 0.0)
+                       / max(self.drift_factor, 1e-6)
+                       * max(abs(baseline), 1e-6)) if baseline is not None else 0.0
+        limit = (baseline + self.drift_factor * max(sigma, sigma_floor)
+                 if baseline is not None else None)
+        if mean is None:
+            outcome = "empty"
+        elif not np.isfinite(mean) or (limit is not None and mean > limit):
+            outcome = "poisoned"  # the span's data was bad: drop it for good
+        else:
+            outcome = "clean"
+        self._m_replays.inc()
+        self._last_replay = {"outcome": outcome, "reason": reason,
+                             "records": len(records), "checked": checked,
+                             "mean_loss": mean,
+                             "limit": limit, **span}
+        self.flight.record("online_replay", trainer=self.name, reason=reason,
+                           outcome=outcome, records=len(records),
+                           checked=checked, mean_loss=mean, limit=limit,
+                           **span)
 
     def _check_window_health(self, losses: np.ndarray) -> None:
         finite = np.isfinite(losses)
@@ -507,6 +638,13 @@ class OnlineTrainer:
 
     # -------------------------------------------------------------- ingest
     def _poll_source(self):
+        if not self._source_breaker.allow():
+            # circuit open: stop hammering a hard-down source until the
+            # cooldown lets one probe through
+            self._stop.wait(min(self.source_retry_s,
+                                self._source_breaker.cooldown_remaining()
+                                or self.source_retry_s))
+            return None
         try:
             rec = self.source.poll(timeout=0.05)
         except Exception as e:  # noqa: BLE001 - disconnects must not kill us
@@ -515,11 +653,15 @@ class OnlineTrainer:
                 self.flight.record("online_source_error", trainer=self.name,
                                    error=f"{type(e).__name__}: {e}"[:200])
             self._m_source_errors.inc()
-            self._stop.wait(self.source_retry_s)
+            self._source_breaker.record_failure()
+            self._stop.wait(self._source_policy.record_failure(
+                error=e, key=self.name))
             return None
         if self._source_down:
             self._source_down = False
             self._m_reconnects.inc()
+            self._source_policy.record_success()
+            self._source_breaker.record_success()
             self.flight.record("online_source_reconnect", trainer=self.name)
         return rec
 
@@ -791,6 +933,10 @@ class OnlineTrainer:
                                      for x in self._recent_losses],
             "last_anomaly": self._last_anomaly,
             "anomalies": anomalies,
+            "replays_total": self._m_replays.n,
+            "last_replay": self._last_replay,
+            "replay_supported": callable(
+                getattr(self.source, "replay", None)),
             "last_good_version": self._last_good_version,
             "checkpoint_every_steps": self.checkpoint_every_steps,
             "serving_model": self._serve_name,

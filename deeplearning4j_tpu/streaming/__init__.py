@@ -10,6 +10,8 @@ from .pipeline import (
     Route,
     QueueSource,
     RecordSource,
+    ReplayableSource,
+    ReplayBufferSource,
     ServeRoute,
     StreamingPipeline,
     TrainRoute,
@@ -24,6 +26,8 @@ __all__ = [
     "Route",
     "QueueSource",
     "RecordSource",
+    "ReplayBufferSource",
+    "ReplayableSource",
     "ServeRoute",
     "SocketRecordSink",
     "SocketRecordSource",

@@ -31,6 +31,63 @@ class RecordSource:
         pass
 
 
+class ReplayableSource(RecordSource):
+    """Optional replay contract on top of :class:`RecordSource`.
+
+    A replayable source exposes a monotonically increasing **cursor** —
+    the count of records it has delivered — and can re-yield any recent
+    span ``(start, end]`` of them. The OnlineTrainer uses this after a
+    drift rollback: the poisoned span ``[last_good_cursor,
+    rollback_cursor]`` is re-ingested through a validation-only pass
+    (loss-band gate, no optimizer updates) before normal ingestion
+    resumes; sources without the contract keep today's behavior and the
+    rollback records an explicit ``replay: unsupported`` event. See
+    docs/robustness.md for the full contract.
+    """
+
+    def replay_cursor(self) -> int:
+        """Records delivered so far (0 before the first poll)."""
+        raise NotImplementedError
+
+    def replay(self, start: int, end: int):
+        """Iterable of the records delivered in cursor span (start, end].
+        Records that have aged out of the source's retention are simply
+        absent — replay is best-effort over what is still held."""
+        raise NotImplementedError
+
+
+class ReplayBufferSource(ReplayableSource):
+    """Make ANY source replayable by remembering its last ``capacity``
+    delivered records (the in-process analogue of broker retention —
+    a Kafka-backed source would instead seek on stored offsets)."""
+
+    def __init__(self, inner: RecordSource, capacity: int = 65536):
+        import collections  # noqa: PLC0415
+        self.inner = inner
+        self._buf = collections.deque(maxlen=int(capacity))
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def poll(self, timeout: float = 0.1):
+        rec = self.inner.poll(timeout=timeout)
+        if rec is not None:
+            with self._lock:
+                self._n += 1
+                self._buf.append((self._n, rec))
+        return rec
+
+    def replay_cursor(self) -> int:
+        with self._lock:
+            return self._n
+
+    def replay(self, start: int, end: int):
+        with self._lock:
+            return [rec for i, rec in self._buf if start < i <= end]
+
+    def close(self) -> None:
+        self.inner.close()
+
+
 class QueueSource(RecordSource):
     """In-process source (tests / direct feeding; the 'direct:' Camel route)."""
 

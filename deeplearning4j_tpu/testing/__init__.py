@@ -1,0 +1,6 @@
+"""Test-support subsystem: deterministic fault injection (:mod:`.chaos`)."""
+
+from .chaos import CHAOS_PLAN_ENV, ChaosSource, FaultPlan, corrupt_file, truncate_file
+
+__all__ = ["CHAOS_PLAN_ENV", "ChaosSource", "FaultPlan", "corrupt_file",
+           "truncate_file"]

@@ -38,6 +38,9 @@ Pages:
 - ``/api/fleet``      — multi-process fleet snapshot: every in-process
   FleetRouter's per-worker liveness/version/queue view plus merged exact
   p50/p99 (see docs/serving.md § Fleet).
+- ``/api/resilience`` — live state of every registered failure-handling
+  site: retry policies (attempts/backoff), deadlines (expiries) and
+  circuit breakers (state/cooldown) (see docs/robustness.md).
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
   and continuous-decode endpoints over the process serving front-end
   (``serving.get_service()``; see docs/serving.md).
@@ -510,6 +513,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, json.dumps(
                 {"routers": [r.stats() for r in get_fleet_routers()]},
                 default=str).encode())
+        if path == "/api/resilience":
+            # live state of every registered failure-handling site:
+            # retry policies, deadlines, circuit breakers
+            # (docs/robustness.md)
+            from ..runtime.resilience import resilience_stats  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                resilience_stats(), default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]

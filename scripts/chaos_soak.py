@@ -1,28 +1,38 @@
 #!/usr/bin/env python
-"""Chaos soak for the online-learning loop (ISSUE 10 acceptance).
+"""Chaos soak for the online-learning loop (ISSUE 10/14 acceptance).
 
 Runs an :class:`~deeplearning4j_tpu.runtime.online.OnlineTrainer` against a
 deliberately hostile stream and asserts the PRODUCTION outcome, not the
 happy path: the trainer must end ALIVE, having rolled back to the last good
-checkpoint, with a flight-recorder bundle — not a stack trace — as the
-artifact, and steady-state ingest must have paid zero warm compiles.
+checkpoint, replayed the poisoned span through a validation-only pass, and
+left a flight-recorder bundle — not a stack trace — as the artifact, while
+steady-state ingest pays zero warm compiles.
 
-Injected chaos:
+All chaos is driven by a seeded
+:class:`~deeplearning4j_tpu.testing.chaos.FaultPlan` — the same seed always
+yields the same fault sequence (``plan.fired``), so a failing soak can be
+replayed exactly:
 
 - **Ragged shapes** — sequence records with lengths drawn from a pool (pow2
   time buckets absorb them) and ragged trailing micro-batches.
-- **Source disconnect/reconnect** — the source raises ``ConnectionError``
-  for an outage window every N polls; the trainer must back off and resume.
-- **NaN batches** — bursts of all-NaN features; the watchdog hook must
-  pause, roll back, dump, resume.
+- **Source disconnect/reconnect** — a ``source-error`` fault every N polls
+  raises ``ConnectionError`` for an outage window; the trainer must back
+  off through its retry policy and resume.
+- **NaN bursts** — ``nan-burst`` faults at scheduled record indices poison
+  features with NaN; the watchdog must pause, roll back, replay the
+  poisoned span, dump, resume.
 - **Slow consumers** — serving clients that hold the swapped model while
   dripping requests, while checkpoints keep hot-swapping under them.
+
+The stream is wrapped ``ReplayBufferSource(ChaosSource(queue, plan))`` so
+the replayed span *includes* the injected NaNs and the validation pass can
+actually see the poison.
 
 Usage (the check.sh short soak uses the in-process entry ``run_soak``)::
 
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--records 4096]
         [--batch 32] [--stage 4] [--nan-bursts 3] [--outages 3]
-        [--seq] [--deadline 300]
+        [--seq] [--deadline 300] [--seed 0]
 
 Exit 0 and a one-line JSON summary on success; exit 1 otherwise.
 """
@@ -44,32 +54,23 @@ if REPO_DIR not in sys.path:
     sys.path.insert(0, REPO_DIR)
 
 
-class FlakySource:
-    """RecordSource wrapper that simulates broker outages: every
-    ``outage_every`` successful polls, ``poll`` raises ``ConnectionError``
-    for ``outage_polls`` consecutive calls, then recovers. Buffered records
-    survive the outage (a real broker redelivers)."""
+def build_plan(records: int, batch: int, warm: int, nan_bursts: int,
+               outages: bool, seed: int):
+    """The soak's deterministic fault schedule (also used by tests)."""
+    from deeplearning4j_tpu.testing.chaos import FaultPlan
 
-    def __init__(self, inner, outage_every: int = 400, outage_polls: int = 4):
-        self.inner = inner
-        self.outage_every = int(outage_every)
-        self.outage_polls = int(outage_polls)
-        self._ok_polls = 0
-        self._down_left = 0
-        self.outages = 0
-
-    def poll(self, timeout: float = 0.1):
-        if self._down_left > 0:
-            self._down_left -= 1
-            raise ConnectionError("chaos: source disconnected")
-        self._ok_polls += 1
-        if self.outage_every > 0 and self._ok_polls % self.outage_every == 0:
-            self._down_left = self.outage_polls
-            self.outages += 1
-        return self.inner.poll(timeout=timeout)
-
-    def close(self) -> None:
-        self.inner.close()
+    faults = []
+    if nan_bursts:
+        # Burst start indices over the steady-state stream, offset past the
+        # warm phase; each burst poisons two batches' worth of records.
+        at = [int(warm + f * records) for f in
+              np.linspace(0.2, 0.9, max(nan_bursts, 1))]
+        faults.append({"site": "source.record", "fault": "nan-burst",
+                       "at": at, "params": {"records": 2 * batch}})
+    if outages:
+        faults.append({"site": "source.poll", "fault": "source-error",
+                       "every": 300, "params": {"polls": 4}})
+    return FaultPlan(seed, faults)
 
 
 def run_soak(records: int = 4096, batch: int = 32, stage: int = 4,
@@ -108,7 +109,8 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
     from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
     from deeplearning4j_tpu.runtime.online import OnlineTrainer
     from deeplearning4j_tpu.serving import InferenceService
-    from deeplearning4j_tpu.streaming import QueueSource
+    from deeplearning4j_tpu.streaming import QueueSource, ReplayBufferSource
+    from deeplearning4j_tpu.testing.chaos import ChaosSource
     from deeplearning4j_tpu.telemetry.flight_recorder import (
         get_flight_recorder)
 
@@ -123,11 +125,9 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
             seed=seed)
         lengths = (5, 7, 8, 11, 13, 16)  # → pow2 buckets 8 and 16
 
-        def make_record(nan=False):
+        def make_record():
             t = int(rng.choice(lengths))
             x = rng.normal(size=(t, feature_dim)).astype(np.float32)
-            if nan:
-                x[:] = np.nan
             y = np.eye(classes, dtype=np.float32)[
                 rng.integers(0, classes, t)]
             return x, y
@@ -141,19 +141,22 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
             seed=seed)
         true_w = rng.normal(size=(feature_dim, classes))
 
-        def make_record(nan=False):
+        def make_record():
             x = rng.normal(size=feature_dim).astype(np.float32)
-            if nan:
-                x[:] = np.nan
             y = np.eye(classes, dtype=np.float32)[int(np.argmax(x @ true_w))]
             return x, y
 
+    warm = max(4 * batch * stage, 256)
+    plan = build_plan(records, batch, warm, nan_bursts, outages, seed)
     net = MultiLayerNetwork(conf).init()
     store = CheckpointStore(
         tempfile.mkdtemp(prefix="dl4jtpu_soak_ckpt_"), retain=4)
     svc = InferenceService(max_delay_ms=0.5)
     queue = QueueSource(maxsize=8192)
-    source = FlakySource(queue, outage_every=300 if outages else 0)
+    chaos_src = ChaosSource(queue, plan)
+    # Replay buffer OUTERMOST: the replayed span must include the NaNs the
+    # plan injected, so the validation-only pass can flag it "poisoned".
+    source = ReplayBufferSource(chaos_src)
     trainer = OnlineTrainer(
         net, source, batch=batch, stage=stage, linger=0.05,
         name="chaos-soak", checkpoint_store=store,
@@ -187,7 +190,6 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
         return False
 
     t_start = time.monotonic()
-    warm = max(4 * batch * stage, 256)
     for _ in range(warm):
         queue.put(*make_record())
     assert wait_for(lambda: trainer.stats()["records_total"] >= warm,
@@ -200,18 +202,11 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
         th.start()
     compiles_mark = cm.compiles.value
 
+    # NaN poisoning is plan-scheduled at delivery ("source.record" site),
+    # so the producer just streams clean records straight through.
     produced = warm
-    burst_at = np.linspace(records * 0.2, records * 0.9,
-                           max(nan_bursts, 1)).astype(int) \
-        if nan_bursts else np.array([], int)
-    next_burst = list(burst_at)
     n = 0
     while n < records and time.monotonic() - t_start < deadline_s:
-        if next_burst and n >= next_burst[0]:
-            next_burst.pop(0)
-            for _ in range(2 * batch):  # a NaN window's worth
-                queue.put(*make_record(nan=True))
-                produced += 1
         queue.put(*make_record())
         produced += 1
         n += 1
@@ -237,8 +232,11 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
         "windows": int(stats["windows_total"]),
         "samples_per_sec": round(stats["records_total"] / elapsed, 1),
         "nan_bursts": int(nan_bursts),
+        "nan_records": int(chaos_src.nan_records),
         "rollbacks": int(stats["rollbacks_total"]),
-        "outages": source.outages,
+        "replays": int(stats["replays_total"]),
+        "last_replay": stats["last_replay"],
+        "outages": int(chaos_src.outages),
         "reconnects": int(stats["reconnects_total"]),
         "source_errors": int(stats["source_errors_total"]),
         "swaps": int(stats["swaps_total"]),
@@ -249,6 +247,7 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
         "flight_bundles": list(recorder.dumps),
         "consumer_errors": consumer_errors[:5],
         "anomalies": stats["anomalies"],
+        "chaos": plan.summary(),
     }
     trainer.stop(checkpoint=False)
     svc.stop()
@@ -257,6 +256,7 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
     assert not consumer_errors, f"serving failed under swaps: {consumer_errors[:3]}"
     if nan_bursts:
         assert summary["rollbacks"] >= 1, "NaN bursts produced no rollback"
+        assert summary["replays"] >= 1, "rollback ran no poisoned-span replay"
         assert summary["flight_bundles"], "no flight bundle artifact"
     if outages:
         assert summary["reconnects"] >= 1, "outages produced no reconnect"
@@ -277,6 +277,8 @@ def main(argv=None) -> int:
                     help="ragged sequence records (LSTM) instead of rows")
     ap.add_argument("--deadline", type=float, default=300.0)
     ap.add_argument("--flight-dir", default=None)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="FaultPlan seed — same seed, same fault sequence")
     ap.add_argument("--no-force-cpu", action="store_true",
                     help="keep the env's pinned backend (default forces the "
                          "CPU backend like the rest of the check harness)")
@@ -288,7 +290,8 @@ def main(argv=None) -> int:
     summary = run_soak(records=args.records, batch=args.batch,
                        stage=args.stage, nan_bursts=args.nan_bursts,
                        outages=not args.no_outages, seq=args.seq,
-                       deadline_s=args.deadline, flight_dir=args.flight_dir)
+                       deadline_s=args.deadline, flight_dir=args.flight_dir,
+                       seed=args.seed)
     print(json.dumps(summary))
     return 0
 

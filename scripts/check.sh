@@ -16,20 +16,34 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ 
 echo "== dl4jtpu-check: telemetry package held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/telemetry/ --fail-on warning
 
-echo "== dl4jtpu-check: compile/bucketing/serving/fleet/layout/online/tune modules held to --fail-on warning"
+echo "== dl4jtpu-check: compile/bucketing/serving/fleet/layout/online/tune/resilience modules held to --fail-on warning"
 env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/runtime/compile_manager.py \
     deeplearning4j_tpu/runtime/inference.py \
     deeplearning4j_tpu/runtime/online.py \
     deeplearning4j_tpu/runtime/checkpoint.py \
+    deeplearning4j_tpu/runtime/resilience.py \
     deeplearning4j_tpu/datasets/bucketing.py \
     deeplearning4j_tpu/serving/ \
     deeplearning4j_tpu/fleet/ \
+    deeplearning4j_tpu/testing/ \
     deeplearning4j_tpu/utils/subproc.py \
     deeplearning4j_tpu/parallel/layout.py \
     deeplearning4j_tpu/analysis/shard_flow.py \
     deeplearning4j_tpu/tune/ \
     --fail-on warning
+
+echo "== dl4jtpu-check: no bespoke retry sleeps outside runtime/resilience.py"
+# Failure handling must flow through the shared typed policies; a raw
+# time.sleep in a fleet/online/checkpoint retry loop is a regression.
+if grep -nE 'time\.sleep\(' \
+    deeplearning4j_tpu/fleet/*.py \
+    deeplearning4j_tpu/runtime/online.py \
+    deeplearning4j_tpu/runtime/checkpoint.py; then
+    echo "FAIL: bespoke time.sleep in a failure-handling module — use" \
+         "RetryPolicy/Deadline from deeplearning4j_tpu/runtime/resilience.py" >&2
+    exit 1
+fi
 
 echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
 env JAX_PLATFORMS=cpu python - <<'PY'
@@ -687,6 +701,183 @@ with tempfile.TemporaryDirectory() as work:
               "with 0 recompiles + changed outputs, SIGKILLed worker "
               f"respawned warm at v2 (respawns={snap['respawns']}), drain "
               "refuses new work")
+    finally:
+        router.stop()
+PY
+
+echo "== dl4jtpu-failsafe self-scan: seeded chaos (corrupt boot, hung worker, NaN rollback+replay, SIGKILL)"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 14 acceptance: the fleet under a SEEDED FaultPlan. The store's
+# newest version is corrupted through the plan's checkpoint.write hook, so
+# two cold worker PROCESSES must quarantine it and warm-boot the previous
+# good version with zero compiles; a hung worker (healthz frozen by the
+# env-transported plan, at-most-once across the fleet via marker file) is
+# detected by the health Deadline and respawned with reason="hung"; a NaN
+# burst injected at a plan-scheduled record index rolls the online trainer
+# back, replays the poisoned span, and the recovered checkpoint still
+# rolls out; a SIGKILLed worker respawns; /api/resilience reports the
+# shared policies' live state.
+import json
+import os
+import signal
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.fleet import FleetRouter, build_bundle, save_bundle
+from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+from deeplearning4j_tpu.runtime.online import OnlineTrainer
+from deeplearning4j_tpu.streaming import QueueSource, ReplayBufferSource
+from deeplearning4j_tpu.testing.chaos import ChaosSource, FaultPlan
+from deeplearning4j_tpu.tune import scoped_env
+
+SEED = 1405
+
+
+def wait_for(pred, seconds, what):
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"chaos self-scan: {what} never happened")
+
+
+with tempfile.TemporaryDirectory() as work:
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        seed=7)).init()
+    store_dir = os.path.join(work, "store")
+    write_plan = FaultPlan(SEED, [{"site": "checkpoint.write",
+                                   "fault": "corrupt-checkpoint",
+                                   "at": [2]}])
+    store = CheckpointStore(store_dir, chaos=write_plan)
+    store.save(net)  # v1 — the good version
+    save_bundle(store, build_bundle(
+        net, example=np.zeros((1, 8), np.float32), argmax=True, max_batch=8))
+    store.save(net)  # v2 — byte-corrupted by the plan as it lands
+    assert [f["fault"] for f in write_plan.fired] == ["corrupt-checkpoint"]
+
+    marker = os.path.join(work, "hang.marker")
+    hang_plan = FaultPlan(SEED, [{"site": "worker.healthz",
+                                  "fault": "hang-worker", "at": [3],
+                                  "params": {"seconds": 30},
+                                  "marker": marker}])
+    with scoped_env(DL4JTPU_CHAOS_PLAN=hang_plan.to_env()):
+        router = FleetRouter(store_dir, workers=2, poll_s=0.2,
+                             health_timeout_s=2.0,
+                             worker_args={"max_delay_ms": 0,
+                                          "max_batch": 8}).start()
+    try:
+        # --- corrupt-latest cold boot: quarantine + serve previous good
+        for handle in router.workers:
+            router._check_worker(handle)
+        snaps = router.stats()["workers"]
+        ready = [s for s in snaps if s["ready"]]
+        assert ready, snaps
+        assert all(s["version"] == 1 for s in ready), snaps
+        assert all(s["compiles_since_ready"] == 0 for s in ready), snaps
+        assert os.path.exists(os.path.join(
+            store_dir, "model-v00000002.zip.quarantine")), \
+            os.listdir(store_dir)
+        probe = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+        status, body, _ = router.route_predict({"features": probe.tolist()})
+        assert status == 200, (status, body)
+
+        # --- hung worker: frozen healthz → Deadline expiry → kill+respawn
+        hung = router._m_respawns.labels(reason="hung")
+        wait_for(lambda: hung.value >= 1, 60, "hung-worker detection")
+        assert os.path.exists(marker)
+        wait_for(lambda: all(s["ready"]
+                             for s in router.stats()["workers"]),
+                 90, "respawn after hang")
+
+        # --- NaN burst → rollback → poisoned-span replay → rollout
+        src_plan = FaultPlan(SEED, [{"site": "source.record",
+                                     "fault": "nan-burst", "at": [260],
+                                     "params": {"records": 32}}])
+        queue = QueueSource(maxsize=4096)
+        source = ReplayBufferSource(ChaosSource(queue, src_plan))
+        trainer = OnlineTrainer(store.restore(), source, batch=16, stage=2,
+                                linger=0.05, name="chaos-scan",
+                                checkpoint_store=store,
+                                checkpoint_every_steps=8)
+        trainer.start()
+        try:
+            rng = np.random.default_rng(SEED)
+            w = rng.normal(size=(8, 4))
+
+            def put(n):
+                for _ in range(n):
+                    x = rng.normal(size=8).astype(np.float32)
+                    y = np.eye(4, dtype=np.float32)[int(np.argmax(x @ w))]
+                    queue.put(x, y)
+
+            put(256)
+            wait_for(lambda: trainer.stats()["steps_total"] >= 8, 90,
+                     "online ingest")
+            put(128)  # deliveries 257..384; the plan poisons 260..291
+            wait_for(lambda: trainer.stats()["rollbacks_total"] >= 1, 90,
+                     "NaN rollback")
+            wait_for(lambda: trainer.stats()["replays_total"] >= 1, 30,
+                     "poisoned-span replay")
+            st = trainer.stats()
+            assert st["last_replay"]["outcome"] in (
+                "poisoned", "clean", "empty"), st
+            assert trainer.alive
+            final_v = trainer.checkpoint_now(swap=False)
+        finally:
+            trainer.stop(checkpoint=False)
+        assert final_v >= 3, final_v
+        wait_for(lambda: (lambda ws: any(s["ready"] for s in ws) and all(
+            s["version"] == final_v for s in ws if s["ready"]))(
+                router.stats()["workers"]),
+            90, f"fleet rollout to v{final_v}")
+        assert all(s["compiles_since_ready"] == 0
+                   for s in router.stats()["workers"] if s["ready"]), \
+            router.stats()["workers"]
+
+        # --- SIGKILL → crash respawn through the shared backoff policy
+        crash = router._m_respawns.labels(reason="crash")
+        crash_before = crash.value
+        os.kill(router.workers[0].proc.pid, signal.SIGKILL)
+        wait_for(lambda: crash.value > crash_before, 90, "crash respawn")
+        wait_for(lambda: router.stats()["workers"][0]["ready"], 90,
+                 "killed worker back in rotation")
+        status, _body, _ = router.route_predict({"features": probe.tolist()})
+        assert status == 200, status
+
+        # --- /api/resilience: the shared policies report live state
+        res = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/api/resilience",
+            timeout=10).read())
+        sites = res["sites"]
+        for name in ("fleet.router.respawn", "fleet.router.failover",
+                     "fleet.router.health", "fleet.router.boot"):
+            assert name in sites, sorted(sites)
+        assert sites["fleet.router.health"]["expired_total"] >= 1, sites
+        assert sites["fleet.router.respawn"]["retries_total"] >= 1, sites
+
+        assert router.drain(timeout_s=30)
+        print("failsafe self-scan OK: corrupt v2 quarantined at cold boot "
+              "(served v1, 0 compiles), hung worker respawned "
+              f"(hung={hung.value:.0f}), NaN rollback replayed the poisoned "
+              f"span ({st['last_replay']['outcome']}), fleet converged on "
+              f"v{final_v} with 0 recompiles, SIGKILL respawned, "
+              "/api/resilience live")
     finally:
         router.stop()
 PY

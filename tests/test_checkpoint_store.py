@@ -250,4 +250,141 @@ def test_rng_entry_present_in_container(tmp_path):
     with zipfile.ZipFile(info.path) as zf:
         names = set(zf.namelist())
     assert {"configuration.json", "coefficients.npz", "updaterState.npz",
-            "state.npz", "meta.json", "rng.npz"} <= names
+            "state.npz", "meta.json", "rng.npz", "manifest.json"} <= names
+
+
+class TestIntegrityQuarantine:
+    """ISSUE 14: sha256 manifest verification, quarantine, and fallback to
+    the previous good version on every corruption shape a killed/ill
+    writer can leave behind."""
+
+    def _seed(self, tmp_path, n=2):
+        net = MultiLayerNetwork(_conf()).init()
+        store = CheckpointStore(str(tmp_path), registry=MetricsRegistry())
+        infos = [store.save(net) for _ in range(n)]
+        return net, store, infos
+
+    def test_verify_ok_and_legacy(self, tmp_path):
+        net, store, (i1, i2) = self._seed(tmp_path)
+        assert store.verify(1) == "ok"
+        # a manifest-less container (pre-manifest era) is accepted as-is
+        with zipfile.ZipFile(i2.path) as zf:
+            entries = {n: zf.read(n) for n in zf.namelist()
+                       if n != "manifest.json"}
+        with zipfile.ZipFile(i2.path, "w") as zf:
+            for name, data in entries.items():
+                zf.writestr(name, data)
+        assert store.verify(2) == "legacy"
+
+    def test_truncated_zip_quarantined_with_fallback(self, tmp_path):
+        from deeplearning4j_tpu.testing.chaos import truncate_file
+
+        net, store, (i1, i2) = self._seed(tmp_path)
+        truncate_file(i2.path, keep_frac=0.4)
+        model, info = store.restore_with_info()
+        assert info.version == 1
+        assert os.path.exists(i2.path + ".quarantine")
+        assert [v.version for v in store.versions()] == [1]
+        assert store._m_corrupt.value >= 1
+
+    def test_bad_rng_entry_digest_mismatch(self, tmp_path):
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            CheckpointCorruptError,
+        )
+
+        net, store, (i1, i2) = self._seed(tmp_path)
+        # rewrite rng.npz in place; the manifest still carries the old
+        # digest, so the zip stays structurally valid but fails verify
+        with zipfile.ZipFile(i2.path) as zf:
+            entries = {n: zf.read(n) for n in zf.namelist()}
+        entries["rng.npz"] = b"\x00" * 32
+        with zipfile.ZipFile(i2.path, "w") as zf:
+            for name, data in entries.items():
+                zf.writestr(name, data)
+        with pytest.raises(CheckpointCorruptError, match="rng.npz"):
+            store.verify(2)
+        model, info = store.restore_with_info()
+        assert info.version == 1
+        assert os.path.exists(i2.path + ".quarantine")
+
+    def test_manifest_zip_mismatch_quarantined(self, tmp_path):
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            CheckpointCorruptError,
+        )
+
+        net, store, (i1, i2) = self._seed(tmp_path)
+        with zipfile.ZipFile(i2.path, "a") as zf:
+            zf.writestr("smuggled.bin", b"x")
+        with pytest.raises(CheckpointCorruptError, match="mismatch"):
+            store.verify(2)
+        model, info = store.restore_with_info()
+        assert info.version == 1
+        assert os.path.exists(i2.path + ".quarantine")
+
+    def test_pinned_corrupt_version_raises_after_quarantine(self, tmp_path):
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            CheckpointCorruptError,
+        )
+        from deeplearning4j_tpu.testing.chaos import truncate_file
+
+        net, store, (i1, i2) = self._seed(tmp_path)
+        truncate_file(i2.path, keep_frac=0.3)
+        # an explicitly pinned version must NOT silently fall back
+        with pytest.raises(CheckpointCorruptError):
+            store.restore(2)
+        assert os.path.exists(i2.path + ".quarantine")
+        # ...while the unpinned path still serves the survivor
+        assert store.restore_with_info()[1].version == 1
+
+    def test_store_with_no_intact_versions(self, tmp_path):
+        from deeplearning4j_tpu.testing.chaos import truncate_file
+
+        net, store, (i1,) = self._seed(tmp_path, n=1)
+        truncate_file(i1.path, keep_frac=0.3)
+        with pytest.raises(FileNotFoundError, match="no intact versions"):
+            store.restore()
+
+    def test_ids_monotonic_past_quarantine(self, tmp_path):
+        from deeplearning4j_tpu.testing.chaos import truncate_file
+
+        net, store, (i1, i2) = self._seed(tmp_path)
+        truncate_file(i2.path, keep_frac=0.4)
+        store.restore()  # quarantines v2, serves v1
+        assert store.save(net).version == 3
+        # a FRESH store over the directory still counts the quarantined id
+        fresh = CheckpointStore(str(tmp_path), registry=MetricsRegistry())
+        assert fresh.save(net).version == 4
+
+    def test_stale_tmp_from_dead_writer_swept(self, tmp_path):
+        dead_pid = 2**22 + 1  # linux pid_max caps at 2**22: can't be alive
+        torn = tmp_path / f".tmp-v00000002-{dead_pid}"
+        torn.write_bytes(b"torn write, never completed")
+        live = tmp_path / f".tmp-v00000003-{os.getpid()}"
+        live.write_bytes(b"in-flight async writer")
+        store = CheckpointStore(str(tmp_path), registry=MetricsRegistry())
+        names = set(os.listdir(tmp_path))
+        assert torn.name not in names
+        assert torn.name + ".quarantine" in names
+        # a tmp owned by a LIVE pid is someone's in-flight write: untouched
+        assert live.name in names
+        assert store._m_corrupt.value == 1
+        net = MultiLayerNetwork(_conf()).init()
+        assert store.save(net).version == 1
+
+    def test_load_into_falls_back_past_corrupt_latest(self, tmp_path):
+        from deeplearning4j_tpu.testing.chaos import corrupt_file
+
+        rng = np.random.default_rng(11)
+        xs, ys = _windows(rng, 2)
+        net = MultiLayerNetwork(_conf()).init()
+        store = CheckpointStore(str(tmp_path), registry=MetricsRegistry())
+        net.fit_on_device(xs[0], ys[0])
+        store.save(net)
+        good_params = jax.tree_util.tree_map(np.asarray, net.params)
+        net.fit_on_device(xs[1], ys[1])
+        info2 = store.save(net)
+        corrupt_file(info2.path, seed=3)
+        loaded = store.load_into(net, fallback=True)
+        assert loaded == 1
+        _leaves_equal(net.params, good_params)
+        assert os.path.exists(info2.path + ".quarantine")

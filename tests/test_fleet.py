@@ -393,6 +393,106 @@ def _seed_store(tmp_path, versions=1):
     return store, net
 
 
+# ---------------------------------------------------------------------------
+# resilience integration (fast, ISSUE 14): hung-worker detection, staggered
+# respawn backoff, corrupt-latest worker boot
+# ---------------------------------------------------------------------------
+
+
+class TestHungWorkerDetection:
+    def test_frozen_healthz_is_hung_not_crash(self, tmp_path):
+        """A worker that accepts TCP but never answers /healthz is a live
+        wedged process: the health Deadline must expire, classify it as
+        "hung" (not "crash"/"unhealthy") and reap it so the respawn can
+        rebind the port."""
+        import socket
+
+        from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(8)  # handshake completes in-kernel; nothing ever reads
+        port = sock.getsockname()[1]
+        dummy = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"])
+        router = FleetRouter(str(tmp_path), workers=1, respawn=False,
+                             health_timeout_s=0.5,
+                             registry=MetricsRegistry())
+        handle = router.workers[0]
+        handle.proc = dummy
+        handle.port = port
+        handle.alive = True
+        handle.ready = True
+        try:
+            router._check_worker(handle)
+            assert handle.down_reason == "hung", handle.down_reason
+            assert not handle.ready
+            assert dummy.wait(timeout=10) is not None  # reaped, port freed
+            assert router.health_deadline.stats()["expired_total"] >= 1
+        finally:
+            if dummy.poll() is None:
+                dummy.kill()
+                dummy.wait(timeout=10)
+            sock.close()
+
+
+class TestRespawnBackoffStagger:
+    def test_simultaneous_deaths_backoff_staggered(self, tmp_path):
+        """Regression for the thundering-herd respawn: simultaneous worker
+        deaths must schedule DIFFERENT backoffs (jitter keyed per worker
+        id), and the stagger must be deterministic run to run."""
+        from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+        router = FleetRouter(str(tmp_path), workers=3, respawn=False,
+                             backoff_base_s=0.5, backoff_cap_s=10.0,
+                             registry=MetricsRegistry())
+        for handle in router.workers:
+            router._backoff(handle)
+        waits = [h.backoff_s for h in router.workers]
+        assert len(set(waits)) == len(waits), waits
+        # attempt 1 with jitter=0.5: base <= wait <= 1.5*base
+        assert all(0.5 <= w <= 0.75 for w in waits), waits
+        router2 = FleetRouter(str(tmp_path), workers=3, respawn=False,
+                              backoff_base_s=0.5, backoff_cap_s=10.0,
+                              registry=MetricsRegistry())
+        for handle in router2.workers:
+            router2._backoff(handle)
+        assert [h.backoff_s for h in router2.workers] == waits
+
+
+class TestWorkerBootIntegrity:
+    def test_boot_quarantines_corrupt_latest_serves_previous(self, tmp_path):
+        """In-process half of the corrupt-latest acceptance: a cold worker
+        boot over a store whose newest version is torn must quarantine it,
+        serve the previous good version, and swap forward as soon as a
+        good NEWER version lands."""
+        from deeplearning4j_tpu.fleet.worker import FleetWorker
+        from deeplearning4j_tpu.testing.chaos import truncate_file
+
+        store, net = _seed_store(tmp_path, versions=2)
+        truncate_file(store.path(2), keep_frac=0.4)
+        worker = FleetWorker(str(tmp_path / "store"), max_delay_ms=0,
+                             max_batch=8, use_bundle=False)
+        try:
+            worker.boot()
+            assert worker.ready and worker.version == 1
+            assert os.path.exists(store.path(2) + ".quarantine")
+            out = worker.predict_payload(
+                {"features": np.zeros((2, 8), np.float32).tolist()})
+            assert len(out["output"]) == 2
+            # the quarantined id stays claimed; the next good save is v3
+            # and the worker swaps to it with no restart
+            v3 = store.save(net).version
+            assert v3 == 3
+            assert worker.swap_to() == 3
+            assert worker.version == 3
+        finally:
+            worker.shutdown()
+            if worker.service is not None:
+                worker.service.stop()
+            set_service(None, f"fleet-worker:{worker.model}")
+
+
 @pytest.mark.slow
 class TestFleetSubprocess:
     def test_warm_boot_zero_compiles(self, tmp_path):
