@@ -1184,7 +1184,14 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
     DT207-style collective census of the compiled per-step program
     (all-gather/reduce-scatter pairs are GSPMD's fsdp signature). Select
     with BENCH_MODEL=shard; needs a multi-device backend (the CPU fallback
-    forces a 4-device virtual mesh)."""
+    forces a 4-device virtual mesh).
+
+    ISSUE 15 grows two tensor-parallel variants on an attention net:
+    ``tp_generic`` (shape-heuristic specs — pays the DT305 per-step
+    activation collectives) vs ``tp_headaware`` (``roles=True`` — QKV
+    column-parallel, out row-parallel, ONE all-reduce per block). The
+    head-aware samples/sec rides the metric line as an ``aux_metrics``
+    entry so BENCH_BASELINE.json anchors it independently."""
     import jax
 
     from deeplearning4j_tpu import (
@@ -1195,6 +1202,8 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
         OutputLayer,
         UpdaterConfig,
     )
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
     from deeplearning4j_tpu.parallel import MeshLayout, ParallelWrapper
     from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
 
@@ -1217,13 +1226,31 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
             seed=seed,
         )).init()
 
+    a_batch, a_t, a_feat, a_d, a_heads, a_classes = 32, 32, 64, 128, 4, 16
+
+    def make_attn_net(seed=42):
+        return MultiLayerNetwork(MultiLayerConfiguration(
+            layers=[
+                SelfAttentionLayer(n_out=a_d, n_heads=a_heads,
+                                   activation="identity"),
+                RnnOutputLayer(n_in=a_d, n_out=a_classes,
+                               activation="softmax", loss="mcxent"),
+            ],
+            input_type=InputType.recurrent(a_feat),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+            seed=seed,
+        )).init()
+
     rng = np.random.default_rng(0)
     xs = rng.normal(size=(groups, batch, feature_dim)).astype(np.float32)
     ys = np.eye(classes, dtype=np.float32)[
         rng.integers(0, classes, (groups, batch))]
+    axs = rng.normal(size=(groups, a_batch, a_t, a_feat)).astype(np.float32)
+    ays = np.eye(a_classes, dtype=np.float32)[
+        rng.integers(0, a_classes, (groups, a_batch, a_t))]
     cm = get_compile_manager()
 
-    def census(net, layout):
+    def census(net, layout, x, y, t=None):
         """Measured vs predicted collective census (ISSUE 9). Measured:
         collective ops parsed out of the compiled per-step program's
         post-SPMD HLO (kind, mesh axes from replica groups, per-device
@@ -1236,13 +1263,14 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
             check_network_shard_flow, compare_census, hlo_collective_census)
 
         try:
-            x_d = layout.put(xs[0], layout.batch_sharding())
-            y_d = layout.put(ys[0], layout.batch_sharding())
+            x_d = layout.put(x, layout.input_sharding(x))
+            y_d = layout.put(y, layout.input_sharding(y))
             step = net._build_train_step()
             hlo = step.lower(net.params, net.opt_state, net.state, x_d, y_d,
                              net._rng, None, None).compile().as_text()
             measured = hlo_collective_census(hlo, layout)
-            flow = check_network_shard_flow(net, batch, layout)
+            flow = check_network_shard_flow(net, x.shape[0], layout,
+                                            timesteps_probe=t)
             predicted = flow["census"]
             return {
                 "measured": measured,
@@ -1254,14 +1282,15 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
         except Exception as e:  # noqa: BLE001 - the metric line must survive
             return {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    def run_variant(label, layout):
-        net = make_net()
+    def run_variant(label, layout, factory=make_net, data=None, t=None):
+        vx, vy = data if data is not None else (xs, ys)
+        net = factory()
         wrapper = ParallelWrapper(net, layout=layout)
-        wrapper.fit_on_device(xs, ys, steps=steps)  # warmup: pays compiles
+        wrapper.fit_on_device(vx, vy, steps=steps)  # warmup: pays compiles
         before_mem = set(cm.memory_records())
         compiles_before = cm.compiles.value
         t0 = time.perf_counter()
-        losses = wrapper.fit_on_device(xs, ys, steps=steps)
+        losses = wrapper.fit_on_device(vx, vy, steps=steps)
         dt = time.perf_counter() - t0  # losses host fetch = the sync point
         assert np.all(np.isfinite(losses)), f"non-finite {label} losses"
         # the staged executable's XLA memory record (post-SPMD = per-device)
@@ -1277,14 +1306,15 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
                         and rec.get("available"):
                     hbm = int(rec["total_bytes"])
         return {
-            "samples_per_sec": round(steps * batch / dt, 1),
+            "samples_per_sec": round(steps * vx.shape[1] / dt, 1),
             "per_device_hbm_bytes": hbm,
             "warm_compiles": cm.compiles.value - compiles_before,
             "seconds": round(dt, 4),
             "layout": layout.describe(),
-            "collectives": census(net, layout),
+            "collectives": census(net, layout, vx[0], vy[0], t=t),
         }
 
+    dp_ways = max(ways // 2, 1)
     variants = {
         "replicated_f32": run_variant(
             "replicated_f32", MeshLayout(data=ways, fsdp=1)),
@@ -1292,9 +1322,20 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
         "fsdp_bf16": run_variant(
             "fsdp_bf16", MeshLayout(data=1, fsdp=ways,
                                     params_dtype="bfloat16")),
+        # ISSUE 15: same attention net, same dp×tp mesh — the only delta is
+        # the layer-roles registry. Generic tp pays the DT305 activation
+        # collectives; head-aware tp pays ONE all-reduce per block.
+        "tp_generic": run_variant(
+            "tp_generic", MeshLayout(data=dp_ways, tp=2),
+            factory=make_attn_net, data=(axs, ays), t=a_t),
+        "tp_headaware": run_variant(
+            "tp_headaware", MeshLayout(data=dp_ways, tp=2, roles=True),
+            factory=make_attn_net, data=(axs, ays), t=a_t),
     }
     rep_hbm = variants["replicated_f32"]["per_device_hbm_bytes"]
     fb_hbm = variants["fsdp_bf16"]["per_device_hbm_bytes"]
+    tp_gen = variants["tp_generic"]["samples_per_sec"]
+    tp_head = variants["tp_headaware"]["samples_per_sec"]
     result = {
         "metric": "shard_fsdp_train_samples_per_sec",
         "value": variants["fsdp_bf16"]["samples_per_sec"],
@@ -1302,8 +1343,16 @@ def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
         "variants": variants,
         "hbm_fsdp_bf16_vs_replicated": (
             round(fb_hbm / rep_hbm, 4) if rep_hbm and fb_hbm else None),
+        "tp_headaware_vs_generic": (
+            round(tp_head / tp_gen, 4) if tp_gen else None),
+        # gated independently against its BENCH_BASELINE.json anchor
+        "aux_metrics": {
+            "shard_tp_headaware_train_samples_per_sec": tp_head,
+        },
         "shape": {"batch": batch, "hidden": hidden, "steps": steps,
-                  "groups": groups, "ways": ways, "devices": n_dev},
+                  "groups": groups, "ways": ways, "devices": n_dev,
+                  "attn": {"batch": a_batch, "t": a_t, "d": a_d,
+                           "heads": a_heads}},
     }
     result["telemetry"] = _telemetry_block(
         [variants["fsdp_bf16"]["seconds"] / steps],

@@ -160,6 +160,10 @@ class _Flow:
         self.sizes = {str(k): int(v) for k, v in axis_sizes.items()}
         self.batch_axes = frozenset(str(a) for a in batch_axes)
         self.events: List[dict] = []
+        # inside a shard_map body: every mesh axis is manual, GSPMD inserts
+        # nothing — only explicit collectives count, and check_rep's
+        # pbroadcast bookkeeping compiles to nothing
+        self._manual = False
         # shape -> {shard factor: #vars} over every eqn output (activation
         # projection for preflight's per-device estimate)
         self.shape_factors: Dict[Tuple[int, ...], Dict[int, int]] = {}
@@ -179,6 +183,18 @@ class _Flow:
             return
         axes = tuple(sorted(set(axes)))
         if not axes:
+            return
+        if kind == "all_reduce" and len(axes) > 1:
+            # XLA lowers a multi-axis all-reduce as one stage PER mesh axis
+            # (measured HLO shows e.g. data-groups then seq-groups, full
+            # payload each) — mirror that so the censuses line up
+            for a in axes:
+                self.events.append({
+                    "kind": kind, "axes": (a,), "bytes": int(payload),
+                    "count": int(max(1, mult)), "cause": cause,
+                    "prim": prim, "scope": scope, "trip": int(trip),
+                    "param": bool(param),
+                })
             return
         self.events.append({
             "kind": kind, "axes": axes, "bytes": int(payload),
@@ -312,6 +328,8 @@ class _Flow:
             return self._while(eqn, read, **kw)
         if name == "cond":
             return self._cond(eqn, read, **kw)
+        if name == "shard_map":
+            return self._shard_map(eqn, read, **kw)
         sub = self._wrapped_jaxpr(eqn)
         if sub is not None and len(sub.jaxpr.invars) == len(eqn.invars):
             outs = self.walk(sub, [read(v) for v in eqn.invars], **kw)
@@ -457,6 +475,18 @@ class _Flow:
                 continue
             if roles == {"batch"}:
                 continue  # batch-dim sharding flows to the result
+            if roles == {"contract"} and len({c[0] for c in cl}) == 1:
+                # one-sided sharded contraction where the OTHER operand (and
+                # hence the result) never touches the axis: GSPMD slices the
+                # unsharded side locally (free) and keeps the result an
+                # unreduced partial sum — the row-parallel Megatron pattern
+                # (attention_out / lstm_gates W / ffn_down role specs). No
+                # gather is emitted; ONE all-reduce fires at the first
+                # non-linear consumer. ZeRO layouts never take this route:
+                # fsdp also shards the activation batch dim, so the fsdp
+                # axis carries mixed roles and falls through to the gather.
+                partial.add(a)
+                continue
             if contract_cl:
                 # one-sided contraction shard (or contraction fighting a
                 # kept dim for the axis): gather the contraction side —
@@ -471,8 +501,12 @@ class _Flow:
                         trip=trip, record=record)
                 continue
             if len(cl) > 1:
-                # the axis claims kept dims on both sides: keep the bigger
-                keep = max(cl, key=lambda c: c[3].charge)
+                # the axis claims kept dims on both sides: keep the bigger;
+                # on a tie keep the RHS claim — in autodiff's dW dots the
+                # cotangent is the lhs and GSPMD gathers it ONCE (every
+                # consumer reuses the gather and dW comes out in the
+                # param's orientation, so the optimizer adds stay local)
+                keep = max(cl, key=lambda c: (c[3].charge, c[0]))
                 for side, d, _, st in cl:
                     if (side, d) == (keep[0], keep[1]):
                         continue
@@ -726,6 +760,11 @@ class _Flow:
 
     def _explicit_collective(self, eqn, read, *, mult, scope, trip,
                              record) -> List[_St]:
+        if eqn.primitive.name == "pbroadcast" and self._manual:
+            # shard_map check_rep replication bookkeeping — compiles to
+            # nothing, never a wire transfer
+            return [self._default_out(eqn, read, i)
+                    for i in range(len(eqn.outvars))]
         for v in eqn.invars:
             self._materialize(read(v), mult=mult, scope=scope, trip=trip,
                               record=record)
@@ -739,6 +778,65 @@ class _Flow:
                    trip=trip, record=record)
         return [self._default_out(eqn, read, i)
                 for i in range(len(eqn.outvars))]
+
+    def _shard_map(self, eqn, read, *, mult, scope, trip,
+                   record) -> List[_St]:
+        """Manual region (the ring / all-to-all attention kernels ride
+        shard_map). Every mesh axis is manual inside, so GSPMD inserts NO
+        collectives in the body — the walk models only the explicit ones
+        (ppermute, psum, ...), whose payloads are the body's per-shard aval
+        bytes: the same per-device convention the measured census counts.
+        At the boundary, an outer sharding axis that ``in_names`` does not
+        carry on that dim forces an all-gather (manual axes absent from the
+        spec require replicated inputs); outputs take their specs straight
+        from ``out_names``."""
+        from jax import core  # noqa: PLC0415
+
+        body = eqn.params.get("jaxpr")
+        if isinstance(body, core.Jaxpr):
+            body = core.ClosedJaxpr(body, ())
+        in_names = eqn.params.get("in_names")
+        out_names = eqn.params.get("out_names")
+        kw = dict(mult=mult, scope=scope, trip=trip, record=record)
+        if (not isinstance(body, core.ClosedJaxpr) or in_names is None
+                or out_names is None
+                or len(body.jaxpr.invars) != len(eqn.invars)
+                or len(body.jaxpr.outvars) != len(eqn.outvars)):
+            return [self._meet(eqn, read, i, **kw)
+                    for i in range(len(eqn.outvars))]
+
+        def names_spec(names, ndim):
+            return tuple(tuple(str(a) for a in names.get(d, ()))
+                         for d in range(ndim))
+
+        inner_in = []
+        for v, iv, names in zip(eqn.invars, body.jaxpr.invars, in_names):
+            st = read(v)
+            self._materialize(st, **kw)
+            want = names_spec(dict(names), len(st.spec))
+            need = {d: set(st.spec[d]) - set(want[d])
+                    for d in range(len(st.spec))
+                    if set(st.spec[d]) - set(want[d])}
+            if need:
+                self._gather(st, need,
+                             cause=("param_gather" if st.param
+                                    else "mismatch"),
+                             prim="shard_map", **kw)
+            ishape = tuple(getattr(iv.aval, "shape", ()) or ())
+            inner_in.append(_St(tuple(() for _ in ishape),
+                                _aval_bytes(iv.aval)))
+        prev_manual = self._manual
+        self._manual = True
+        try:
+            self.walk(body, inner_in, **kw)
+        finally:
+            self._manual = prev_manual
+        outs = []
+        for ov, names in zip(eqn.outvars, out_names):
+            oshape = tuple(getattr(ov.aval, "shape", ()) or ())
+            spec = names_spec(dict(names), len(oshape))
+            outs.append(_St(spec, _aval_bytes(ov.aval)))
+        return outs
 
     # ------------------------------------------------------- control flow
     def _carry_fixpoint(self, probe, carry: List[_St]) -> List[_St]:
@@ -1131,6 +1229,8 @@ def check_network_shard_flow(net, batch_or_struct=None, layout=None, *,
     t_probe = (DEFAULT_TIMESTEPS_PROBE if timesteps_probe is None
                else int(timesteps_probe))
     net.init()
+    if getattr(layout, "roles", False) and hasattr(layout, "bind"):
+        layout.bind(net)  # resolve role sites so param_specs are head-aware
     inputs = _input_structs(net, batch_or_struct, timesteps_probe=t_probe)
     conf_dtype = getattr(net.conf, "dtype", "float32")
     params = _shell_tree(net.params, conf_dtype)
@@ -1140,44 +1240,77 @@ def check_network_shard_flow(net, batch_or_struct=None, layout=None, *,
 
     param_specs = layout.param_specs(params)
     batch = layout.batch_spec()
+    _in_fn = getattr(layout, "input_spec", None)
 
-    if train:
-        opt_state = _shell_tree(net.opt_state, conf_dtype)
-        state = _shell_tree(net.state, conf_dtype)
-        rng = jax.ShapeDtypeStruct(tuple(net._rng.shape), net._rng.dtype)
-        labels = _label_structs(net, int(inputs[0].shape[0]), t_probe)
-        step = net._build_train_step()
-        inner = getattr(step, "__wrapped__", step)
-        args = (params, opt_state, state, x_arg, labels, rng, None, None)
-        opt_specs = (layout.opt_specs(opt_state)
-                     if hasattr(layout, "opt_specs")
-                     else layout.param_specs(opt_state))
-        in_spec_tree = (param_specs, opt_specs,
-                        jax.tree_util.tree_map(lambda _: P(), state),
-                        jax.tree_util.tree_map(lambda _: batch, x_arg),
-                        jax.tree_util.tree_map(lambda _: batch, labels),
-                        P(), None, None)
-        n_param = len(jax.tree_util.tree_leaves(params))
-        n_opt = len(jax.tree_util.tree_leaves(opt_state))
-        flags = [True] * (n_param + n_opt)
-        declared = _flatten_specs(param_specs) + _flatten_specs(opt_specs)
-    else:
-        state = _shell_tree(net.state, conf_dtype)
-        if is_graph:
-            def inner(p, xs):
-                acts, _, _ = net._activations(p, xs, state, False, None, None)
-                return acts
+    def _in_spec(leaf):
+        # seq-axis layouts shard [B,T,..] request tensors on time too
+        if _in_fn is not None:
+            return _in_fn(getattr(leaf, "ndim", None))
+        return batch
+
+    # seq-axis layouts execute attention through the shard_map ring
+    # kernels (layout.apply installs the mesh) — trace the SAME program
+    # here, else the census models a local kernel the net will never run
+    _restore = None
+    _seq_axis = getattr(layout, "_seq_axis", None)
+    if _seq_axis is not None:
+        from ..nn.layers.attention import (  # noqa: PLC0415
+            get_attention_mesh, set_attention_mesh)
+        _prev = get_attention_mesh()
+        set_attention_mesh(layout.mesh, _seq_axis, nets=(net,),
+                           batch_axes=getattr(layout, "_batch_axes", ()))
+
+        def _restore():
+            if _prev is None:
+                set_attention_mesh(None, nets=(net,))
+            else:
+                set_attention_mesh(
+                    _prev[0], _prev[1], nets=(net,),
+                    batch_axes=_prev[2] if len(_prev) > 2 else ())
+
+    try:
+        if train:
+            opt_state = _shell_tree(net.opt_state, conf_dtype)
+            state = _shell_tree(net.state, conf_dtype)
+            rng = jax.ShapeDtypeStruct(tuple(net._rng.shape), net._rng.dtype)
+            labels = _label_structs(net, int(inputs[0].shape[0]), t_probe)
+            step = net._build_train_step()
+            inner = getattr(step, "__wrapped__", step)
+            args = (params, opt_state, state, x_arg, labels, rng, None, None)
+            opt_specs = (layout.opt_specs(opt_state)
+                         if hasattr(layout, "opt_specs")
+                         else layout.param_specs(opt_state))
+            in_spec_tree = (param_specs, opt_specs,
+                            jax.tree_util.tree_map(lambda _: P(), state),
+                            jax.tree_util.tree_map(_in_spec, x_arg),
+                            jax.tree_util.tree_map(_in_spec, labels),
+                            P(), None, None)
+            n_param = len(jax.tree_util.tree_leaves(params))
+            n_opt = len(jax.tree_util.tree_leaves(opt_state))
+            flags = [True] * (n_param + n_opt)
+            declared = (_flatten_specs(param_specs)
+                        + _flatten_specs(opt_specs))
         else:
-            def inner(p, x):
-                out, _, _ = net._forward(p, x, state, False, None)
-                return out
-        args = (params, x_arg)
-        in_spec_tree = (param_specs,
-                        jax.tree_util.tree_map(lambda _: batch, x_arg))
-        flags = [True] * len(jax.tree_util.tree_leaves(params))
-        declared = None
+            state = _shell_tree(net.state, conf_dtype)
+            if is_graph:
+                def inner(p, xs):
+                    acts, _, _ = net._activations(p, xs, state, False, None,
+                                                  None)
+                    return acts
+            else:
+                def inner(p, x):
+                    out, _, _ = net._forward(p, x, state, False, None)
+                    return out
+            args = (params, x_arg)
+            in_spec_tree = (param_specs,
+                            jax.tree_util.tree_map(_in_spec, x_arg))
+            flags = [True] * len(jax.tree_util.tree_leaves(params))
+            declared = None
 
-    closed = jax.make_jaxpr(inner)(*args)
+        closed = jax.make_jaxpr(inner)(*args)
+    finally:
+        if _restore is not None:
+            _restore()
     flat_specs = _flatten_specs(in_spec_tree)
     flow = propagate_jaxpr(closed, flat_specs, layout,
                            declared_out_specs=declared, param_flags=flags)
@@ -1187,7 +1320,10 @@ def check_network_shard_flow(net, batch_or_struct=None, layout=None, *,
 
     # DT305: generic tp specs on attention/LSTM-gate sites — the per-step
     # tp collectives on their activations would vanish under head-aware
-    # specs (shard heads/gates, not the flat last dim). Advisory.
+    # specs (shard heads/gates, not the flat last dim). Advisory. A site
+    # that RESOLVED through a head-aware role rule (attention_qkv/
+    # attention_out/lstm_gates via MeshLayout(roles=True)) is exempt: its
+    # remaining tp traffic is the intended ONE-all-reduce Megatron pattern.
     tp_axis = getattr(layout, "_tp_axis", None)
     if tp_axis is not None:
         conf = net.conf
@@ -1196,8 +1332,12 @@ def check_network_shard_flow(net, batch_or_struct=None, layout=None, *,
                            for v in conf.vertices.values()]
         else:
             layer_types = [type(l).__name__ for l in conf.layers]
+        resolved = (layout.role_resolved_types()
+                    if getattr(layout, "roles", False)
+                    and hasattr(layout, "role_resolved_types") else set())
         sites = sorted({t for t in layer_types
-                        if any(k in t for k in _HEAD_AWARE_LAYERS)})
+                        if any(k in t for k in _HEAD_AWARE_LAYERS)
+                        and t not in resolved})
         tp_events = [e for e in flow.events
                      if tp_axis in e["axes"] and not e["param"]]
         if sites and tp_events:
@@ -1206,9 +1346,12 @@ def check_network_shard_flow(net, batch_or_struct=None, layout=None, *,
                 f"{len(tp_events)} per-step tp collective(s) "
                 f"(~{_fmt_bytes(total)}) land on activations of "
                 f"{', '.join(sites)}: the generic last-dim tp spec splits "
-                "heads/gates across devices — a head-aware tp spec (shard "
-                "the head/gate dim, keep each head local) would eliminate "
-                "these all-reduces/gathers", file=source, context="tp"))
+                "heads/gates across devices — resolve these sites through "
+                "the layer-roles registry: MeshLayout(..., roles=True) "
+                "reads the layers' PARAM_ROLES declarations, and "
+                "parallel.roles.register_layer_role(layer_cls, param, "
+                "role) opts custom layers in (docs/distributed.md, 'Layer "
+                "roles & head-aware tp')", file=source, context="tp"))
     report["findings"] = merge_findings(findings)
     return report
 
@@ -1222,6 +1365,7 @@ _SHAPE_RE = re.compile(r"([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
 _GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[0-9,{} ]*\}\}|\[[0-9,]+\]<=\[[0-9,]+\]"
     r"(?:T\([0-9,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]+\},?)*)\}")
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -1281,10 +1425,14 @@ def hlo_collective_census(hlo_text: str, layout=None) -> List[dict]:
     per-device ``max(operands, results)`` payload (the convention the
     predicted census uses), axes the mesh axes whose replica groups match
     (``["?"]`` when no axis subset of the given layout's mesh matches).
+    All-gathers of the same source operands over the same groups/dims are
+    one LOGICAL collective counted once — XLA may materialize extra copies
+    purely for consumer layouts; ``layout_dups`` on the row records them.
     """
     mesh = getattr(layout, "mesh", None) if layout is not None else None
     axis_groups = _axis_groups(mesh) if mesh is not None else []
     rows: Dict[Tuple[str, Tuple[str, ...]], dict] = {}
+    seen_gathers: Dict[tuple, dict] = {}
     for line in hlo_text.splitlines():
         m = _HLO_OP_RE.search(line)
         if not m:
@@ -1296,7 +1444,8 @@ def hlo_collective_census(hlo_text: str, layout=None) -> List[dict]:
         # operand list ends at the first attribute (channel_id=, dimensions=,
         # replica_groups=, to_apply=, metadata=)
         op_text = re.split(r"\b(?:channel_id|dimensions|replica_groups|"
-                           r"to_apply|metadata)=", operands)[0]
+                           r"source_target_pairs|to_apply|metadata)=",
+                           operands)[0]
         operand_bytes = sum(_shape_bytes(d, s)
                             for d, s in _SHAPE_RE.findall(op_text))
         payload = max(result_bytes, operand_bytes)
@@ -1311,8 +1460,38 @@ def hlo_collective_census(hlo_text: str, layout=None) -> List[dict]:
                     if groups == expected:
                         axes = sub
                         break
+        elif kind == "collective_permute":
+            # permutes carry source_target_pairs, not replica_groups:
+            # attribute to the smallest axis subset whose groups contain
+            # every pair (a seq-ring's hops stay within each seq group)
+            pm = _PAIRS_RE.search(line)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in re.findall(r"\{([0-9, ]+)\}",
+                                             pm.group(1))]
+                if pairs and all(s != t for s, t in pairs):
+                    for sub, expected in axis_groups:
+                        if all(any({s, t} <= g for g in expected)
+                               for s, t in pairs):
+                            axes = sub
+                            break
         row = rows.setdefault((kind, axes), {
             "kind": kind, "axes": list(axes), "count": 0, "bytes": 0})
+        if kind == "all_gather":
+            # XLA materializes the SAME logical gather once per consumer
+            # physical layout (CSE stops at layout boundaries — e.g. the
+            # saved attention context re-gathered for each backward dot's
+            # preferred operand order). One logical collective, several
+            # wire copies the static pass cannot see: count it once and
+            # record the duplication on the row.
+            ops = tuple(re.findall(r"%[\w.\-]+", op_text))
+            dm = re.search(r"dimensions=\{([0-9,]*)\}", line)
+            key = (axes, ops, dm.group(1) if dm else None)
+            if ops and key in seen_gathers:
+                dup = seen_gathers[key]
+                dup["layout_dups"] = dup.get("layout_dups", 0) + 1
+                continue
+            seen_gathers[key] = row
         row["count"] += 1
         row["bytes"] += payload
     return sorted(rows.values(), key=lambda r: (-r["bytes"], r["kind"]))

@@ -77,6 +77,14 @@ class SelfAttentionLayer(BaseLayer):
     # The explicit values are the per-site escape hatch.
     attention_impl: str = "auto"
 
+    # parallel.roles registry (MeshLayout(roles=True)): QKV column-parallel
+    # (each tp device computes whole heads), out-projection row-parallel —
+    # the Megatron pattern; the block pays ONE all-reduce instead of
+    # per-site activation gathers (DT305).
+    PARAM_ROLES = {"Wq": "attention_qkv", "Wk": "attention_qkv",
+                   "Wv": "attention_qkv", "Wo": "attention_out",
+                   "bo": "attention_out"}
+
     @property
     def is_recurrent(self) -> bool:
         return True
@@ -131,11 +139,11 @@ class SelfAttentionLayer(BaseLayer):
             else:
                 out = attention(q, k, v, causal=self.causal, key_mask=key_mask)
         else:
-            mesh, axis = mesh_ctx
+            mesh, axis, batch_axes = mesh_ctx
             fn = (ring_attention if self.sequence_parallel == "ring"
                   else all_to_all_attention)
             out = fn(q, k, v, mesh, seq_axis=axis, causal=self.causal,
-                     key_mask=key_mask)
+                     key_mask=key_mask, batch_axes=batch_axes)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = out @ params["Wo"] + params["bo"]
         out = maybe_dropout(out, self.dropout, train, rng)
@@ -145,13 +153,17 @@ class SelfAttentionLayer(BaseLayer):
 _ATTENTION_MESH: Optional[tuple] = None
 
 
-def set_attention_mesh(mesh, seq_axis: str = "seq", nets=()) -> None:
+def set_attention_mesh(mesh, seq_axis: str = "seq", nets=(),
+                       batch_axes=()) -> None:
     """Install (or clear, with None) the mesh attention layers execute on —
     call BEFORE the first fit/output: the choice is captured at jit trace
-    time. Pass already-traced models via ``nets`` to drop their cached
-    programs so the new mesh takes effect."""
+    time. ``batch_axes`` names the mesh axes the batch dim is sharded over
+    so the shard_map kernels keep it sharded inside the region. Pass
+    already-traced models via ``nets`` to drop their cached programs so the
+    new mesh takes effect."""
     global _ATTENTION_MESH
-    _ATTENTION_MESH = None if mesh is None else (mesh, seq_axis)
+    _ATTENTION_MESH = (None if mesh is None
+                       else (mesh, seq_axis, tuple(batch_axes or ())))
     for net in nets:
         for attr in ("_train_step", "_eval_forward", "_tbptt_step", "_rnn_step_fn",
                      "_grad_stats_step"):

@@ -69,6 +69,10 @@ class OutputLayer(DenseLayer):
 
     loss: str = "mcxent"
 
+    # parallel.roles: logits gather back whole (row-parallel W, replicated
+    # bias) so the loss softmax runs without cross-device reduces.
+    PARAM_ROLES = {"W": "ffn_down", "b": "ffn_down"}
+
     @property
     def is_output_layer(self) -> bool:
         return True
@@ -149,6 +153,10 @@ class EmbeddingLayer(BaseLayer):
     n_in: int = 0  # vocab size
     n_out: int = 0
     has_bias: bool = True
+
+    # parallel.roles: the table replicates over tp (vocab rows over fsdp
+    # when divisible) — lookups never pay a per-token gather.
+    PARAM_ROLES = {"W": "embedding"}
 
     def get_output_type(self, input_type: InputType) -> InputType:
         return InputType.feed_forward(self.n_out)

@@ -162,6 +162,14 @@ class GravesLSTM(BaseLayer):
     gate_activation: str = "sigmoid"
     activation: str = "tanh"
 
+    # parallel.roles registry (MeshLayout(roles=True)): the i/f/g/o gate
+    # blocks stay device-local — W goes row-parallel (tp shards the hoisted
+    # x@W rows, ONE all-reduce outside the scan), RW/b/peepholes replicate
+    # over tp, so the scan body pays zero per-step collectives. Bidirectional
+    # bwd_* params follow these via the roles.role_of prefix rule.
+    PARAM_ROLES = {"W": "lstm_gates", "RW": "lstm_gates", "b": "lstm_gates",
+                   "pF": "lstm_gates", "pI": "lstm_gates", "pO": "lstm_gates"}
+
     @property
     def is_recurrent(self) -> bool:
         return True
@@ -267,6 +275,10 @@ class RnnOutputLayer(DenseLayer):
 
     loss: str = "mcxent"
 
+    # parallel.roles: logits gather back whole (row-parallel W, replicated
+    # bias) so the softmax-xent loss runs without cross-device reduces.
+    PARAM_ROLES = {"W": "ffn_down", "b": "ffn_down"}
+
     @property
     def is_output_layer(self) -> bool:
         return True
@@ -310,6 +322,10 @@ class RnnEmbeddingLayer(BaseLayer):
 
     n_in: int = 0  # vocab
     n_out: int = 0
+
+    # parallel.roles: the table replicates over tp (vocab rows over fsdp
+    # when divisible) — token lookups never pay a per-token gather.
+    PARAM_ROLES = {"W": "embedding"}
 
     @property
     def is_recurrent(self) -> bool:

@@ -8,6 +8,13 @@ from .mesh import (
     data_sharding,
 )
 from .layout import MeshLayout, PrecisionPolicy, layout_of
+from .roles import (
+    HEAD_AWARE_ROLES,
+    RoleDivisibilityError,
+    register_layer_role,
+    registered_roles,
+    roles_for,
+)
 from .wrapper import ParallelWrapper
 from .training_master import (
     TrainingMaster,
@@ -38,6 +45,11 @@ __all__ = [
     "MeshLayout",
     "PrecisionPolicy",
     "layout_of",
+    "HEAD_AWARE_ROLES",
+    "RoleDivisibilityError",
+    "register_layer_role",
+    "registered_roles",
+    "roles_for",
     "ParallelWrapper",
     "TrainingMaster",
     "TrainingStats",

@@ -90,6 +90,12 @@ class PrecisionPolicy:
                 "compute_dtype": self.compute_dtype}
 
 
+def _is_spec(x) -> bool:
+    from jax.sharding import PartitionSpec
+
+    return isinstance(x, PartitionSpec)
+
+
 def layout_of(net) -> Optional["MeshLayout"]:
     """The MeshLayout a net was sharded with (``MeshLayout.apply``), or
     None — how the serving fast path discovers mesh placement."""
@@ -100,32 +106,36 @@ class MeshLayout:
     """One named mesh + the spec rules every scale path shares."""
 
     def __init__(self, data: Optional[int] = None, fsdp: int = 1, tp: int = 1,
-                 *, devices: Optional[Sequence] = None,
-                 params_dtype: Optional[str] = None, zero_stage: int = 3):
+                 seq: int = 1, *, devices: Optional[Sequence] = None,
+                 params_dtype: Optional[str] = None, zero_stage: int = 3,
+                 roles: bool = False):
         import jax
         from jax.sharding import Mesh
 
-        fsdp, tp = int(fsdp), int(tp)
-        if fsdp < 1 or tp < 1:
-            raise ValueError(f"axis sizes must be >= 1, got fsdp={fsdp} tp={tp}")
+        fsdp, tp, seq = int(fsdp), int(tp), int(seq)
+        if fsdp < 1 or tp < 1 or seq < 1:
+            raise ValueError(
+                f"axis sizes must be >= 1, got fsdp={fsdp} tp={tp} seq={seq}")
         devs = list(devices) if devices is not None else jax.devices()
         if data is None:
-            data = max(1, len(devs) // (fsdp * tp))
+            data = max(1, len(devs) // (fsdp * tp * seq))
         data = int(data)
-        need = data * fsdp * tp
+        need = data * fsdp * tp * seq
         if need > len(devs):
             raise ValueError(
-                f"layout data={data} x fsdp={fsdp} x tp={tp} needs {need} "
-                f"devices, have {len(devs)}")
-        arr = np.array(devs[:need]).reshape(data, fsdp, tp)
-        self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp"))
-        self._init_axes({"data": data, "fsdp": fsdp, "tp": tp},
-                        params_dtype=params_dtype, zero_stage=zero_stage)
+                f"layout data={data} x fsdp={fsdp} x tp={tp} x seq={seq} "
+                f"needs {need} devices, have {len(devs)}")
+        arr = np.array(devs[:need]).reshape(data, fsdp, tp, seq)
+        self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp", "seq"))
+        self._init_axes({"data": data, "fsdp": fsdp, "tp": tp, "seq": seq},
+                        params_dtype=params_dtype, zero_stage=zero_stage,
+                        roles=roles)
 
     def _init_axes(self, sizes: dict, *, params_dtype: Optional[str],
                    zero_stage: int, canonical: bool = True,
                    model_axis: Optional[str] = None,
-                   expert_axis: Optional[str] = None) -> None:
+                   expert_axis: Optional[str] = None,
+                   roles: bool = False) -> None:
         if int(zero_stage) not in (1, 3):
             raise ValueError(
                 f"zero_stage must be 1 (moments-only fsdp sharding) or 3 "
@@ -139,6 +149,8 @@ class MeshLayout:
                 else None
             self._tp_axis = "tp" if self._axis_sizes.get("tp", 1) > 1 else None
             self._expert_axis = None
+            self._seq_axis = ("seq" if self._axis_sizes.get("seq", 1) > 1
+                              else None)
         else:
             # legacy from_mesh semantics: every non-model/expert axis is a
             # batch axis, size-1 included (spec spellings feed cache keys)
@@ -150,8 +162,20 @@ class MeshLayout:
                 and "fsdp" not in (model_axis, expert_axis)) else None
             self._tp_axis = model_axis
             self._expert_axis = expert_axis
+            self._seq_axis = ("seq" if (
+                self._axis_sizes.get("seq", 1) > 1
+                and "seq" not in (model_axis, expert_axis)) else None)
+            if self._seq_axis is not None:
+                self._batch_axes = tuple(
+                    a for a in self._batch_axes if a != "seq")
         self.zero_stage = int(zero_stage)
         self.precision = PrecisionPolicy(params_dtype=params_dtype)
+        self.roles = bool(roles)
+        # layer-semantics binding (MeshLayout.bind): path-suffix
+        # (layer key, param name) -> (role, layer). None until bound.
+        self._role_map = None
+        self._role_ctx: dict = {}
+        self._role_sites: List[dict] = []
 
     @classmethod
     def from_mesh(cls, mesh, model_axis: Optional[str] = None,
@@ -176,9 +200,9 @@ class MeshLayout:
         return self
 
     @classmethod
-    def abstract(cls, data: int = 1, fsdp: int = 1, tp: int = 1, *,
-                 params_dtype: Optional[str] = None,
-                 zero_stage: int = 3) -> "MeshLayout":
+    def abstract(cls, data: int = 1, fsdp: int = 1, tp: int = 1,
+                 seq: int = 1, *, params_dtype: Optional[str] = None,
+                 zero_stage: int = 3, roles: bool = False) -> "MeshLayout":
         """A device-less layout: pure spec algebra (``param_spec``,
         ``batch_spec``, the sharding-flow pass) with NO jax mesh behind it —
         the CLI ``--mesh`` flag analyzes a 64-chip layout from a laptop.
@@ -187,8 +211,9 @@ class MeshLayout:
         self = cls.__new__(cls)
         self.mesh = None
         self._init_axes({"data": int(data), "fsdp": int(fsdp),
-                         "tp": int(tp)},
-                        params_dtype=params_dtype, zero_stage=zero_stage)
+                         "tp": int(tp), "seq": int(seq)},
+                        params_dtype=params_dtype, zero_stage=zero_stage,
+                        roles=roles)
         return self
 
     # ------------------------------------------------------------ geometry
@@ -229,6 +254,24 @@ class MeshLayout:
 
         return P(None, self._batch_axes) if self._batch_axes else P()
 
+    def input_spec(self, ndim: Optional[int] = None):
+        """Spec for one input/label tensor: dim 0 over the batch axes, and —
+        under an active seq axis — dim 1 (time, ``[B, T, ...]``) over
+        ``seq``. Rank-2-or-less tensors (and layouts without a seq axis)
+        fall back to :meth:`batch_spec`."""
+        from jax.sharding import PartitionSpec as P
+
+        if self._seq_axis is not None and ndim is not None and ndim >= 3:
+            return P(self._batch_axes or None, self._seq_axis)
+        return self.batch_spec()
+
+    def input_sharding(self, arr=None):
+        """NamedSharding for one input tensor (:meth:`input_spec` of its
+        rank — pass the array/struct, or nothing for the plain batch
+        sharding)."""
+        ndim = len(np.shape(arr)) if arr is not None else None
+        return self.sharding(self.input_spec(ndim))
+
     def param_spec(self, shape) -> "Any":
         """The fsdp/tp/expert rule set for one parameter shape:
 
@@ -258,12 +301,13 @@ class MeshLayout:
         bytes and nothing in the step needs them gathered)."""
         return self._shape_spec(shape, with_fsdp=True)
 
-    def _shape_spec(self, shape, *, with_fsdp: bool) -> "Any":
+    def _shape_spec(self, shape, *, with_fsdp: bool,
+                    with_tp: bool = True) -> "Any":
         from jax.sharding import PartitionSpec as P
 
         shape = tuple(int(s) for s in shape)
         esize = self._size(self._expert_axis)
-        tsize = self._size(self._tp_axis)
+        tsize = self._size(self._tp_axis) if with_tp else 1
         fsize = self._size(self._fsdp_axis) if with_fsdp else 1
         if (self._expert_axis and len(shape) == 3 and esize > 1
                 and shape[0] % esize == 0 and shape[0] >= esize):
@@ -321,34 +365,156 @@ class MeshLayout:
                 "tp/expert layouts; use sync mode (averaging_frequency=1)")
         return self.batch_sharding()
 
-    def param_specs(self, tree):
-        """PartitionSpec pytree for params — or any shape-mirroring tree
-        (scalar bookkeeping replicates)."""
+    # ------------------------------------------------------ role resolution
+    def bind(self, net) -> "MeshLayout":
+        """Resolve the layer-semantics registry against ``net``'s layers
+        (``roles=True`` layouts only — a no-op otherwise): every param whose
+        layer declares a role gets a role-resolved spec keyed by its tree
+        path suffix ``(layer key, param name)``, so optimizer moments (and
+        any shape-mirroring tree) follow their param's role. Divisibility
+        is checked here — ``apply``/``validate``/``describe`` all reject a
+        tp size that does not divide a head count or row dim instead of
+        silently falling back (:class:`roles.RoleDivisibilityError`)."""
+        if not self.roles:
+            return self
+        from . import roles as R
+
+        conf = net.conf
+        if hasattr(conf, "vertices"):
+            items = [(str(k), getattr(v, "layer", v))
+                     for k, v in conf.vertices.items()]
+        else:
+            items = [(str(i), l) for i, l in enumerate(conf.layers)]
+        tsize = self._size(self._tp_axis)
+        role_map: dict = {}
+        role_ctx: dict = {}
+        sites: List[dict] = []
+        prev = None
+        for key, layer in items:
+            # ffn_down is row-parallel ONLY when the producing stage is
+            # feature-local math (attention/dense): after an LSTM scan the
+            # row-parallel backward would send a tp-sharded cotangent into
+            # every scan step — replicate the head over tp instead
+            ctx = {"after_scan": prev is not None
+                   and "LSTM" in type(prev).__name__}
+            prev = layer
+            rmap = R.roles_for(layer)
+            if not any(r != R.GENERIC for r in rmap.values()):
+                continue
+            role_map[key] = layer
+            role_ctx[key] = ctx
+            for pname, role in sorted(rmap.items()):
+                if role == R.GENERIC:
+                    continue
+                sites.append({"layer": key,
+                              "layer_type": type(layer).__name__,
+                              "param": pname, "role": role, **ctx})
+                # early divisibility rejection for checks that need only
+                # layer attrs (n_heads); shape-dependent ones re-check at
+                # spec resolution
+                if role in R.HEAD_AWARE_ROLES:
+                    heads = getattr(layer, "n_heads", None)
+                    if heads is not None and tsize > 1 \
+                            and int(heads) % tsize != 0:
+                        R.check_role_site(layer, key, pname, role, (),
+                                          tsize)
+        self._role_map = role_map
+        self._role_ctx = role_ctx
+        self._role_sites = sites
+        return self
+
+    @property
+    def role_sites(self) -> List[dict]:
+        """Every (layer, param, role) the binding resolved — empty until
+        :meth:`bind` (``apply`` binds automatically)."""
+        return list(self._role_sites)
+
+    def role_resolved_types(self) -> set:
+        """Layer type names whose params resolved through a HEAD-AWARE role
+        rule (attention_qkv/attention_out/lstm_gates) — the DT305 advisory
+        skips these sites."""
+        from . import roles as R
+
+        return {s["layer_type"] for s in self._role_sites
+                if s["role"] in R.HEAD_AWARE_ROLES}
+
+    def _path_site(self, path):
+        """(layer key, param name) from a tree-path SUFFIX, or None. Param
+        trees end ``(..., layer key, param name)`` on both net classes —
+        and optax moment trees mirror params, so the same suffix matches
+        ``mu``/``nu`` leaves without knowing the optimizer's structure."""
+        if self._role_map is None or len(path) < 2:
+            return None
+        name_k, layer_k = path[-1], path[-2]
+        name = getattr(name_k, "key", None)
+        if not isinstance(name, str):
+            return None
+        layer = getattr(layer_k, "key", None)
+        if layer is None:
+            layer = getattr(layer_k, "idx", None)
+        if layer is None:
+            return None
+        return (str(layer), name)
+
+    def _resolve_leaf_spec(self, path, shape, *, with_fsdp: bool):
+        """Role spec for one leaf when bound and matched, else the generic
+        shape rule."""
+        site = self._path_site(path)
+        if site is not None:
+            layer = self._role_map.get(site[0])
+            if layer is not None:
+                from . import roles as R
+
+                role = R.role_of(layer, site[1])
+                if role is not None and role != R.GENERIC:
+                    ctx = getattr(self, "_role_ctx", {}).get(site[0]) or {}
+                    R.check_role_site(layer, site[0], site[1], role, shape,
+                                      self._size(self._tp_axis), ctx=ctx)
+                    spec = R.resolve_role_spec(self, role, site[1], shape,
+                                               with_fsdp=with_fsdp, ctx=ctx)
+                    if spec is not None:
+                        return spec
+        return self._shape_spec(shape, with_fsdp=with_fsdp)
+
+    def _spec_tree(self, tree, *, with_fsdp: bool):
         import jax
 
-        return jax.tree_util.tree_map(
-            lambda a: self.param_spec(np.shape(a)), tree)
+        if self._role_map is None:
+            return jax.tree_util.tree_map(
+                lambda a: self._shape_spec(np.shape(a),
+                                           with_fsdp=with_fsdp), tree)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        return jax.tree_util.tree_unflatten(
+            treedef, [self._resolve_leaf_spec(p, np.shape(l),
+                                              with_fsdp=with_fsdp)
+                      for p, l in flat])
+
+    def param_specs(self, tree):
+        """PartitionSpec pytree for params — or any shape-mirroring tree
+        (scalar bookkeeping replicates). Role-resolved per site after
+        :meth:`bind`; the generic shape rules otherwise."""
+        return self._spec_tree(tree, with_fsdp=(self.zero_stage >= 3))
 
     def param_shardings(self, tree):
         import jax
 
         return jax.tree_util.tree_map(
-            lambda a: self.sharding(self.param_spec(np.shape(a))), tree)
+            self.sharding, self.param_specs(tree),
+            is_leaf=_is_spec)
 
     def opt_specs(self, tree):
         """PartitionSpec pytree for optimizer state (moments follow their
-        param's shape rule at zero_stage=3; ZeRO-1 shards them over fsdp
-        while the params replicate)."""
-        import jax
-
-        return jax.tree_util.tree_map(
-            lambda a: self.opt_spec(np.shape(a)), tree)
+        param's shape rule — and, once bound, their param's ROLE — at
+        zero_stage=3; ZeRO-1 shards them over fsdp while params
+        replicate)."""
+        return self._spec_tree(tree, with_fsdp=True)
 
     def opt_shardings(self, tree):
         import jax
 
         return jax.tree_util.tree_map(
-            lambda a: self.sharding(self.opt_spec(np.shape(a))), tree)
+            self.sharding, self.opt_specs(tree),
+            is_leaf=_is_spec)
 
     # -------------------------------------------------------------- devices
     def put(self, arr, sharding=None):
@@ -360,14 +526,15 @@ class MeshLayout:
                           else self.batch_sharding())
 
     def put_params(self, tree):
-        """device_put a param-shaped pytree leaf-wise on its layout specs."""
+        """device_put a param-shaped pytree leaf-wise on its layout specs
+        (role-resolved per site once :meth:`bind` ran)."""
         import jax
 
         from .mesh import global_put
 
         return jax.tree_util.tree_map(
-            lambda a: global_put(a, self.sharding(
-                self.param_spec(np.shape(a)))), tree)
+            lambda a, s: global_put(a, self.sharding(s)),
+            tree, self.param_specs(tree))
 
     def put_opt_state(self, tree):
         """device_put optimizer state on its moment specs (= param specs at
@@ -377,8 +544,8 @@ class MeshLayout:
         from .mesh import global_put
 
         return jax.tree_util.tree_map(
-            lambda a: global_put(a, self.sharding(
-                self.opt_spec(np.shape(a)))), tree)
+            lambda a, s: global_put(a, self.sharding(s)),
+            tree, self.opt_specs(tree))
 
     def put_replicated(self, tree):
         import jax
@@ -397,6 +564,9 @@ class MeshLayout:
         import jax
 
         net.init()
+        self.bind(net)
+        if self._seq_axis is not None:
+            self._install_seq(net)
         self.precision.apply_to_net(net)
         net.params = self.put_params(net.params)
         if net.opt_state is not None:
@@ -406,6 +576,33 @@ class MeshLayout:
         net._mesh_layout = self
         return self
 
+    def _install_seq(self, net) -> None:
+        """Wire the sequence axis: attention layers route q/k/v through the
+        shard_map ring/all-to-all kernels (``parallel/ring_attention.py``)
+        on this mesh — the escape hatch where GSPMD's own propagation would
+        reshard K/V every block. Recurrent scan layers consume time
+        sequentially, so a seq axis cannot shard their scan — reject loudly
+        instead of silently training with per-step resharding."""
+        conf = net.conf
+        if hasattr(conf, "vertices"):
+            layers = [getattr(v, "layer", v) for v in conf.vertices.values()]
+        else:
+            layers = list(conf.layers)
+        recurrent = [type(l).__name__ for l in layers
+                     if "LSTM" in type(l).__name__]
+        if recurrent:
+            raise ValueError(
+                f"seq={self._size(self._seq_axis)} shards the time dim, but "
+                f"{', '.join(sorted(set(recurrent)))} consumes time "
+                "sequentially inside lax.scan — the seq axis supports "
+                "attention nets (ring/all-to-all sequence parallelism); "
+                "use data/fsdp/tp for recurrent nets")
+        if any(hasattr(l, "n_heads") for l in layers):
+            from ..nn.layers.attention import set_attention_mesh
+
+            set_attention_mesh(self.mesh, "seq", nets=(net,),
+                               batch_axes=self._batch_axes)
+
     def shard_params(self, net):
         """:meth:`apply` returning the param sharding pytree (checkpoint
         restore wants it) — the layout twin of the legacy
@@ -414,15 +611,37 @@ class MeshLayout:
         return self.param_shardings(net.params)
 
     # ------------------------------------------------------------ validation
-    def validate(self, params=None, *, source: str = "<MeshLayout>"):
+    def validate(self, params=None, *, net=None,
+                 source: str = "<MeshLayout>"):
         """DT008 ``check_partition_specs`` over this layout's param specs
         (axis membership, duplicate axes, divisibility when ``params`` is
-        given). Returns analysis findings — empty means clean."""
+        given). Role-resolved specs are validated too: pass ``net`` (or
+        :meth:`bind` first) and a tp size that does not divide a head count
+        or row dim comes back as an ERROR finding naming the layer and dim
+        instead of silently falling back. Returns analysis findings — empty
+        means clean."""
         from ..analysis import check_partition_specs
 
+        findings = []
+        if net is not None and self.roles and self._role_map is None:
+            try:
+                self.bind(net)
+            except ValueError as e:
+                from ..analysis.rules import get_rule
+
+                return [get_rule("DT008").finding(str(e), file=source,
+                                                  context="roles")]
         tree = params if params is not None else {}
-        specs = self.param_specs(tree) if params is not None else {}
-        return check_partition_specs(specs, self.mesh, params, source=source)
+        try:
+            specs = self.param_specs(tree) if params is not None else {}
+        except ValueError as e:
+            from ..analysis.rules import get_rule
+
+            return [get_rule("DT008").finding(str(e), file=source,
+                                              context="roles")]
+        findings += check_partition_specs(specs, self.mesh, params,
+                                          source=source)
+        return findings
 
     # ------------------------------------------------------- fsdp HBM math
     def _leaf_bytes(self, leaf, *, storage: bool, sharded: bool,
@@ -476,11 +695,17 @@ class MeshLayout:
         """
         import jax
 
-        p_pd = sum(self._leaf_bytes(l, storage=True, sharded=True)
-                   for l in jax.tree_util.tree_leaves(net.params))
-        o_pd = sum(self._leaf_bytes(l, storage=True, sharded=True,
-                                    spec_fn=self.opt_spec)
-                   for l in jax.tree_util.tree_leaves(net.opt_state))
+        def _tree_bytes(tree, spec_tree):
+            leaves = jax.tree_util.tree_leaves(tree)
+            specs = jax.tree_util.tree_leaves(spec_tree, is_leaf=_is_spec)
+            return sum(self._leaf_bytes(l, storage=True, sharded=True,
+                                        spec_fn=lambda _s, s=s: s)
+                       for l, s in zip(leaves, specs))
+
+        # per-leaf spec TREES, not shape rules: once a net is bound, two
+        # same-shaped params can resolve to different role specs
+        p_pd = _tree_bytes(net.params, self.param_specs(net.params))
+        o_pd = _tree_bytes(net.opt_state, self.opt_specs(net.opt_state))
         bf = self.batch_factor
         act_pd = 0.0
         rows = report.get("layers") or []
@@ -504,17 +729,25 @@ class MeshLayout:
 
     # ---------------------------------------------------------------- misc
     def describe(self) -> dict:
-        """JSON-ready layout summary (serving stats / flight events)."""
-        return {
+        """JSON-ready layout summary (serving stats / flight events). A
+        bound roles layout lists its resolved sites; binding already
+        rejected non-divisible tp sizes, so a describable layout is a
+        valid one."""
+        out = {
             "axes": self.axis_sizes,
             "batch_axes": list(self._batch_axes),
             "fsdp_axis": self._fsdp_axis,
             "tp_axis": self._tp_axis,
+            "seq_axis": self._seq_axis,
             "expert_axis": self._expert_axis,
             "devices": self.num_devices,
             "zero_stage": self.zero_stage,
+            "roles": self.roles,
             "precision": self.precision.describe(),
         }
+        if self._role_map is not None:
+            out["role_sites"] = self.role_sites
+        return out
 
     def __repr__(self) -> str:  # pragma: no cover - debugging nicety
         sizes = "x".join(f"{a}={s}" for a, s in self.axis_sizes.items())

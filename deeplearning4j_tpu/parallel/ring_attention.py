@@ -74,15 +74,26 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
+def _batch_entry(mesh, batch_axes):
+    """The PartitionSpec batch-dim entry for the live batch axes (size-1
+    axes trimmed): shard_map treats every mesh axis as manual, so a batch
+    axis left out of the in_specs would force GSPMD to all-gather the
+    activations over it at the region boundary."""
+    live = tuple(a for a in (batch_axes or ()) if mesh.shape.get(a, 1) > 1)
+    return live if live else None
+
+
 def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
-                   key_mask=None):
+                   key_mask=None, batch_axes=()):
     """Sequence-parallel attention: time axis sharded over ``seq_axis``.
 
     Full q/k/v are passed in [B,H,T,D]; shard_map splits T over the mesh
     axis and the K/V shards circulate the ring (P-1 ppermute hops); the
     ``key_mask`` [B,T] shard (padding exclusion) travels with its K block.
-    The result equals :func:`attention` on the gathered arrays.
+    ``batch_axes`` names the mesh axes the batch dim is sharded over
+    (kept sharded inside the region). The result equals :func:`attention`
+    on the gathered arrays.
     """
     from jax.sharding import PartitionSpec as P  # noqa: PLC0415
 
@@ -93,8 +104,9 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     n_shards = mesh.shape[seq_axis]
-    spec = P(None, None, seq_axis, None)
-    mspec = P(None, seq_axis)
+    batch = _batch_entry(mesh, batch_axes)
+    spec = P(batch, None, seq_axis, None)
+    mspec = P(batch, seq_axis)
 
     local = functools.partial(
         _ring_local, n_shards=n_shards, seq_axis=seq_axis,
@@ -137,7 +149,7 @@ def _ring_local(q, k, v, kmask=None, *, n_shards, seq_axis, causal, scale,
 
 def all_to_all_attention(q, k, v, mesh, seq_axis: str = "seq",
                          causal: bool = False, scale: Optional[float] = None,
-                         key_mask=None):
+                         key_mask=None, batch_axes=()):
     """DeepSpeed-Ulysses-style sequence parallelism: all-to-all swaps the
     sharded axis from time to heads, computes full-sequence attention locally
     per head group, and swaps back. Complements ring attention: better when
@@ -153,8 +165,9 @@ def all_to_all_attention(q, k, v, mesh, seq_axis: str = "seq",
     n = mesh.shape[seq_axis]
     if q.shape[1] % n != 0:
         raise ValueError(f"heads ({q.shape[1]}) must divide mesh axis ({n})")
-    spec = P(None, None, seq_axis, None)
-    mspec = P(None, seq_axis)
+    batch = _batch_entry(mesh, batch_axes)
+    spec = P(batch, None, seq_axis, None)
+    mspec = P(batch, seq_axis)
 
     def local(q, k, v, kmask=None):
         # [B, H, T/n, D] -> all_to_all -> [B, H/n, T, D]

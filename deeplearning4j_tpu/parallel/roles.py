@@ -1,0 +1,258 @@
+"""Layer-semantics spec registry: per-site PartitionSpecs by ROLE, not ndim.
+
+`MeshLayout._shape_spec` picks specs from parameter *shapes* ("2-D+ kernels
+shard the last dim over tp"), which is the right default for dense stacks
+but wrong for attention and LSTM: the flat last dim of an attention
+projection is ``heads x head_dim`` and the flat last dim of an LSTM kernel
+is the ``4H`` gate block ``[a|f|o|i]`` — splitting either across tp devices
+pays per-step activation collectives that DT305 names site-by-site
+(``analysis/shard_flow.py``). This module is the spec-rule half of ROADMAP
+direction 2 (the analyzer half landed in PR 9), in the style of
+SNIPPETS.md [2]'s ``SpecLayout``: layers declare what their parameters
+*mean*, and the layout resolves head-aware specs from those roles.
+
+Roles and their tp rules (fsdp composes per the usual ZeRO placement):
+
+==================  =======================================================
+``attention_qkv``   column-parallel ``[n_in, H*D] -> P(fsdp?, tp)``: each
+                    device computes whole heads (tp must divide the head
+                    count — the reshape ``[B,T,d] -> [B,T,H,D]`` then keeps
+                    tp on the head dim and per-head attention math is local)
+``attention_out``   row-parallel ``[d, d] -> P(tp, fsdp?)``: the contraction
+                    dim is sharded on BOTH sides, so GSPMD keeps partial
+                    sums and the whole block pays ONE all-reduce (Megatron
+                    pattern; Shoeybi et al.)
+``lstm_gates``      the input kernel ``W [n_in, 4H]`` goes row-parallel
+                    ``P(tp, fsdp?)`` (tp shards the big hoisted ``x @ W``
+                    projection; ONE all-reduce outside the scan) while the
+                    recurrent kernel/bias/peepholes replicate over tp — the
+                    ``i/f/g/o`` gate blocks stay device-local, so the scan
+                    body runs with ZERO per-step collectives (the DT304/305
+                    fix)
+``ffn_up``          column-parallel ``P(fsdp?, tp)``, bias ``P(tp)`` — the
+                    first half of a Megatron MLP pair
+``ffn_down``        row-parallel ``P(tp, fsdp?)``, bias replicated over tp —
+                    the gather-back half (also the right role for output/
+                    softmax layers: logits come back whole, so the loss
+                    softmax runs without cross-device reduces)
+``embedding``       table replicated over tp (vocab rows shard over fsdp
+                    when divisible) — lookups never pay a per-token gather
+``generic``         the existing shape rules, unchanged
+==================  =======================================================
+
+The registry is keyed by layer class + param name. Layers ship their own
+declarations via a ``PARAM_ROLES`` class attribute (resolved through the
+MRO, ``bwd_``-prefixed bidirectional params follow their forward twin);
+external/custom layers join with :func:`register_layer_role`. Role
+resolution is OPT-IN per layout (``MeshLayout(..., roles=True)``) so every
+existing layout stays bit-compatible with the shape rules.
+
+Divisibility is checked, not silently skipped: a tp size that does not
+divide the head count (or the LSTM/FFN row dim) raises
+:class:`RoleDivisibilityError` naming the layer and dim — the old behavior
+(fall back to the next shape rule) masked a misconfigured mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "ATTENTION_QKV", "ATTENTION_OUT", "LSTM_GATES", "FFN_UP", "FFN_DOWN",
+    "EMBEDDING", "GENERIC", "HEAD_AWARE_ROLES", "RoleDivisibilityError",
+    "register_layer_role", "registered_roles", "roles_for",
+    "resolve_role_spec", "check_role_site",
+]
+
+ATTENTION_QKV = "attention_qkv"
+ATTENTION_OUT = "attention_out"
+LSTM_GATES = "lstm_gates"
+FFN_UP = "ffn_up"
+FFN_DOWN = "ffn_down"
+EMBEDDING = "embedding"
+GENERIC = "generic"
+
+#: roles whose resolution makes a layer "head-aware" — DT305 must not fire
+#: on a site that resolved through one of these
+HEAD_AWARE_ROLES = frozenset({ATTENTION_QKV, ATTENTION_OUT, LSTM_GATES})
+
+_ALL_ROLES = frozenset({ATTENTION_QKV, ATTENTION_OUT, LSTM_GATES, FFN_UP,
+                        FFN_DOWN, EMBEDDING, GENERIC})
+
+# (layer class name, param name) -> role. Class NAMES key the table so
+# registration never imports layer modules (and JSON round-trips stay
+# trivial); lookups walk the layer's MRO.
+_REGISTRY: Dict[Tuple[str, str], str] = {}
+
+
+class RoleDivisibilityError(ValueError):
+    """tp size does not divide a role-sharded dim (head count / row dim)."""
+
+
+def register_layer_role(layer_cls, param_name: str, role: str) -> None:
+    """Map ``(layer class, param name)`` to a role. ``layer_cls`` may be the
+    class or its name. This is THE extension point custom layers use to opt
+    into head-aware tp — see docs/distributed.md "Layer roles"."""
+    if role not in _ALL_ROLES:
+        raise ValueError(f"unknown role {role!r}; valid: {sorted(_ALL_ROLES)}")
+    name = layer_cls if isinstance(layer_cls, str) else layer_cls.__name__
+    _REGISTRY[(str(name), str(param_name))] = role
+
+
+def registered_roles() -> Dict[Tuple[str, str], str]:
+    """Snapshot of the explicit registry (PARAM_ROLES declarations on layer
+    classes are resolved per-layer by :func:`roles_for`, not listed here)."""
+    return dict(_REGISTRY)
+
+
+def roles_for(layer) -> Dict[str, str]:
+    """Every ``param name -> role`` mapping for ``layer``: explicit
+    registrations (by any class in the MRO) override the class's own
+    ``PARAM_ROLES`` declaration. Empty dict = purely generic layer."""
+    out: Dict[str, str] = {}
+    for cls in reversed(type(layer).__mro__):
+        out.update(getattr(cls, "PARAM_ROLES", None) or {})
+    for cls in reversed(type(layer).__mro__):
+        cname = cls.__name__
+        for (lname, pname), role in _REGISTRY.items():
+            if lname == cname:
+                out[pname] = role
+    return out
+
+
+def role_of(layer, param_name: str) -> Optional[str]:
+    """The role of one param, or None. ``bwd_``-prefixed params (the
+    bidirectional-LSTM direction twin) follow their forward name."""
+    rmap = roles_for(layer)
+    if param_name in rmap:
+        return rmap[param_name]
+    if param_name.startswith("bwd_") and param_name[4:] in rmap:
+        return rmap[param_name[4:]]
+    return None
+
+
+# --------------------------------------------------------------- spec rules
+def _require(cond: bool, layer, param: str, msg: str) -> None:
+    if not cond:
+        raise RoleDivisibilityError(
+            f"{type(layer).__name__}.{param}: {msg} — a non-divisible tp "
+            "size would silently split heads/gates across devices; shrink "
+            "tp or change the layer width")
+
+
+def check_role_site(layer, layer_key, param: str, role: str, shape,
+                    tp_size: int, ctx: Optional[dict] = None) -> None:
+    """The divisibility contract, checked at bind time (so ``describe()``/
+    ``validate()``/``apply()`` all reject early instead of silently falling
+    back to the next shape rule). ``ctx`` carries bind-time site context
+    (``after_scan``: the producing stage is an LSTM scan, so ffn_down
+    resolves replicated and its row-dim constraint does not apply)."""
+    if tp_size <= 1:
+        return
+    ctx = ctx or {}
+    shape = tuple(int(s) for s in shape)
+    base = param[4:] if param.startswith("bwd_") else param
+    if role in (ATTENTION_QKV, ATTENTION_OUT):
+        heads = getattr(layer, "n_heads", None)
+        if heads is not None:
+            _require(int(heads) % tp_size == 0, layer, param,
+                     f"tp={tp_size} does not divide n_heads={int(heads)} "
+                     "(the head dim)")
+        if role == ATTENTION_QKV and len(shape) >= 2:
+            _require(shape[-1] % tp_size == 0, layer, param,
+                     f"tp={tp_size} does not divide the projection width "
+                     f"dim [-1]={shape[-1]}")
+        if role == ATTENTION_OUT and len(shape) >= 2:
+            _require(shape[0] % tp_size == 0, layer, param,
+                     f"tp={tp_size} does not divide the row (contraction) "
+                     f"dim [0]={shape[0]}")
+    elif role == LSTM_GATES and base == "W" and len(shape) >= 2:
+        gate_block = shape[-1] // 4 if shape[-1] % 4 == 0 else shape[-1]
+        _require(shape[0] % tp_size == 0, layer, param,
+                 f"tp={tp_size} does not divide the input dim "
+                 f"[0]={shape[0]} (the 4H gate block [4x{gate_block}] "
+                 "stays device-local; tp shards the input rows)")
+    elif role in (FFN_DOWN,) and len(shape) >= 2 \
+            and not ctx.get("after_scan"):
+        _require(shape[0] % tp_size == 0, layer, param,
+                 f"tp={tp_size} does not divide the row (contraction) "
+                 f"dim [0]={shape[0]}")
+    elif role in (FFN_UP,) and len(shape) >= 2:
+        _require(shape[-1] % tp_size == 0, layer, param,
+                 f"tp={tp_size} does not divide the column dim "
+                 f"[-1]={shape[-1]}")
+
+
+def _column_parallel(layout, shape, with_fsdp: bool):
+    # [.., out_features] -> out features over tp, a non-tp dim over fsdp
+    return layout._shape_spec(shape, with_fsdp=with_fsdp)
+
+
+def _row_parallel(layout, shape, with_fsdp: bool):
+    from jax.sharding import PartitionSpec as P
+
+    shape = tuple(int(s) for s in shape)
+    tsize = layout._size(layout._tp_axis)
+    fsize = layout._size(layout._fsdp_axis) if with_fsdp else 1
+    entries: list = [None] * len(shape)
+    if tsize > 1 and shape[0] % tsize == 0:
+        entries[0] = layout._tp_axis
+    if fsize > 1:
+        for d in range(len(shape) - 1, -1, -1):
+            if entries[d] is None and shape[d] % fsize == 0 \
+                    and shape[d] >= fsize:
+                entries[d] = layout._fsdp_axis
+                break
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def _replicated_over_tp(layout, shape, with_fsdp: bool):
+    # the generic shape rule with the tp axis masked out
+    return layout._shape_spec(shape, with_fsdp=with_fsdp, with_tp=False)
+
+
+def _tp_vector(layout, shape, with_fsdp: bool):
+    from jax.sharding import PartitionSpec as P
+
+    tsize = layout._size(layout._tp_axis)
+    if len(shape) == 1 and tsize > 1 and int(shape[0]) % tsize == 0:
+        return P(layout._tp_axis)
+    return _replicated_over_tp(layout, shape, with_fsdp)
+
+
+def resolve_role_spec(layout, role: str, param: str, shape,
+                      with_fsdp: bool, ctx: Optional[dict] = None):
+    """PartitionSpec for one role site, or None to fall back to the generic
+    shape rule. ``layout`` is the MeshLayout doing the resolution; ``ctx``
+    is the bind-time site context (see :func:`check_role_site`)."""
+    shape = tuple(int(s) for s in shape)
+    ctx = ctx or {}
+    base = param[4:] if param.startswith("bwd_") else param
+    if role == GENERIC or not shape:
+        return None
+    if role == FFN_DOWN and ctx.get("after_scan"):
+        # row-parallel assumes the producing stage left features tp-local
+        # (attention/column-parallel math). After an LSTM scan the input is
+        # replicated, and a row-parallel head would push a tp-sharded
+        # cotangent into EVERY backward scan step — replicate instead.
+        return _replicated_over_tp(layout, shape, with_fsdp)
+    if role == ATTENTION_QKV:
+        return _column_parallel(layout, shape, with_fsdp) \
+            if len(shape) >= 2 else _tp_vector(layout, shape, with_fsdp)
+    if role in (ATTENTION_OUT, FFN_DOWN):
+        return _row_parallel(layout, shape, with_fsdp) \
+            if len(shape) >= 2 else _replicated_over_tp(layout, shape,
+                                                        with_fsdp)
+    if role == FFN_UP:
+        return _column_parallel(layout, shape, with_fsdp) \
+            if len(shape) >= 2 else _tp_vector(layout, shape, with_fsdp)
+    if role == LSTM_GATES:
+        if base == "W" and len(shape) >= 2:
+            return _row_parallel(layout, shape, with_fsdp)
+        # RW / b / peepholes: gate math stays device-local
+        return _replicated_over_tp(layout, shape, with_fsdp)
+    if role == EMBEDDING:
+        return _replicated_over_tp(layout, shape, with_fsdp)
+    return None

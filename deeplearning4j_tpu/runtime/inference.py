@@ -183,16 +183,22 @@ def _net_layout(net):
 
 
 def _layout_put(layout, arr, rows: Optional[int] = None):
-    """Place one request tensor on the net's layout: batch-sharded over
-    data×fsdp when the (padded) row count divides the batch factor,
-    replicated otherwise — both compile and run under GSPMD; replication
-    only costs the sharding win, never correctness. No-op without a
-    layout (single-device serving keeps host arrays — zero extra puts)."""
+    """Place one request tensor on the net's layout: input-sharded (batch
+    over data×fsdp, and — under an active seq axis — time over ``seq``)
+    when the (padded) row count divides the batch factor, replicated
+    otherwise — both compile and run under GSPMD; replication only costs
+    the sharding win, never correctness. No-op without a layout
+    (single-device serving keeps host arrays — zero extra puts)."""
     if layout is None or arr is None:
         return arr
     bf = layout.batch_factor
     if rows is not None and bf > 1 and rows % bf == 0:
-        return layout.put(arr, layout.batch_sharding())
+        shard = layout.batch_sharding()
+        seq = getattr(layout, "_seq_axis", None)
+        if (seq is not None and getattr(arr, "ndim", 0) >= 3
+                and arr.shape[1] % layout.mesh.shape[seq] == 0):
+            shard = layout.input_sharding(arr)
+        return layout.put(arr, shard)
     return layout.put(arr, layout.replicated())
 
 

@@ -110,6 +110,15 @@ def iter_results(paths):
                 continue
             if isinstance(parsed, dict) and parsed.get("metric"):
                 yield parsed
+                # secondary metrics riding the same result line (e.g. the
+                # shard bench's tp_headaware samples/sec) gate against
+                # their own BENCH_BASELINE.json anchors
+                aux = parsed.get("aux_metrics")
+                if isinstance(aux, dict):
+                    for name in sorted(aux):
+                        if isinstance(aux[name], (int, float)):
+                            yield {"metric": name, "value": aux[name],
+                                   "unit": parsed.get("unit")}
 
 
 def gate(results, baselines: dict, tolerance: float, refresh):

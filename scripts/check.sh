@@ -29,6 +29,8 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/testing/ \
     deeplearning4j_tpu/utils/subproc.py \
     deeplearning4j_tpu/parallel/layout.py \
+    deeplearning4j_tpu/parallel/roles.py \
+    deeplearning4j_tpu/parallel/ring_attention.py \
     deeplearning4j_tpu/analysis/shard_flow.py \
     deeplearning4j_tpu/tune/ \
     --fail-on warning
@@ -345,6 +347,48 @@ assert z1.opt_spec((1024, 1024)) == P("fsdp")
 fwd = check_network_shard_flow(net, 64, z1, train=False)
 assert fwd["census"] == [], fwd["census"]
 print("  ZeRO-1 forward collective-free, moments sharded / params replicated")
+
+# ISSUE 15: head-aware tp on an attention net. Training through admission
+# must leave dl4jtpu_ir_findings_total{rule="DT305"} at ZERO (the layer-
+# roles registry eliminated the per-step activation collectives the
+# generic tp spec pays), and the compiled census must hold parity.
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.telemetry import get_registry
+
+attn = MultiLayerNetwork(MultiLayerConfiguration(
+    layers=[SelfAttentionLayer(n_out=128, n_heads=4, activation="identity"),
+            RnnOutputLayer(n_in=128, n_out=16, activation="softmax",
+                           loss="mcxent")],
+    input_type=InputType.recurrent(64),
+    updater=UpdaterConfig(updater="adam", learning_rate=1e-3))).init()
+ha = MeshLayout(data=2, tp=2, roles=True)
+flow = check_network_shard_flow(attn, 8, ha, timesteps_probe=32)
+assert flow["findings"] == [], [f.format_human() for f in flow["findings"]]
+xa = rng.normal(size=(8, 32, 64)).astype(np.float32)
+ya = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (8, 32))]
+ParallelWrapper(attn, layout=ha).fit(DataSet(xa, ya))
+fam = get_registry().get("dl4jtpu_ir_findings_total")
+dt305 = 0
+if fam is not None:
+    dt305 = sum(child.value for key, child in fam._items()
+                if key and key[0] == "DT305")
+assert dt305 == 0, \
+    f'dl4jtpu_ir_findings_total{{rule="DT305"}} = {dt305} under roles=True'
+step = attn._build_train_step()
+hlo = step.lower(attn.params, attn.opt_state, attn.state,
+                 ha.put(xa, ha.input_sharding(xa)),
+                 ha.put(ya, ha.input_sharding(ya)),
+                 attn._rng, None, None).compile().as_text()
+res = compare_census(flow["census"], hlo_collective_census(hlo, ha))
+assert res["ok"], (res["problems"], flow["census"])
+tp_ar = [r for r in flow["census"]
+         if r["kind"] == "all_reduce" and r["axes"] == ["tp"]]
+assert sum(r["count"] for r in tp_ar) <= 2, flow["census"]
+print(f"  head-aware tp: DT305=0 through admission, census parity "
+      f"ratio {res['total_ratio']}, deferred tp all-reduces only")
 print("shard-flow self-scan OK")
 PY
 
@@ -946,6 +990,23 @@ for name, variant in d["variants"].items():
     assert match.get("ok"), (name, match.get("problems"), col)
     print(f"census parity gate OK [{name}]: predicted/measured byte ratio "
           f"{match['total_ratio']}")
+
+# ISSUE 15 acceptance: head-aware tp must beat generic tp on the same
+# attention net + mesh (the eliminated DT305 activation collectives ARE
+# the speedup) with zero warm recompiles, and only the head-aware variant
+# may be DT305-clean
+gen, head = d["variants"]["tp_generic"], d["variants"]["tp_headaware"]
+assert head["samples_per_sec"] >= gen["samples_per_sec"], (
+    f"tp_headaware {head['samples_per_sec']} < "
+    f"tp_generic {gen['samples_per_sec']} samples/sec")
+assert head["warm_compiles"] == 0, head["warm_compiles"]
+assert "DT305" in (gen["collectives"].get("findings") or []), \
+    "generic tp lost its DT305 advisory"
+assert "DT305" not in (head["collectives"].get("findings") or []), \
+    "head-aware tp still carries DT305"
+print(f"head-aware tp gate OK: {head['samples_per_sec']} vs generic "
+      f"{gen['samples_per_sec']} samples/sec "
+      f"({d['tp_headaware_vs_generic']}x), zero warm compiles")
 PY
 
 echo "== bench regression gate (autotune mode vs BENCH_BASELINE.json)"

@@ -112,6 +112,28 @@ def _write(tmp_path, name, obj):
     return str(p)
 
 
+def test_aux_metrics_gate_against_their_own_anchors(tmp_path):
+    """A result line's ``aux_metrics`` entries (e.g. the shard bench's
+    tp_headaware samples/sec) gate independently: a regression in the aux
+    metric fails the run even when the primary metric passes."""
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps({
+        "m": {"value": 100.0, "tolerance": 0.5},
+        "m_aux": {"value": 100.0, "tolerance": 0.5},
+    }))
+    good = _write(tmp_path, "good.json",
+                  _r("m", 100.0, unit="samples/sec",
+                     aux_metrics={"m_aux": 90.0}))
+    assert main([good, "--baseline", str(baseline)]) == 0
+    bad = _write(tmp_path, "bad.json",
+                 _r("m", 100.0, aux_metrics={"m_aux": 10.0}))
+    assert main([bad, "--baseline", str(baseline)]) == 1
+    # non-numeric aux values are ignored, not gated
+    odd = _write(tmp_path, "odd.json",
+                 _r("m", 100.0, aux_metrics={"m_aux": 90.0, "note": "x"}))
+    assert main([odd, "--baseline", str(baseline)]) == 0
+
+
 def test_main_gates_and_persists_anchor(tmp_path):
     baseline = tmp_path / "BASE.json"
     baseline.write_text(json.dumps(

@@ -1,0 +1,325 @@
+"""Head-aware tensor parallelism (ISSUE 15): the layer-roles registry
+(``parallel/roles.py``) resolves attention/LSTM sites to Megatron-style
+specs under ``MeshLayout(..., roles=True)``, and the ``seq`` mesh axis
+shards time through the shard_map ring-attention kernels.
+
+Four guarantees, each census-proven against the compiled HLO:
+
+- trajectory parity: head-aware tp (and the seq axis) change the
+  partitioning, never the math;
+- collective elimination: the DT305-named per-step activation gathers on
+  attention/LSTM-gate sites vanish — attention pays the ONE deferred
+  all-reduce per block, the LSTM scan body runs collective-free;
+- predicted-vs-measured census parity for every new canonical layout;
+- loud divisibility: a tp size that does not divide the head count (or
+  the LSTM row dim) is rejected naming the layer and dim.
+
+Runs on the suite's virtual CPU devices (conftest.py) — single-process
+GSPMD throughout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.analysis.shard_flow import (
+    check_network_shard_flow,
+    compare_census,
+    hlo_collective_census,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.models.char_rnn import char_rnn
+from deeplearning4j_tpu.nn.layers.attention import (
+    SelfAttentionLayer,
+    set_attention_mesh,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.parallel import (
+    MeshLayout,
+    ParallelWrapper,
+    RoleDivisibilityError,
+)
+
+B, T = 8, 32
+
+
+def _devices(n=4):
+    return jax.devices()[:n]
+
+
+def _attn_conf(features=64, d=128, heads=4, classes=16, updater="adam",
+               lr=1e-3, seed=5):
+    return MultiLayerConfiguration(
+        layers=[
+            SelfAttentionLayer(n_out=d, n_heads=heads,
+                               activation="identity"),
+            RnnOutputLayer(n_in=d, n_out=classes, activation="softmax",
+                           loss="mcxent"),
+        ],
+        input_type=InputType.recurrent(features),
+        updater=UpdaterConfig(updater=updater, learning_rate=lr),
+        seed=seed,
+    )
+
+
+def _attn_data(seed=0, features=64, classes=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(B, T, features)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, (B, T))]
+    return x, y
+
+
+def _char_data(vocab, seed=1):
+    rng = np.random.default_rng(seed)
+    x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
+    y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
+    return x, y
+
+
+def _f32(net):
+    """The suite may run x64; the census fixtures pin f32 so predicted and
+    measured byte counts use the same element width."""
+    def cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(jnp.float32)
+        return a
+    net.params = jax.tree_util.tree_map(cast, net.params)
+    net.opt_state = jax.tree_util.tree_map(cast, net.opt_state)
+    return net
+
+
+def _measured_census(net, lo, x, y):
+    x_d = lo.put(x, lo.input_sharding(x))
+    y_d = lo.put(y, lo.input_sharding(y))
+    step = net._build_train_step()
+    hlo = step.lower(net.params, net.opt_state, net.state, x_d, y_d,
+                     net._rng, None, None).compile().as_text()
+    return hlo_collective_census(hlo, lo)
+
+
+def _final_params(net):
+    return [np.asarray(l, np.float64)
+            for l in jax.tree_util.tree_leaves(net.params)]
+
+
+class TestTrajectoryParity:
+    def test_attention_headaware_tp_matches_replicated(self):
+        """Head-aware tp on the attention net follows the single-device
+        trajectory within reduction-order tolerance."""
+        x, y = _attn_data()
+        layouts = {
+            "ref": MeshLayout(data=1, devices=_devices(1)),
+            "tp_roles": MeshLayout(data=2, tp=2, roles=True,
+                                   devices=_devices()),
+        }
+        finals = {}
+        for name, lo in layouts.items():
+            net = MultiLayerNetwork(
+                _attn_conf(updater="sgd", lr=0.1)).init()
+            w = ParallelWrapper(net, layout=lo)
+            for _ in range(6):
+                w.fit(DataSet(x, y))
+            finals[name] = _final_params(net)
+        for a, b in zip(finals["ref"], finals["tp_roles"]):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    def test_charrnn_headaware_tp_matches_replicated(self):
+        """lstm_gates row-parallel W + replicated recurrence reproduce the
+        single-device charrnn trajectory."""
+        V, H = 60, 64
+        x, y = _char_data(V)
+        layouts = {
+            "ref": MeshLayout(data=1, devices=_devices(1)),
+            "tp_roles": MeshLayout(data=2, tp=2, roles=True,
+                                   devices=_devices()),
+        }
+        finals = {}
+        for name, lo in layouts.items():
+            net = MultiLayerNetwork(
+                char_rnn(V, hidden_size=H, num_layers=1)).init()
+            w = ParallelWrapper(net, layout=lo)
+            for _ in range(4):
+                w.fit(DataSet(x, y))
+            finals[name] = _final_params(net)
+        for a, b in zip(finals["ref"], finals["tp_roles"]):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    def test_seq_axis_parity_time_bucketed(self):
+        """The seq axis (ring attention, time dim sharded) follows the
+        single-device trajectory on a time-bucketed batch — every sequence
+        padded to the same T bucket, the shard_map splitting T evenly."""
+        x, y = _attn_data(seed=7)
+        try:
+            finals = {}
+            for name, lo in {
+                "ref": MeshLayout(data=1, devices=_devices(1)),
+                "seq": MeshLayout(data=2, seq=2, roles=True,
+                                  devices=_devices()),
+            }.items():
+                net = MultiLayerNetwork(
+                    _attn_conf(updater="sgd", lr=0.1)).init()
+                w = ParallelWrapper(net, layout=lo)
+                for _ in range(4):
+                    w.fit(DataSet(x, y))
+                finals[name] = _final_params(net)
+            for a, b in zip(finals["ref"], finals["seq"]):
+                np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+        finally:
+            set_attention_mesh(None)
+
+
+class TestCensusParity:
+    def test_attention_headaware_census(self):
+        """roles=True on the attention net: no DT305, and the predicted
+        census stays at byte parity with the compiled HLO — the block pays
+        its tp traffic as the ONE deferred all-reduce pattern, not per-site
+        activation gathers."""
+        net = MultiLayerNetwork(_attn_conf()).init()
+        _f32(net)
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        flow = check_network_shard_flow(net, B, lo, timesteps_probe=T)
+        assert sorted({f.rule_id for f in flow["findings"]}) == []
+        x, y = _attn_data()
+        lo.apply(net)
+        _f32(net)
+        r = compare_census(flow["census"], _measured_census(net, lo, x, y))
+        assert r["ok"], r["problems"]
+        # the Megatron pattern: a HANDFUL of tp all-reduces (fwd out-proj +
+        # bwd QKV), not one per site per step
+        tp_reduces = sum(e["count"] for e in flow["census"]
+                         if e["kind"] == "all_reduce"
+                         and e["axes"] == ["tp"])
+        assert tp_reduces <= 2, flow["census"]
+
+    def test_charrnn_headaware_census(self):
+        """lstm_gates: the hoisted x@W all-reduce is the ONLY tp collective
+        — the scan body runs collective-free (no DT304 in-loop gathers)."""
+        V, H = 60, 64
+        net = MultiLayerNetwork(char_rnn(V, hidden_size=H,
+                                         num_layers=1)).init()
+        _f32(net)
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        flow = check_network_shard_flow(net, B, lo, timesteps_probe=T)
+        assert sorted({f.rule_id for f in flow["findings"]}) == []
+        tp_events = [e for e in flow["census"] if e["axes"] == ["tp"]]
+        assert len(tp_events) == 1 and tp_events[0]["kind"] == "all_reduce"
+        x, y = _char_data(V)
+        lo.apply(net)
+        _f32(net)
+        r = compare_census(flow["census"], _measured_census(net, lo, x, y))
+        assert r["ok"], r["problems"]
+
+    def test_seq_axis_census(self):
+        """The seq layout's predicted census models the shard_map ring —
+        collective_permute hops attributed to the seq axis — and stays at
+        parity with the measured HLO."""
+        net = MultiLayerNetwork(_attn_conf()).init()
+        _f32(net)
+        lo = MeshLayout(data=2, seq=2, roles=True, devices=_devices())
+        try:
+            flow = check_network_shard_flow(net, B, lo, timesteps_probe=T)
+            assert sorted({f.rule_id for f in flow["findings"]}) == []
+            permutes = [e for e in flow["census"]
+                        if e["kind"] == "collective_permute"]
+            assert permutes and all(e["axes"] == ["seq"] for e in permutes)
+            x, y = _attn_data()
+            lo.apply(net)
+            _f32(net)
+            m = _measured_census(net, lo, x, y)
+            assert any(e["kind"] == "collective_permute" for e in m)
+            r = compare_census(flow["census"], m)
+            assert r["ok"], r["problems"]
+        finally:
+            set_attention_mesh(None)
+
+
+class TestDT305Registry:
+    def test_generic_tp_fires_dt305_naming_registry_api(self):
+        """A still-generic attention site under tp names the fix: the
+        layer-roles registry, not a hand-written spec."""
+        net = MultiLayerNetwork(_attn_conf()).init()
+        lo = MeshLayout(data=2, tp=2, devices=_devices())
+        flow = check_network_shard_flow(net, B, lo, timesteps_probe=T)
+        dt305 = [f for f in flow["findings"] if f.rule_id == "DT305"]
+        assert dt305
+        msg = dt305[0].message
+        assert "MeshLayout" in msg and "roles=True" in msg
+        assert "register_layer_role" in msg
+        assert "docs/distributed.md" in msg
+
+    def test_role_resolved_site_exempt(self):
+        """The SAME net under roles=True resolves through attention_qkv /
+        attention_out and DT305 must NOT fire."""
+        net = MultiLayerNetwork(_attn_conf()).init()
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        flow = check_network_shard_flow(net, B, lo, timesteps_probe=T)
+        assert not [f for f in flow["findings"] if f.rule_id == "DT305"]
+
+
+class TestDivisibility:
+    def test_bind_rejects_tp_not_dividing_heads(self):
+        conf = _attn_conf(d=96, heads=3)
+        net = MultiLayerNetwork(conf).init()
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        with pytest.raises(RoleDivisibilityError,
+                           match=r"does not divide n_heads=3"):
+            lo.bind(net)
+
+    def test_validate_reports_dt008_naming_layer_and_dim(self):
+        net = MultiLayerNetwork(_attn_conf(d=96, heads=3)).init()
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        findings = lo.validate(net.params, net=net)
+        assert findings and findings[0].rule_id == "DT008"
+        assert "n_heads=3" in findings[0].message
+
+    def test_lstm_gate_input_dim_checked(self):
+        """tp must divide the lstm_gates input (row) dim of W — the 4H gate
+        block stays device-local."""
+        V, H = 61, 64  # odd vocab: 2 does not divide W's input dim
+        net = MultiLayerNetwork(char_rnn(V, hidden_size=H,
+                                         num_layers=1)).init()
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        lo.bind(net)  # head-count rule passes; the shape check is per-site
+        with pytest.raises(RoleDivisibilityError,
+                           match=r"does not divide the input dim"):
+            lo.param_specs(net.params)
+        # ...and validate() reports the same as a DT008 finding
+        findings = lo.validate(net.params, net=net)
+        assert findings and findings[0].rule_id == "DT008"
+        assert "does not divide the input dim" in findings[0].message
+
+
+class TestZeroWarmCompiles:
+    def _fit_twice_then_count(self, conf, lo):
+        from deeplearning4j_tpu.runtime.compile_manager import (
+            get_compile_manager,
+        )
+
+        net = MultiLayerNetwork(conf).init()
+        w = ParallelWrapper(net, layout=lo)
+        x, y = _attn_data()
+        cm = get_compile_manager()
+        w.fit(DataSet(x, y))  # warm-up: pays the compile
+        w.fit(DataSet(x, y))
+        before = cm.compiles.value
+        w.fit(DataSet(x, y))
+        w.fit(DataSet(x, y))
+        return cm.compiles.value - before
+
+    def test_headaware_tp_layout_zero_warm_compiles(self):
+        lo = MeshLayout(data=2, tp=2, roles=True, devices=_devices())
+        assert self._fit_twice_then_count(_attn_conf(), lo) == 0
+
+    def test_seq_layout_zero_warm_compiles(self):
+        try:
+            lo = MeshLayout(data=2, seq=2, roles=True, devices=_devices())
+            assert self._fit_twice_then_count(_attn_conf(), lo) == 0
+        finally:
+            set_attention_mesh(None)

@@ -250,23 +250,44 @@ class TestDT300Family:
         assert "DT304" not in {f.rule_id for f in rep["findings"]}
 
     def test_dt304_hoists_loop_invariant_const_gathers(self):
-        # a tp-sharded WEIGHT consumed one-sided inside scan is loop
-        # invariant: its gather hoists out of the loop and counts ONCE
+        # a tp-sharded WEIGHT whose contraction shard CONFLICTS with an
+        # activation kept-dim shard inside scan is loop invariant: its
+        # gather hoists out of the loop and counts ONCE (xs carries tp on
+        # the batch dim, so the kept claim forces the param gather)
         lo = self._lo(data=1, tp=4)
 
         def f(c, xs, w):
             def body(c, x):
-                return c + (x @ w).sum(), None  # w: one-sided contraction
+                return c + (x @ w).sum(), None
             return jax.lax.scan(body, c, xs)
 
         rep = analyze_shard_flow(
             f, (jax.ShapeDtypeStruct((), jnp.float32),
                 jax.ShapeDtypeStruct((16, 8, 512), jnp.float32),
                 jax.ShapeDtypeStruct((512, 512), jnp.float32)),
-            (P(), P(), P("tp", None)), lo, param_argnums=(2,))
+            (P(), P(None, "tp"), P("tp", None)), lo, param_argnums=(2,))
         gathers = [r for r in rep["census"] if r["kind"] == "all_gather"]
         assert gathers and all(r["count"] == 1 for r in gathers)
         assert "DT304" not in {f.rule_id for f in rep["findings"]}
+
+    def test_one_sided_contraction_keeps_partial_sums(self):
+        # w sharded on the contraction dim with the activation (and result)
+        # never touching tp: GSPMD slices the activation locally and keeps
+        # partial sums — NO gather, ONE deferred all-reduce (the
+        # row-parallel Megatron pattern the lstm_gates/ffn_down roles use)
+        lo = self._lo(data=1, tp=4)
+
+        def f(x, w):
+            return jnp.tanh(x @ w)  # tanh forces the deferred all-reduce
+
+        rep = analyze_shard_flow(
+            f, (jax.ShapeDtypeStruct((8, 512), jnp.float32),
+                jax.ShapeDtypeStruct((512, 512), jnp.float32)),
+            (P(), P("tp", None)), lo, param_argnums=(1,))
+        kinds = {r["kind"] for r in rep["census"]}
+        assert "all_gather" not in kinds
+        reduces = [r for r in rep["census"] if r["kind"] == "all_reduce"]
+        assert reduces and any("tp" in r["axes"] for r in reduces)
 
     def test_dt305_fires_on_lstm_under_tp(self):
         net = MultiLayerNetwork(char_rnn(vocab_size=64, hidden_size=128,
@@ -474,7 +495,7 @@ class TestCommunicationRoofline:
 class TestAbstractLayoutAndCli:
     def test_abstract_layout_spec_algebra(self):
         lo = MeshLayout.abstract(data=8, fsdp=4, tp=2)
-        assert lo.axis_sizes == {"data": 8, "fsdp": 4, "tp": 2}
+        assert lo.axis_sizes == {"data": 8, "fsdp": 4, "tp": 2, "seq": 1}
         assert lo.num_devices == 64
         assert lo.param_spec((128, 256)) == P("fsdp", "tp")
         assert lo.batch_spec() == P(("data", "fsdp"))
