@@ -32,7 +32,22 @@ Two passes, run before anything compiles:
   CLI ``--ir --mesh data=8,fsdp=4,tp=2``, and admission for any program
   compiled with mesh-sharded args.
 
-Each finding carries a rule id (``DT0xx``/``DT1xx``/``DT2xx``), severity,
+- **Runtime-guard pass** (`concurrency` + `runtime_checks`, DT4xx):
+  concurrency/env/telemetry lint for the threaded serving/fleet/online
+  stack. Thread-entry discovery (``Thread(target=...)``, HTTP ``do_*``
+  handlers, watchdog/batcher sinks, public methods of lock-owning
+  classes) feeds a per-class attribute census with ``with self._lock``
+  context tracking: shared attributes raced across entries (DT400),
+  blocking calls under a lock (DT401), lock-order inversions (DT402),
+  raw ``os.environ`` writes outside ``tune.EnvScope`` (DT403), bare
+  ``time.sleep`` outside ``runtime.resilience`` (DT404), trace-unsafe
+  global mutation from handler threads (DT405), and ``dl4jtpu_*``
+  metric / flight-event schema drift (DT406). Entry points:
+  ``check_runtime_paths``, ``conf.analyze(concurrency=True)``, CLI
+  ``--concurrency``, and the check.sh self-scan of serving/fleet/
+  runtime/telemetry/streaming.
+
+Each finding carries a rule id (``DT0xx``-``DT4xx``), severity,
 location and fix hint; rules live in a registry (`rules`) so later PRs add
 checks cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress AST
 findings (`pragmas`); IR findings (no source line) suppress via
@@ -58,6 +73,14 @@ from .ir_checks import (
     check_jaxpr_ir,
     check_network_ir,
     check_padding_waste,
+)
+from .concurrency import check_concurrency_file, check_concurrency_source
+from .runtime_checks import (
+    TelemetrySchema,
+    check_runtime_file,
+    check_runtime_package,
+    check_runtime_paths,
+    check_runtime_source,
 )
 from .shard_flow import (
     analyze_shard_flow,
@@ -96,4 +119,11 @@ __all__ = [
     "check_network_shard_flow",
     "compare_census",
     "hlo_collective_census",
+    "TelemetrySchema",
+    "check_concurrency_file",
+    "check_concurrency_source",
+    "check_runtime_file",
+    "check_runtime_package",
+    "check_runtime_paths",
+    "check_runtime_source",
 ]

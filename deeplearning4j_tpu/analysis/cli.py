@@ -1,6 +1,9 @@
 """CLI: ``python -m deeplearning4j_tpu.analysis [paths...]``.
 
-- ``.py`` files (and directories, walked recursively) get the AST pass.
+- ``.py`` files (and directories, walked recursively) get the AST pass —
+  or, with ``--concurrency``, the DT4xx runtime-guard tier (thread-entry
+  discovery + lock census, env hygiene, telemetry schema aggregated
+  across every given path).
 - ``.json`` files are parsed as serialized configs (``to_json`` output of
   MultiLayerConfiguration / ComputationGraphConfiguration) and get the
   graph pass — plus the jaxpr-level DT2xx IR pass with ``--ir`` (the config
@@ -133,6 +136,11 @@ def main(argv=None) -> int:
                     "--mesh data=2,fsdp=4,tp=2,bf16,zero1 — predicts the "
                     "collective census + communication roofline with no "
                     "devices attached")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the DT4xx runtime-guard tier on .py inputs "
+                    "(thread-entry/lock census, env hygiene, telemetry "
+                    "schema) instead of the DT1xx JAX-pitfall pass; the "
+                    "telemetry schema aggregates across ALL given paths")
     ap.add_argument("--ignore", default="",
                     help="comma-separated rule ids to drop from the report "
                     "(e.g. DT204,DT206 — the suppression mechanism for IR "
@@ -167,6 +175,7 @@ def main(argv=None) -> int:
     findings: List[Finding] = []
     costs: list = []
     n_files = 0
+    schema = None  # one DT406 schema across every --concurrency path
     for path in args.paths:
         if not os.path.exists(path):
             print(f"error: no such path: {path}", file=sys.stderr)
@@ -181,12 +190,24 @@ def main(argv=None) -> int:
                 print(f"error: could not analyze config {path}: {e}",
                       file=sys.stderr)
                 return 2
+        elif args.concurrency:
+            from .runtime_checks import TelemetrySchema, check_runtime_source
+
+            if schema is None:
+                schema = TelemetrySchema()
+            for py in _iter_py_files(path):
+                n_files += 1
+                with open(py, "r", encoding="utf-8") as fh:
+                    findings += check_runtime_source(fh.read(), filename=py,
+                                                     schema=schema)
         else:
             from .ast_checks import check_file
 
             for py in _iter_py_files(path):
                 n_files += 1
                 findings += check_file(py)
+    if schema is not None:
+        findings += schema.findings()
 
     findings = merge_findings(f for f in findings
                               if f.rule_id not in ignored)

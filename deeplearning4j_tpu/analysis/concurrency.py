@@ -1,0 +1,812 @@
+"""Pass 5a: concurrency lint over the threaded runtime stack (DT400-DT402,
+DT405).
+
+Thread-entry discovery first: a function is an *entry point* when it is
+
+- a ``threading.Thread(target=...)`` / ``Timer`` / executor ``submit``
+  target (non-reentrant: one thread per start),
+- a callback/sink handed to another component (``on_*=``, ``sink=``,
+  ``callback=`` kwargs, ``add_sink(...)`` args, or a ``*_sink`` method —
+  reentrant: the owner may invoke it from several threads),
+- a ``do_*`` method of an ``http.server`` request-handler class
+  (reentrant: ThreadingHTTPServer runs one thread per request), or
+- a public method of a class that owns a lock or spawns threads
+  (reentrant: any thread may call into it).
+
+Entries close transitively over same-module calls (``self._helper()``,
+bare functions, uniquely-named methods), so a helper's accesses belong to
+every entry that reaches it. A per-class attribute census from
+``__init__`` classifies attributes (lock / sync primitive / queue /
+container / scalar; ``Condition(self._lock)`` aliases to the wrapped
+lock), and a lock-context walk over each function records which locks are
+held at every attribute access — that census powers:
+
+- **DT400** — attribute written from one entry and touched from another
+  with no common lock, or read-modified-written lock-free inside a
+  reentrant entry. Plain scalar assignment/read is treated as an atomic
+  publish and stays clean; container iteration/mutation does not.
+- **DT401** — blocking call (sleep, HTTP, subprocess, unbounded
+  ``queue.get``, ``Future.result``, device fetch/compile, ``join``)
+  while holding a lock. ``cond.wait()`` on the lock being held is exempt
+  (it releases the lock).
+- **DT402** — two locks nested in opposite orders on different paths.
+- **DT405** — trace-unsafe global mutation (``jax.config`` updates,
+  ``set_site_override``, ``global`` rebinds) reachable from an entry.
+
+All findings are line-anchored, so ``# dl4jtpu: ignore[DT4xx]`` pragmas
+apply as in pass 2.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
+
+from .ast_checks import _full_name, _last
+from .findings import Finding, sort_findings
+from .pragmas import filter_findings
+from .rules import get_rule
+
+__all__ = ["check_concurrency_source", "check_concurrency_file"]
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_SYNC_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier",
+}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_CONTAINER_CTORS = {
+    "deque", "list", "dict", "set", "OrderedDict", "defaultdict", "Counter",
+}
+_CONTAINER_LITERALS = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "add", "pop", "popleft",
+    "clear", "update", "setdefault", "remove", "discard", "insert",
+    "popitem",
+}
+_ITERATING = {
+    "list", "tuple", "set", "dict", "frozenset", "sorted", "sum", "max",
+    "min", "len", "any", "all", "extend", "percentile", "mean", "median",
+    "asarray", "array",
+}
+_THREAD_CTORS = {"Thread", "Timer"}
+_CALLBACK_KWARGS = {"sink", "sinks", "callback", "callbacks", "target"}
+_SINK_REGISTRARS = {"add_sink", "register_sink", "add_callback",
+                    "add_done_callback"}
+_HANDLER_BASES = {
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "StreamRequestHandler", "BaseRequestHandler",
+}
+# method names too generic to resolve on a non-self receiver (they are
+# almost always dict/list/thread-primitive methods, not module methods)
+_GENERIC_METHODS = {
+    "get", "pop", "update", "clear", "items", "keys", "values", "append",
+    "extend", "add", "remove", "discard", "insert", "put", "read", "write",
+    "copy", "count", "index", "sort", "reverse", "setdefault", "popitem",
+    "join", "split", "strip", "format", "encode", "decode", "wait",
+    "notify", "notify_all", "acquire", "release", "set", "is_set",
+    "qsize", "empty", "full", "get_nowait", "put_nowait", "close", "flush",
+}
+_BLOCKING_LASTS = {
+    "urlopen", "communicate", "block_until_ready", "device_get",
+    "rnn_time_step", "fit_on_device", "readline", "accept", "recv",
+    "connect", "create_connection", "wait_event", "pace", "aot", "result",
+}
+_REQUESTS_VERBS = {"get", "post", "put", "delete", "head", "request"}
+_SUBPROCESS_BLOCKING = {"run", "call", "check_output", "check_call"}
+
+LockId = Tuple[str, str]  # (owner class or "<module>", canonical attr/name)
+
+
+class _ClassCensus:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: Dict[str, str] = {}  # attr -> canonical lock attr
+        self.sync_attrs: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.container_attrs: Set[str] = set()
+        self.scalar_attrs: Set[str] = set()
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.is_handler = any(
+            _last(_full_name(base)) in _HANDLER_BASES for base in node.bases)
+        self.spawns_threads = False
+
+    def data_kind(self, attr: str) -> Optional[str]:
+        if attr in self.container_attrs:
+            return "container"
+        if attr in self.scalar_attrs:
+            return "scalar"
+        return None
+
+    def owns(self, attr: str) -> bool:
+        return (attr in self.lock_attrs or attr in self.sync_attrs
+                or attr in self.queue_attrs or attr in self.container_attrs
+                or attr in self.scalar_attrs)
+
+
+class _Access(NamedTuple):
+    cls: str
+    attr: str
+    kind: str  # "write" | "read"
+    rmw: bool
+    locks: FrozenSet[LockId]
+    line: int
+    col: int
+
+
+class _Blocking(NamedTuple):
+    desc: str
+    lock: str
+    line: int
+    col: int
+
+
+class _Mutation(NamedTuple):  # DT405 candidate
+    desc: str
+    line: int
+    col: int
+
+
+def _classify_init_value(value: ast.AST) -> Tuple[str, Optional[str]]:
+    """('lock'|'sync'|'queue'|'container'|'scalar', condition-alias)."""
+    if isinstance(value, ast.Call):
+        ctor = _last(_full_name(value.func))
+        if ctor in _LOCK_CTORS:
+            return "lock", None
+        if ctor == "Condition":
+            alias = None
+            if value.args:
+                wrapped = _full_name(value.args[0])
+                if wrapped.startswith("self."):
+                    alias = wrapped.split(".", 1)[1]
+            return "lock", alias
+        if ctor in _QUEUE_CTORS:
+            return "queue", None
+        if ctor in _SYNC_CTORS:
+            return "sync", None
+        if ctor in _CONTAINER_CTORS:
+            return "container", None
+        return "scalar", None
+    if isinstance(value, _CONTAINER_LITERALS):
+        return "container", None
+    return "scalar", None
+
+
+class _Module:
+    """Census + entry discovery + call graph for one parsed module."""
+
+    def __init__(self, tree: ast.Module, filename: str):
+        self.tree = tree
+        self.filename = filename
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.functions: List[ast.FunctionDef] = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for fn in self.functions:
+            self.by_name.setdefault(fn.name, []).append(fn)
+        self.classes: Dict[str, _ClassCensus] = {}
+        self.module_locks: Set[str] = set()
+        self.imports: Set[str] = set()
+        self._build_census()
+        # attr -> owning class (unique across module; ambiguous names drop)
+        self.data_owner: Dict[str, _ClassCensus] = {}
+        self.lock_owner: Dict[str, _ClassCensus] = {}
+        self.queue_owner: Dict[str, _ClassCensus] = {}
+        self._build_owner_maps()
+        self.entries: Dict[ast.FunctionDef, Set[str]] = {}
+        self._discover_entries()
+        self.edges: Dict[ast.FunctionDef, Set[ast.FunctionDef]] = {}
+        self._build_call_graph()
+
+    # -- census ------------------------------------------------------------
+    def _build_census(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.imports.add((alias.asname or alias.name).split(".")[0])
+            if isinstance(node, ast.ClassDef):
+                census = _ClassCensus(node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        census.methods[item.name] = item
+                init = census.methods.get("__init__")
+                if init is not None:
+                    self._census_init(census, init)
+                self.classes[node.name] = census
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value,
+                                                           ast.Call):
+                ctor = _last(_full_name(stmt.value.func))
+                if ctor in _LOCK_CTORS | {"Condition"}:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+
+    def _census_init(self, census: _ClassCensus,
+                     init: ast.FunctionDef) -> None:
+        for node in ast.walk(init):
+            target = value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if (not isinstance(target, ast.Attribute)
+                    or _full_name(target.value) != "self"):
+                continue
+            attr = target.attr
+            if census.owns(attr):
+                continue
+            kind, alias = _classify_init_value(value)
+            if kind == "lock":
+                canonical = attr
+                if alias is not None:
+                    canonical = census.lock_attrs.get(alias, alias)
+                census.lock_attrs[attr] = canonical
+            elif kind == "sync":
+                census.sync_attrs.add(attr)
+            elif kind == "queue":
+                census.queue_attrs.add(attr)
+            elif kind == "container":
+                census.container_attrs.add(attr)
+            else:
+                census.scalar_attrs.add(attr)
+
+    def _build_owner_maps(self) -> None:
+        seen: Dict[str, int] = {}
+        for census in self.classes.values():
+            for attr in (census.container_attrs | census.scalar_attrs
+                         | set(census.lock_attrs) | census.sync_attrs
+                         | census.queue_attrs):
+                seen[attr] = seen.get(attr, 0) + 1
+        for census in self.classes.values():
+            for attr in census.container_attrs | census.scalar_attrs:
+                if seen[attr] == 1:
+                    self.data_owner[attr] = census
+            for attr in census.lock_attrs:
+                if seen[attr] == 1:
+                    self.lock_owner[attr] = census
+            for attr in census.queue_attrs:
+                if seen[attr] == 1:
+                    self.queue_owner[attr] = census
+
+    # -- structural lookups ------------------------------------------------
+    def enclosing_class(self, node: ast.AST) -> Optional[_ClassCensus]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return self.classes.get(cur.name)
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.FunctionDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            if isinstance(cur, ast.ClassDef):
+                return None
+            cur = self.parents.get(cur)
+        return None
+
+    def display(self, fn: ast.FunctionDef) -> str:
+        cls = self.enclosing_class(fn)
+        return f"{cls.name}.{fn.name}" if cls else fn.name
+
+    # -- entry discovery ---------------------------------------------------
+    def _resolve_callable(self, expr: ast.AST,
+                          site: ast.AST) -> List[ast.FunctionDef]:
+        if isinstance(expr, ast.Lambda):
+            out: List[ast.FunctionDef] = []
+            for call in ast.walk(expr.body):
+                if isinstance(call, ast.Call):
+                    out.extend(self._resolve_callable(call.func, site))
+            return out
+        name = _full_name(expr)
+        if not name:
+            return []
+        if name.startswith("self."):
+            parts = name.split(".")
+            cls = self.enclosing_class(site)
+            if len(parts) == 2 and cls and parts[1] in cls.methods:
+                return [cls.methods[parts[1]]]
+            return []
+        if "." in name:
+            return []
+        return list(self.by_name.get(name, []))
+
+    def _mark(self, fns: List[ast.FunctionDef], kind: str) -> None:
+        for fn in fns:
+            self.entries.setdefault(fn, set()).add(kind)
+
+    def _discover_entries(self) -> None:
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = _full_name(call.func)
+            ctor = _last(fname)
+            if ctor in _THREAD_CTORS:
+                cls = self.enclosing_class(call)
+                if cls is not None:
+                    cls.spawns_threads = True
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        self._mark(self._resolve_callable(kw.value, call),
+                                   "thread")
+                if ctor == "Timer" and len(call.args) >= 2:
+                    self._mark(self._resolve_callable(call.args[1], call),
+                               "thread")
+                continue
+            if ctor == "submit" and call.args:
+                self._mark(self._resolve_callable(call.args[0], call),
+                           "thread")
+            if ctor in _SINK_REGISTRARS:
+                for arg in call.args:
+                    self._mark(self._resolve_callable(arg, call), "callback")
+            for kw in call.keywords:
+                if kw.arg and (kw.arg.startswith("on_")
+                               or kw.arg in _CALLBACK_KWARGS):
+                    self._mark(self._resolve_callable(kw.value, call),
+                               "callback")
+        for census in self.classes.values():
+            for mname, fn in census.methods.items():
+                if mname.endswith("_sink") or mname == "sink":
+                    self._mark([fn], "callback")
+                if census.is_handler and mname.startswith("do_"):
+                    self._mark([fn], "handler")
+        for census in self.classes.values():
+            qualifies = (bool(census.lock_attrs) or census.spawns_threads
+                         or any(fn in self.entries
+                                for fn in census.methods.values()))
+            if not qualifies:
+                continue
+            for mname, fn in census.methods.items():
+                if mname.startswith("_") or fn in self.entries:
+                    continue
+                self.entries.setdefault(fn, set()).add("public")
+
+    def reentrant(self, fn: ast.FunctionDef) -> bool:
+        return any(k != "thread" for k in self.entries.get(fn, ()))
+
+    # -- call graph --------------------------------------------------------
+    def _build_call_graph(self) -> None:
+        method_owner: Dict[str, List[ast.FunctionDef]] = {}
+        for census in self.classes.values():
+            for mname, fn in census.methods.items():
+                method_owner.setdefault(mname, []).append(fn)
+        for fn in self.functions:
+            targets: Set[ast.FunctionDef] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not fn \
+                        and self.enclosing_function(node) is fn \
+                        and node not in self.entries:
+                    targets.add(node)  # nested helper runs on this thread
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = _full_name(node.func)
+                if not fname:
+                    continue
+                if fname.startswith("self."):
+                    parts = fname.split(".")
+                    cls = self.enclosing_class(fn)
+                    if len(parts) == 2 and cls and parts[1] in cls.methods:
+                        targets.add(cls.methods[parts[1]])
+                        continue
+                if "." in fname:
+                    mname = _last(fname)
+                    head = fname.split(".")[0]
+                    if (mname not in _GENERIC_METHODS
+                            and head not in self.imports
+                            and len(method_owner.get(mname, ())) >= 1):
+                        targets.update(method_owner.get(mname, ()))
+                elif fname in self.by_name and fname not in self.imports:
+                    targets.update(self.by_name[fname])
+            self.edges[fn] = targets
+
+    def reaching_entries(self) -> Dict[ast.FunctionDef,
+                                       List[ast.FunctionDef]]:
+        reach: Dict[ast.FunctionDef, List[ast.FunctionDef]] = {}
+        for entry in self.entries:
+            stack, seen = [entry], {entry}
+            while stack:
+                fn = stack.pop()
+                reach.setdefault(fn, []).append(entry)
+                for nxt in self.edges.get(fn, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+        return reach
+
+
+class _FunctionScan:
+    """Lock-context walk of one function body: attribute accesses, blocking
+    calls under locks, nested lock-acquisition edges, DT405 candidates."""
+
+    def __init__(self, module: _Module, fn: ast.FunctionDef):
+        self.module = module
+        self.fn = fn
+        self.cls = module.enclosing_class(fn)
+        self.accesses: List[_Access] = []
+        self.blocking: List[_Blocking] = []
+        self.acq_edges: List[Tuple[LockId, LockId, int, int]] = []
+        self.mutations: List[_Mutation] = []
+        self.globals: Set[str] = set()
+        self._walk_stmts(fn.body, frozenset())
+
+    # -- resolution --------------------------------------------------------
+    def _resolve_data(self, node: ast.AST) -> Optional[Tuple[str, str, str]]:
+        """(class, attr, 'container'|'scalar') for a census'd attribute."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        base = _full_name(node.value)
+        attr = node.attr
+        if base == "self" and self.cls is not None:
+            kind = self.cls.data_kind(attr)
+            if kind:
+                return (self.cls.name, attr, kind)
+            return None
+        if not base or base.split(".")[0] in self.module.imports:
+            return None
+        owner = self.module.data_owner.get(attr)
+        if owner is not None:
+            return (owner.name, attr, owner.data_kind(attr))
+        return None
+
+    def _lock_id(self, expr: ast.AST) -> Optional[LockId]:
+        name = _full_name(expr)
+        if not name:
+            return None
+        if "." not in name:
+            if name in self.module.module_locks:
+                return ("<module>", name)
+            return None
+        base, attr = name.rsplit(".", 1)
+        if base == "self" and self.cls and attr in self.cls.lock_attrs:
+            return (self.cls.name, self.cls.lock_attrs[attr])
+        owner = self.module.lock_owner.get(attr)
+        if owner is not None and base.split(".")[0] not in self.module.imports:
+            return (owner.name, owner.lock_attrs[attr])
+        return None
+
+    def _is_queue_attr(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Attribute):
+            return False
+        base = _full_name(node.value)
+        attr = node.attr
+        if base == "self" and self.cls is not None:
+            return attr in self.cls.queue_attrs
+        return attr in self.module.queue_owner
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, resolved, kind: str, rmw: bool, node: ast.AST,
+                held: FrozenSet[LockId]) -> None:
+        cls, attr, _ = resolved
+        self.accesses.append(_Access(cls, attr, kind, rmw, held,
+                                     node.lineno, node.col_offset))
+
+    # -- statement walk ----------------------------------------------------
+    def _walk_stmts(self, stmts, held: FrozenSet[LockId]) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: FrozenSet[LockId]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # scanned as their own functions / class bodies
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[LockId] = []
+            for item in stmt.items:
+                lid = self._lock_id(item.context_expr)
+                if lid is not None:
+                    for outer in held | frozenset(acquired):
+                        if outer != lid:
+                            self.acq_edges.append(
+                                (outer, lid, stmt.lineno, stmt.col_offset))
+                    acquired.append(lid)
+                else:
+                    self._scan_expr(item.context_expr, held)
+            self._walk_stmts(stmt.body, held | frozenset(acquired))
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            resolved = self._resolve_data(stmt.iter)
+            if resolved and resolved[2] == "container":
+                self._record(resolved, "read", False, stmt.iter, held)
+            self._scan_expr(stmt.iter, held)
+            self._walk_stmts(stmt.body, held)
+            self._walk_stmts(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, held)
+            self._walk_stmts(stmt.body, held)
+            self._walk_stmts(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_stmts(stmt.body, held)
+            for handler in stmt.handlers:
+                self._walk_stmts(handler.body, held)
+            self._walk_stmts(stmt.orelse, held)
+            self._walk_stmts(stmt.finalbody, held)
+            return
+        if isinstance(stmt, ast.Global):
+            self.globals.update(stmt.names)
+            return
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                self._scan_target(target, held)
+            self._scan_expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_target(stmt.target, held)
+                self._scan_expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            resolved = self._resolve_data(stmt.target)
+            if resolved:
+                self._record(resolved, "write", True, stmt, held)
+            elif isinstance(stmt.target, ast.Subscript):
+                inner = self._resolve_data(stmt.target.value)
+                if inner:
+                    self._record(inner, "write", True, stmt, held)
+            elif (isinstance(stmt.target, ast.Name)
+                  and stmt.target.id in self.globals):
+                self.mutations.append(_Mutation(
+                    f"augmented assignment to global '{stmt.target.id}'",
+                    stmt.lineno, stmt.col_offset))
+            self._scan_expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, ast.Subscript):
+                    inner = self._resolve_data(target.value)
+                    if inner:
+                        self._record(inner, "write", True, target, held)
+            return
+        # Return/Expr/Assert/Raise/...: scan all contained expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+
+    def _scan_target(self, target: ast.AST,
+                     held: FrozenSet[LockId]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._scan_target(elt, held)
+            return
+        if isinstance(target, ast.Attribute):
+            resolved = self._resolve_data(target)
+            # reassigning a shared container swaps it under readers; a plain
+            # scalar rebind is an atomic publish and stays clean
+            if resolved and resolved[2] == "container":
+                self._record(resolved, "write", False, target, held)
+            fname = _full_name(target)
+            if fname.startswith("jax.config."):
+                self.mutations.append(_Mutation(
+                    f"assignment to {fname}", target.lineno,
+                    target.col_offset))
+            return
+        if isinstance(target, ast.Subscript):
+            inner = self._resolve_data(target.value)
+            if inner:
+                self._record(inner, "write", True, target, held)
+            self._scan_expr(target.value, held)
+            if isinstance(target.slice, ast.expr):
+                self._scan_expr(target.slice, held)
+            return
+        if isinstance(target, ast.Name) and target.id in self.globals:
+            self.mutations.append(_Mutation(
+                f"rebind of global '{target.id}'", target.lineno,
+                target.col_offset))
+
+    def _scan_expr(self, expr: ast.AST, held: FrozenSet[LockId]) -> None:
+        if expr is None or isinstance(expr, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(expr, ast.Call):
+            self._scan_call(expr, held)
+            return
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in expr.generators:
+                resolved = self._resolve_data(gen.iter)
+                if resolved and resolved[2] == "container":
+                    self._record(resolved, "read", False, gen.iter, held)
+            for child in ast.iter_child_nodes(expr):
+                self._scan_expr(child, held)
+            return
+        if isinstance(expr, ast.Subscript) and isinstance(expr.ctx, ast.Load):
+            resolved = self._resolve_data(expr.value)
+            if resolved and resolved[2] == "container":
+                self._record(resolved, "read", False, expr, held)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.comprehension, ast.keyword)):
+                self._scan_expr(
+                    child.value if isinstance(child, ast.keyword) else child,
+                    held)
+
+    def _scan_call(self, call: ast.Call, held: FrozenSet[LockId]) -> None:
+        fname = _full_name(call.func)
+        last = _last(fname)
+        # container mutation through a method: self.ring.append(x)
+        if isinstance(call.func, ast.Attribute) and last in _MUTATORS:
+            resolved = self._resolve_data(call.func.value)
+            if resolved:
+                self._record(resolved, "write", True, call, held)
+        # iteration-shaped reads: list(self.ring), sorted(entry.latencies)
+        if last in _ITERATING:
+            for arg in call.args:
+                resolved = self._resolve_data(arg)
+                if resolved and resolved[2] == "container":
+                    self._record(resolved, "read", False, arg, held)
+        # DT405 candidates (attributed to entries later)
+        if fname.startswith("jax.config.") or last == "set_site_override":
+            self.mutations.append(_Mutation(
+                f"call to {fname or last}", call.lineno, call.col_offset))
+        if held:
+            self._check_blocking(call, fname, last, held)
+        for child in ast.iter_child_nodes(call):
+            self._scan_expr(child, held)
+
+    def _check_blocking(self, call: ast.Call, fname: str, last: str,
+                        held: FrozenSet[LockId]) -> None:
+        desc = None
+        head = fname.split(".")[0] if fname else ""
+        if fname == "time.sleep" or (last == "sleep" and head == "time"):
+            desc = "time.sleep"
+        elif last in _BLOCKING_LASTS:
+            desc = fname or last
+        elif head == "requests" and last in _REQUESTS_VERBS:
+            desc = fname
+        elif head == "subprocess" and last in _SUBPROCESS_BLOCKING:
+            desc = fname
+        elif (last == "join" and isinstance(call.func, ast.Attribute)
+              and not call.args and not call.keywords):
+            desc = f"{fname or last}"
+        elif (last == "wait" and isinstance(call.func, ast.Attribute)
+              and not call.args and not call.keywords):
+            receiver = self._lock_id(call.func.value)
+            if receiver is None or receiver not in held:
+                desc = f"{fname or last}"
+        elif last == "get" and isinstance(call.func, ast.Attribute):
+            if self._is_queue_attr(call.func.value):
+                bounded = (len(call.args) >= 2 or any(
+                    kw.arg in ("timeout", "block") for kw in call.keywords))
+                if not bounded:
+                    desc = f"{fname or 'queue.get'}"
+        if desc is not None:
+            lock = sorted(f"{c}.{a}" for c, a in held)[0]
+            self.blocking.append(
+                _Blocking(desc, lock, call.lineno, call.col_offset))
+
+
+def _check_tree(tree: ast.Module, filename: str) -> List[Finding]:
+    module = _Module(tree, filename)
+    findings: List[Finding] = []
+    scans: Dict[ast.FunctionDef, _FunctionScan] = {}
+    for fn in module.functions:
+        if fn.name in ("__init__", "__post_init__", "__del__"):
+            continue  # construction/teardown is single-threaded
+        scans[fn] = _FunctionScan(module, fn)
+
+    reach = module.reaching_entries()
+
+    # ---- DT400: per-attribute cross-entry census
+    per_attr: Dict[Tuple[str, str],
+                   List[Tuple[ast.FunctionDef, ast.FunctionDef,
+                              _Access]]] = {}
+    for fn, scan in scans.items():
+        entries = reach.get(fn)
+        if not entries:
+            continue
+        for acc in scan.accesses:
+            per_attr.setdefault((acc.cls, acc.attr), []).extend(
+                (entry, fn, acc) for entry in entries)
+    rule400 = get_rule("DT400")
+    for (cls, attr), recs in sorted(per_attr.items()):
+        writes = [r for r in recs if r[2].kind == "write"]
+        if not writes:
+            continue
+        fired = False
+        for w_entry, w_fn, w_acc in writes:
+            for a_entry, a_fn, a_acc in recs:
+                if a_entry is w_entry:
+                    continue
+                if w_acc.locks & a_acc.locks:
+                    continue
+                findings.append(rule400.finding(
+                    f"'{cls}.{attr}' is written in "
+                    f"'{module.display(w_fn)}' (entry "
+                    f"'{module.display(w_entry)}') and accessed in "
+                    f"'{module.display(a_fn)}' (entry "
+                    f"'{module.display(a_entry)}', line {a_acc.line}) with "
+                    f"no common lock",
+                    file=filename, line=w_acc.line, col=w_acc.col,
+                    context=f"{cls}.{attr}"))
+                fired = True
+                break
+            if fired:
+                break
+        if fired:
+            continue
+        for w_entry, w_fn, w_acc in writes:
+            if w_acc.rmw and not w_acc.locks and module.reentrant(w_entry):
+                findings.append(rule400.finding(
+                    f"'{cls}.{attr}' is read-modified-written without a "
+                    f"lock in '{module.display(w_fn)}', reachable from "
+                    f"entry '{module.display(w_entry)}' which can run "
+                    f"concurrently with itself",
+                    file=filename, line=w_acc.line, col=w_acc.col,
+                    context=f"{cls}.{attr}"))
+                break
+
+    # ---- DT401: blocking while locked (any function, entry or not)
+    rule401 = get_rule("DT401")
+    for fn, scan in scans.items():
+        for block in scan.blocking:
+            findings.append(rule401.finding(
+                f"blocking call {block.desc}() in '{module.display(fn)}' "
+                f"while holding lock '{block.lock}'",
+                file=filename, line=block.line, col=block.col,
+                context=module.display(fn)))
+
+    # ---- DT402: lock-order inversions (module-global)
+    rule402 = get_rule("DT402")
+    edges: Dict[Tuple[LockId, LockId], Tuple[int, int, str]] = {}
+    for fn, scan in scans.items():
+        for outer, inner, line, col in scan.acq_edges:
+            edges.setdefault((outer, inner),
+                             (line, col, module.display(fn)))
+    for (outer, inner), (line, col, where) in sorted(edges.items()):
+        if (inner, outer) in edges:
+            rline, _, rwhere = edges[(inner, outer)]
+            findings.append(rule402.finding(
+                f"lock '{outer[0]}.{outer[1]}' is taken before "
+                f"'{inner[0]}.{inner[1]}' in '{where}' but after it in "
+                f"'{rwhere}' (line {rline}): opposite orders can deadlock",
+                file=filename, line=line, col=col,
+                context=f"{outer[0]}.{outer[1]}<->{inner[0]}.{inner[1]}"))
+
+    # ---- DT405: trace-unsafe global mutation from entries
+    rule405 = get_rule("DT405")
+    for fn, scan in scans.items():
+        entries = reach.get(fn)
+        if not entries:
+            continue
+        names = sorted({module.display(e) for e in entries})
+        for mut in scan.mutations:
+            findings.append(rule405.finding(
+                f"{mut.desc} in '{module.display(fn)}' is reachable from "
+                f"thread entry "
+                f"{', '.join(repr(n) for n in names[:3])}: executables "
+                f"compiled before and after it disagree",
+                file=filename, line=mut.line, col=mut.col,
+                context=module.display(fn)))
+
+    return findings
+
+
+def check_concurrency_source(source: str,
+                             filename: str = "<source>") -> List[Finding]:
+    """DT400-DT402 + DT405 over one module's source."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [get_rule("DT100").finding(
+            f"could not parse: {exc.msg}", file=filename,
+            line=exc.lineno or 0, col=exc.offset or 0)]
+    findings = sort_findings(_check_tree(tree, filename))
+    return filter_findings(findings, source)
+
+
+def check_concurrency_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return check_concurrency_source(source, filename=path)

@@ -45,6 +45,7 @@ __all__ = [
     "analyze_config_ir",
     "check_padding_waste",
     "record_findings",
+    "ir_findings_family",
     "admission_check",
 ]
 
@@ -542,6 +543,16 @@ def check_padding_waste(stats: Optional[dict], *,
 
 
 # ----------------------------------------------------------- observability
+def ir_findings_family(registry):
+    """The single owning declaration of ``dl4jtpu_ir_findings_total`` —
+    :func:`record_findings` and the compile manager both draw the family
+    from here so the schema (labels, help text) cannot drift (DT406)."""
+    return registry.counter(
+        "dl4jtpu_ir_findings_total",
+        "IR-lint (DT2xx) findings from admission/preflight/epoch scans",
+        labelnames=("rule",))
+
+
 def record_findings(findings: Sequence[Finding], *, registry=None,
                     flight=None) -> None:
     """Route IR findings into telemetry: one
@@ -557,11 +568,7 @@ def record_findings(findings: Sequence[Finding], *, registry=None,
                 from ..telemetry import get_registry  # noqa: PLC0415
 
                 registry = get_registry()
-            fam = registry.counter(
-                "dl4jtpu_ir_findings_total",
-                "IR-lint (DT2xx) findings from admission/preflight/epoch "
-                "scans",
-                labelnames=("rule",))
+            fam = ir_findings_family(registry)
             for f in findings:
                 fam.labels(rule=f.rule_id).inc()
         except Exception:

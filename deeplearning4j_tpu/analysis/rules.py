@@ -1,9 +1,12 @@
 """Rule registry: every check has a DTxxx id, default severity and fix hint.
 
 DT0xx = graph/config rules (pass 1), DT1xx = AST lint rules (pass 2),
-DT2xx = jaxpr/HLO IR rules (pass 3 — what the compiler actually built).
-Register new rules with :func:`register_rule`; the catalog drives
-``--list-rules``, docs/static_analysis.md, and pragma validation.
+DT2xx = jaxpr/HLO IR rules (pass 3 — what the compiler actually built),
+DT3xx = sharding-flow rules (pass 4), DT4xx = runtime-guard rules
+(pass 5 — concurrency, env hygiene and telemetry schema across the
+serving/fleet/online stack). Register new rules with
+:func:`register_rule`; the catalog drives ``--list-rules``,
+docs/static_analysis.md, and pragma validation.
 """
 
 from __future__ import annotations
@@ -348,6 +351,91 @@ register_rule(Rule(
     "Shard the head dim (reshape kernels to [in, heads, d_head] and spec "
     "P(None, 'tp', None)) or gate dim for LSTM kernels, so each device "
     "computes whole heads locally — the ROADMAP 'head-aware tp specs' item.",
+))
+
+# ------------------------------------------------------ runtime-guard rules
+# Pass 5 (analysis/concurrency.py + analysis/runtime_checks.py): AST lint
+# over the multi-threaded serving/fleet/online stack. Thread-entry discovery
+# (Thread targets, HTTP do_* handlers, watchdog/batcher sinks, public methods
+# of lock-owning classes) feeds a per-class attribute read/write census with
+# ``with self._lock`` context tracking. Findings carry source lines, so the
+# usual ``# dl4jtpu: ignore[DT4xx]`` pragmas apply.
+register_rule(Rule(
+    "DT400", "shared attribute raced across thread entries", "warning",
+    "runtime",
+    "A mutable attribute is written from one thread entry point and "
+    "read/written from another with no common lock held (or read-modified-"
+    "written without any lock inside a handler/callback entry that can run "
+    "concurrently with itself): lost updates, torn reads, and "
+    "mutated-during-iteration crashes on the stats/snapshot paths.",
+    "Guard every access to the attribute with ONE lock (the owning class's "
+    "existing lock where present); for counters, increment under the lock; "
+    "for rings/lists, snapshot under the lock before iterating.",
+))
+register_rule(Rule(
+    "DT401", "blocking call while holding a lock", "warning", "runtime",
+    "A blocking operation (time.sleep, HTTP, subprocess, unbounded "
+    "queue.get, Future.result, device fetch/compile, rnn_time_step, "
+    "socket recv/accept) runs while a lock is held: every other thread "
+    "contending for that lock stalls behind the slow operation — on a "
+    "serving hot path this serializes the whole request fleet.",
+    "Move the blocking call outside the ``with lock:`` block (snapshot the "
+    "state you need under the lock, then release before blocking); if the "
+    "lock deliberately serializes a single-threaded resource (e.g. one "
+    "stateful net), say so with # dl4jtpu: ignore[DT401].",
+))
+register_rule(Rule(
+    "DT402", "inconsistent lock acquisition order", "warning", "runtime",
+    "Two locks are acquired in nested ``with`` blocks in one order on one "
+    "code path and the opposite order on another: two threads taking one "
+    "lock each then waiting for the other deadlock the process.",
+    "Pick one global order for the pair (document it where the locks are "
+    "created) and re-nest the second path; or collapse the critical "
+    "sections so only one lock spans both.",
+))
+register_rule(Rule(
+    "DT403", "raw os.environ mutation outside EnvScope", "warning",
+    "runtime",
+    "os.environ is written/deleted directly (subscript assignment, pop, "
+    "update, clear, putenv): the prior value — including its absence — is "
+    "lost, so the process leaks config state across trials, tests and "
+    "serving rollouts.",
+    "Mutate env vars only through tune.EnvScope / tune.scoped_env, which "
+    "record the prior state and restore it bit-identically on exit; the "
+    "EnvScope implementation itself carries the justified ignore pragma.",
+))
+register_rule(Rule(
+    "DT404", "bare time.sleep outside resilience policies", "warning",
+    "runtime",
+    "time.sleep() pauses a thread with no deadline, no stop-event and no "
+    "pacing accounting: shutdown hangs for the residual sleep, tests slow "
+    "down by the worst case, and the wait is invisible to the resilience "
+    "stats. (AST successor to the old check.sh grep gate.)",
+    "Use runtime.resilience primitives: Deadline(t).pace(interval, "
+    "stop=event) for poll loops, DeadlinePolicy(...).start().wait_event(ev) "
+    "for waits, event.wait(timeout) for plain delays; genuinely intentional "
+    "sleeps take # dl4jtpu: ignore[DT404] with a reason.",
+))
+register_rule(Rule(
+    "DT405", "trace-unsafe global mutation from a thread entry", "warning",
+    "runtime",
+    "jax.config updates, kernel set_site_override calls, or module-global "
+    "rebinding reachable from a thread/handler entry point: compiled "
+    "executables already cached ignore the new value, executables compiled "
+    "after it embed it — the fleet serves from two configs at once.",
+    "Apply process-global config once at startup (before warmup) from the "
+    "main thread; per-request variation must be threaded as arguments, "
+    "not globals (see tune.EnvScope for env-read knobs).",
+))
+register_rule(Rule(
+    "DT406", "telemetry schema drift", "warning", "runtime",
+    "A dl4jtpu_* metric name is declared twice with a different type or "
+    "label set, or a flight-recorder event is recorded with a kind that no "
+    "module registered: dashboards silently split series and replay "
+    "tooling drops the unregistered events.",
+    "Declare each metric once (one owner module) and reuse the handle; "
+    "register new flight-event kinds with "
+    "telemetry.flight_recorder.register_event_kind at import time.",
 ))
 
 register_rule(Rule(

@@ -1,0 +1,344 @@
+"""Pass 5b: runtime env-hygiene and telemetry-schema lint (DT403, DT404,
+DT406) + the combined DT4xx tier driver.
+
+- **DT403** — raw ``os.environ`` mutation (subscript writes/deletes,
+  ``pop``/``update``/``setdefault``/``clear``, ``os.putenv``). The only
+  sanctioned mutation path is :class:`tune.EnvScope` /
+  :func:`tune.scoped_env`, whose own implementation carries the justified
+  ignore pragma. Reads (``os.environ.get``) and copies
+  (``dict(os.environ)``) stay clean.
+- **DT404** — bare ``time.sleep`` anywhere: the AST successor to the old
+  check.sh grep gate. Poll loops belong on
+  ``runtime.resilience.Deadline.pace`` (stop-event aware, accounted),
+  waits on ``wait_event``/``event.wait(timeout)``.
+- **DT406** — telemetry schema consistency. A :class:`TelemetrySchema`
+  accumulates every ``dl4jtpu_*`` metric declaration
+  (``registry.counter/gauge/histogram`` with a literal name) and every
+  flight-recorder ``record(<kind>)`` site across all scanned files, then
+  reports metric names declared with conflicting types/label sets (or
+  from two modules), and event kinds no module registered with
+  :func:`telemetry.flight_recorder.register_event_kind`.
+
+:func:`check_runtime_source` runs the whole DT4xx tier (delegating
+DT400-DT402/DT405 to :mod:`analysis.concurrency`) on one source;
+:func:`check_runtime_paths` scans files/trees with ONE schema aggregated
+across all of them — that is what ``python -m deeplearning4j_tpu.analysis
+--concurrency`` and ``conf.analyze(concurrency=True)`` invoke.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .ast_checks import _full_name, _last
+from .concurrency import check_concurrency_source
+from .findings import Finding, merge_findings, sort_findings
+from .pragmas import filter_findings
+from .rules import get_rule
+
+__all__ = [
+    "TelemetrySchema",
+    "check_runtime_file",
+    "check_runtime_package",
+    "check_runtime_paths",
+    "check_runtime_source",
+]
+
+_ENV_MUTATORS = {"pop", "update", "setdefault", "clear", "popitem"}
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build",
+              "dist"}
+
+
+def _env_bases(tree: ast.Module) -> Set[str]:
+    """Dotted names that refer to os.environ in this module."""
+    bases = {"os.environ"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name == "environ":
+                    bases.add(alias.asname or "environ")
+    return bases
+
+
+def _sleep_names(tree: ast.Module) -> Set[str]:
+    names = {"time.sleep"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    names.add(alias.asname or "sleep")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time" and alias.asname:
+                    names.add(f"{alias.asname}.sleep")
+    return names
+
+
+def _scan_env_and_sleep(tree: ast.Module, filename: str) -> List[Finding]:
+    rule403 = get_rule("DT403")
+    rule404 = get_rule("DT404")
+    env_bases = _env_bases(tree)
+    sleep_names = _sleep_names(tree)
+    findings: List[Finding] = []
+
+    def env_write(node: ast.AST, what: str) -> None:
+        findings.append(rule403.finding(
+            f"raw os.environ mutation ({what}) — prior state (including "
+            f"absence) is lost",
+            file=filename, line=node.lineno, col=node.col_offset,
+            context=what))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                if (isinstance(target, ast.Subscript)
+                        and _full_name(target.value) in env_bases):
+                    env_write(node, "subscript assignment")
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if (isinstance(target, ast.Subscript)
+                        and _full_name(target.value) in env_bases):
+                    env_write(node, "del")
+        elif isinstance(node, ast.Call):
+            fname = _full_name(node.func)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ENV_MUTATORS
+                    and _full_name(node.func.value) in env_bases):
+                env_write(node, f"environ.{node.func.attr}()")
+            elif fname in ("os.putenv", "os.unsetenv"):
+                env_write(node, f"{fname}()")
+            elif fname in sleep_names:
+                findings.append(rule404.finding(
+                    "bare time.sleep(): no deadline, no stop event, "
+                    "invisible to resilience stats",
+                    file=filename, line=node.lineno, col=node.col_offset,
+                    context=fname))
+    return findings
+
+
+def _registered_event_kinds() -> Optional[Set[str]]:
+    try:
+        from ..telemetry.flight_recorder import registered_event_kinds
+    except Exception:  # pragma: no cover - analysis must run without deps
+        return None
+    try:
+        return set(registered_event_kinds())
+    except Exception:  # pragma: no cover
+        return None
+
+
+class TelemetrySchema:
+    """Cross-file accumulator for DT406.
+
+    ``collect()`` one parsed module at a time, then ``findings()`` once at
+    the end — metric-name collisions only exist across the whole scanned
+    set, so per-file checking would miss exactly the drift this rule is
+    for.
+    """
+
+    def __init__(self, registered_kinds: Optional[Set[str]] = None):
+        self.registered = (registered_kinds if registered_kinds is not None
+                           else _registered_event_kinds())
+        # metric name -> (ctor kind, labels-or-None, file, line)
+        self.metrics: Dict[str, Tuple[str, Optional[Tuple[str, ...]],
+                                      str, int]] = {}
+        self._conflicts: List[Finding] = []
+        self._events: List[Tuple[str, str, int, int]] = []
+        self._sources: Dict[str, str] = {}
+
+    # -- collection --------------------------------------------------------
+    def collect(self, tree: ast.Module, source: str, filename: str) -> None:
+        self._sources[filename] = source
+        consts: Dict[str, str] = {}
+        for stmt in tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                consts[stmt.targets[0].id] = stmt.value.value
+        flight_class_calls: Set[ast.Call] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and "FlightRecorder" in node.name:
+                flight_class_calls.update(
+                    c for c in ast.walk(node) if isinstance(c, ast.Call))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            self._collect_metric(node, filename)
+            self._collect_event(node, flight_class_calls, consts, filename)
+
+    def _collect_metric(self, call: ast.Call, filename: str) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        kind = call.func.attr
+        if kind not in _METRIC_CTORS or not call.args:
+            return
+        first = call.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("dl4jtpu_")):
+            return
+        name = first.value
+        labels = self._labelnames(call)
+        prior = self.metrics.get(name)
+        if prior is None:
+            self.metrics[name] = (kind, labels, filename, call.lineno)
+            return
+        pkind, plabels, pfile, pline = prior
+        rule = get_rule("DT406")
+        if pkind != kind:
+            self._conflicts.append(rule.finding(
+                f"metric '{name}' declared as {kind} here but as {pkind} "
+                f"at {pfile}:{pline} — dashboards split the series",
+                file=filename, line=call.lineno, col=call.col_offset,
+                context=name))
+        elif labels is not None and plabels is not None \
+                and labels != plabels:
+            self._conflicts.append(rule.finding(
+                f"metric '{name}' declared with labels {list(labels)} here "
+                f"but {list(plabels)} at {pfile}:{pline} — label sets must "
+                f"be stable",
+                file=filename, line=call.lineno, col=call.col_offset,
+                context=name))
+        elif os.path.abspath(pfile) != os.path.abspath(filename):
+            self._conflicts.append(rule.finding(
+                f"metric '{name}' declared in two modules (here and "
+                f"{pfile}:{pline}) — each metric needs one owning module",
+                file=filename, line=call.lineno, col=call.col_offset,
+                context=name))
+
+    @staticmethod
+    def _labelnames(call: ast.Call) -> Optional[Tuple[str, ...]]:
+        expr = None
+        if len(call.args) >= 3:
+            expr = call.args[2]
+        for kw in call.keywords:
+            if kw.arg == "labelnames":
+                expr = kw.value
+        if expr is None:
+            return ()
+        if isinstance(expr, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in expr.elts):
+            return tuple(e.value for e in expr.elts)
+        return None  # dynamic label expression: skip the comparison
+
+    def _collect_event(self, call: ast.Call,
+                       flight_class_calls: Set[ast.Call],
+                       consts: Dict[str, str], filename: str) -> None:
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "record" and call.args):
+            return
+        receiver = call.func.value
+        rname = _full_name(receiver)
+        if isinstance(receiver, ast.Call):
+            rname = _full_name(receiver.func)
+        is_flight = "flight" in rname or _last(rname) in ("rec", "recorder")
+        if not is_flight and rname == "self":
+            # FlightRecorder's own helpers call self.record(...)
+            is_flight = call in flight_class_calls
+        if not is_flight:
+            return
+        first = call.args[0]
+        kind: Optional[str] = None
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            kind = first.value
+        elif isinstance(first, ast.Name):
+            kind = consts.get(first.id)
+        if kind is None:
+            return  # dynamic kind expression: nothing to audit statically
+        self._events.append((kind, filename, call.lineno, call.col_offset))
+
+    # -- reporting ---------------------------------------------------------
+    def findings(self) -> List[Finding]:
+        out = list(self._conflicts)
+        if self.registered is not None:
+            rule = get_rule("DT406")
+            for kind, filename, line, col in self._events:
+                if kind not in self.registered:
+                    out.append(rule.finding(
+                        f"flight-recorder event kind '{kind}' is recorded "
+                        f"but never registered — register_event_kind() it "
+                        f"in the owning module",
+                        file=filename, line=line, col=col, context=kind))
+        by_file: Dict[str, List[Finding]] = {}
+        for f in out:
+            by_file.setdefault(f.file, []).append(f)
+        filtered: List[Finding] = []
+        for filename, group in by_file.items():
+            source = self._sources.get(filename)
+            filtered.extend(filter_findings(group, source)
+                            if source is not None else group)
+        return sort_findings(filtered)
+
+
+def check_runtime_source(source: str, filename: str = "<source>", *,
+                         schema: Optional[TelemetrySchema] = None
+                         ) -> List[Finding]:
+    """The full DT4xx tier on one source string.
+
+    With ``schema=None`` (standalone use, tests) a private schema is
+    created and its DT406 findings are included; pass a shared schema to
+    aggregate metric/event declarations across files and call
+    ``schema.findings()`` yourself at the end.
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return check_concurrency_source(source, filename)  # DT100
+    findings = filter_findings(
+        sort_findings(_scan_env_and_sleep(tree, filename)), source)
+    findings += check_concurrency_source(source, filename)
+    own_schema = schema is None
+    if own_schema:
+        schema = TelemetrySchema()
+    schema.collect(tree, source, filename)
+    if own_schema:
+        findings += schema.findings()
+    return sort_findings(merge_findings(findings))
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif path.endswith(".py"):
+            out.append(path)
+    return out
+
+
+def check_runtime_paths(paths: Sequence[str]) -> List[Finding]:
+    """DT4xx over files/directories with ONE schema across all of them."""
+    schema = TelemetrySchema()
+    findings: List[Finding] = []
+    for py in _iter_py_files(paths):
+        with open(py, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        findings += check_runtime_source(source, filename=py, schema=schema)
+    findings += schema.findings()
+    return sort_findings(merge_findings(findings))
+
+
+def check_runtime_file(path: str) -> List[Finding]:
+    return check_runtime_paths([path])
+
+
+def check_runtime_package() -> List[Finding]:
+    """Self-scan of the package's threaded runtime stack — the surface the
+    check.sh gate holds clean at --fail-on warning."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dirs = [os.path.join(pkg_dir, d)
+            for d in ("serving", "fleet", "runtime", "telemetry",
+                      "streaming")]
+    return check_runtime_paths([d for d in dirs if os.path.isdir(d)])

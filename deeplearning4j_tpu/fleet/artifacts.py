@@ -204,7 +204,9 @@ def install_bundle(bundle: dict, *, set_env: bool = True) -> dict:
     cache_dir = bundle.get("xla_cache_dir")
     if cache_dir:
         if set_env and not os.environ.get(CACHE_DIR_ENV):
-            os.environ[CACHE_DIR_ENV] = str(cache_dir)
+            # deliberately unscoped: the cache dir must outlive this call
+            # for the whole worker process (EnvScope would restore it)
+            os.environ[CACHE_DIR_ENV] = str(cache_dir)  # dl4jtpu: ignore[DT403]
         report["xla_cache"] = enable_persistent_cache(str(cache_dir))
 
     kernel = bundle.get("kernel") or {}

@@ -171,6 +171,9 @@ class FleetRouter:
         self.requests_total = 0
         self.shed_total = 0
         self.failed_total = 0
+        # request counters increment from HTTP handler threads AND the
+        # supervisor; every += goes through this lock
+        self._stats_lock = threading.Lock()
         self._draining = False
         self._stop = threading.Event()
         self._route_cv = threading.Condition()
@@ -436,7 +439,8 @@ class FleetRouter:
                 raise _NoWorker("no ready worker")
             if handle.outstanding >= self.shed_outstanding:
                 # least-loaded worker is saturated => whole fleet is
-                self.shed_total += 1
+                with self._stats_lock:
+                    self.shed_total += 1
                 self._m_shed.inc()
                 retry = round(max(0.05, 0.01 * handle.outstanding), 3)
                 return (429, {"error": "fleet saturated",
@@ -451,7 +455,8 @@ class FleetRouter:
                     {"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     out = json.loads(resp.read())
-                self.requests_total += 1
+                with self._stats_lock:
+                    self.requests_total += 1
                 self._m_requests.inc()
                 return 200, out, {}
             except urllib.error.HTTPError as e:
@@ -461,7 +466,8 @@ class FleetRouter:
                 except Exception:  # noqa: BLE001
                     pass
                 if e.code == 429:  # propagate the worker's shed verbatim
-                    self.shed_total += 1
+                    with self._stats_lock:
+                        self.shed_total += 1
                     self._m_shed.inc()
                     headers = {}
                     if e.headers.get("Retry-After"):
@@ -484,10 +490,12 @@ class FleetRouter:
         try:
             return self.failover_policy.run(attempt)
         except _NoWorker as e:
-            self.failed_total += 1
+            with self._stats_lock:
+                self.failed_total += 1
             return 503, {"error": f"no worker served the request ({e})"}, {}
         except Exception as e:  # noqa: BLE001 - RetryError wraps the cause
-            self.failed_total += 1
+            with self._stats_lock:
+                self.failed_total += 1
             cause = getattr(e, "last", e)
             return 503, {"error": f"no worker served the request "
                                   f"({cause})"}, {}
@@ -498,7 +506,8 @@ class FleetRouter:
         percentiles over every worker's bounded latency ring."""
         merged: List[float] = []
         for handle in self.workers:
-            merged.extend(handle.latency_samples)
+            with handle.lock:  # _check_worker swaps the ring concurrently
+                merged.extend(handle.latency_samples)
         return {
             "store": self.store_dir,
             "model": self.model,

@@ -90,6 +90,9 @@ class FleetWorker:
         self.requests_total = 0
         self.shed_total = 0
         self.started_at = time.time()
+        # ThreadingHTTPServer runs one thread per request: the request
+        # counters increment under this lock, never bare
+        self._stats_lock = threading.Lock()
         self._swap_lock = threading.Lock()
         self._stop = threading.Event()
         self._drained = threading.Event()
@@ -270,7 +273,8 @@ class FleetWorker:
         argmax = bool(payload.get("argmax", False))
         version = self.version  # pre-dispatch tag; body proves the params
         out = self.service.predict(self.model, features, argmax=argmax)
-        self.requests_total += 1
+        with self._stats_lock:
+            self.requests_total += 1
         key = "classes" if argmax else "output"
         return {key: np.asarray(out).tolist(), "version": version}
 
@@ -356,7 +360,8 @@ class FleetWorker:
                         self._send(503, {"error": str(e),
                                          "draining": True})
                     except AdmissionError as e:
-                        worker.shed_total += 1
+                        with worker._stats_lock:  # noqa: SLF001
+                            worker.shed_total += 1
                         self._send(429, {"error": str(e),
                                          "reason": e.reason,
                                          "retry_after_s": e.retry_after_s},

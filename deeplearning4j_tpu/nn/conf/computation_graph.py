@@ -94,13 +94,15 @@ class ComputationGraphConfiguration:
             known[name] = self.vertices[name].get_output_type(*ins)
         return result
 
-    def analyze(self, ir: bool = False, **kw):
+    def analyze(self, ir: bool = False, concurrency: bool = False, **kw):
         """Run the dl4jtpu-check graph pass over this DAG; returns a merged,
         deduplicated, stable-sorted list of
         :class:`~deeplearning4j_tpu.analysis.Finding` with per-vertex
         diagnostics (empty = clean). ``ir=True`` additionally builds the
-        graph and runs the DT2xx jaxpr/IR pass over its real train step.
-        See docs/static_analysis.md; keywords forward to
+        graph and runs the DT2xx jaxpr/IR pass over its real train step;
+        ``concurrency=True`` additionally runs the DT4xx runtime-guard pass
+        over the package's serving/fleet/runtime/telemetry/streaming
+        sources. See docs/static_analysis.md; keywords forward to
         :func:`deeplearning4j_tpu.analysis.check_graph` /
         :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
         from ...analysis import check_graph, merge_findings  # local: analysis is optional at runtime
@@ -111,6 +113,10 @@ class ComputationGraphConfiguration:
             from ...analysis.ir_checks import analyze_config_ir
 
             findings += analyze_config_ir(self, **kw)[0]
+        if concurrency:
+            from ...analysis.runtime_checks import check_runtime_package
+
+            findings += check_runtime_package()
         return merge_findings(f for f in findings if f.rule_id not in ignore)
 
     def output_types(self) -> List[InputType]:

@@ -72,12 +72,14 @@ class MultiLayerConfiguration:
         return self.layers[-1].get_output_type(its[-1])
 
     # ---- static analysis ----------------------------------------------------
-    def analyze(self, ir: bool = False, **kw):
+    def analyze(self, ir: bool = False, concurrency: bool = False, **kw):
         """Run the dl4jtpu-check graph pass over this config; returns a
         merged, deduplicated, stable-sorted list of
         :class:`~deeplearning4j_tpu.analysis.Finding` (empty = clean).
         ``ir=True`` additionally builds the network and runs the DT2xx
-        jaxpr/IR pass over its real train step (see
+        jaxpr/IR pass over its real train step; ``concurrency=True``
+        additionally runs the DT4xx runtime-guard pass over the package's
+        serving/fleet/runtime/telemetry/streaming sources (see
         docs/static_analysis.md); keywords forward to
         :func:`deeplearning4j_tpu.analysis.check_multi_layer` /
         :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
@@ -89,6 +91,10 @@ class MultiLayerConfiguration:
             from ...analysis.ir_checks import analyze_config_ir
 
             findings += analyze_config_ir(self, **kw)[0]
+        if concurrency:
+            from ...analysis.runtime_checks import check_runtime_package
+
+            findings += check_runtime_package()
         return merge_findings(f for f in findings if f.rule_id not in ignore)
 
     # ---- JSON ---------------------------------------------------------------

@@ -202,10 +202,8 @@ class CompileManager:
         self.hbm_total = registry.gauge(
             "dl4jtpu_executable_hbm_total_bytes",
             "cache-wide total HBM footprint of live cached executables")
-        self.ir_findings = registry.counter(
-            "dl4jtpu_ir_findings_total",
-            "IR-lint (DT2xx) findings from admission/preflight/epoch scans",
-            labelnames=("rule",))
+        from ..analysis.ir_checks import ir_findings_family  # noqa: PLC0415
+        self.ir_findings = ir_findings_family(registry)
 
     # -------------------------------------------------------- observability
     @staticmethod

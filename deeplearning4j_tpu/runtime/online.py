@@ -74,14 +74,18 @@ class _Count:
     one process (or one after another) must not read each other's
     counts."""
 
-    __slots__ = ("n", "_family")
+    __slots__ = ("n", "_family", "_lock")
 
     def __init__(self, family):
         self.n = 0
         self._family = family
+        # inc() runs on the ingest thread while pause/checkpoint callers
+        # bump their own counters from control threads
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.n += int(n)
+        with self._lock:
+            self.n += int(n)
         self._family.inc(n)
 
 
@@ -225,6 +229,9 @@ class OnlineTrainer:
         self._loss_var: Optional[float] = None  # EMA of within-window loss variance
         self._baseline_windows = 0
         self._recent_losses: "deque[float]" = deque(maxlen=self.drift_window)
+        # the ingest loop appends/clears the loss window while stats()
+        # snapshots it from serving threads
+        self._window_lock = threading.Lock()
         self._shift = _ShiftStats()
         self._rate: "deque[Tuple[float, int]]" = deque(maxlen=64)
         self._rate_value = 0.0
@@ -447,14 +454,18 @@ class OnlineTrainer:
         hard_pause = kind in self.pause_on
         if kind in self.rollback_on or hard_pause:
             self.pause(reason=kind)
-        if kind in self.rollback_on:
-            self._rollback(kind)
+        rolled = kind in self.rollback_on and self._rollback(kind)
         # the bundle IS the artifact: dump after the rollback so it records
         # both the anomaly and the recovery (rate-limited per reason)
         try:
             self.flight.dump(reason=f"online-{kind}")
         except Exception:  # a failed dump must never kill the loop
             pass
+        # the counter is the wait-handle: observers poll rollbacks_total and
+        # then read the newest bundle, so it must not advance until the
+        # bundle is on disk
+        if rolled:
+            self._m_rollbacks.inc()
         if not hard_pause:
             self.resume()
 
@@ -481,10 +492,10 @@ class OnlineTrainer:
         # a corrupt target quarantines and falls back to the newest good
         # version rather than wedging the recovery path
         loaded = self.store.load_into(self.net, target, fallback=True)
-        self._m_rollbacks.inc()
         # the drifted/poisoned window means must not re-trigger on the
         # restored model; the healthy baseline survives
-        self._recent_losses.clear()
+        with self._window_lock:
+            self._recent_losses.clear()
         self.flight.record("online_rollback", trainer=self.name,
                            reason=reason, version=int(loaded),
                            iteration=int(self.net.iteration))
@@ -602,11 +613,13 @@ class OnlineTrainer:
                 f"{self.net.iteration}")
             return
         mean = float(np.mean(losses))
-        self._recent_losses.append(mean)
+        with self._window_lock:
+            self._recent_losses.append(mean)
+            window = list(self._recent_losses)
         baseline = self._loss_baseline
         if baseline is not None and self._baseline_windows \
                 >= self.drift_min_windows:
-            recent = float(np.mean(list(self._recent_losses)[-3:]))
+            recent = float(np.mean(window[-3:]))
             # adaptive band: the threshold scales with the EMA of the
             # WITHIN-window loss variance, so benign noise widens the band
             # instead of tripping it, while a between-window trend (drift)
@@ -909,6 +922,8 @@ class OnlineTrainer:
         anomalies = {}
         for ev in self.watchdog.events[-256:]:
             anomalies[ev.kind] = anomalies.get(ev.kind, 0) + 1
+        with self._window_lock:
+            window = list(self._recent_losses)
         out = {
             "name": self.name,
             "alive": self.alive,
@@ -929,8 +944,7 @@ class OnlineTrainer:
             "loss_baseline": self._loss_baseline,
             "loss_sigma": (None if self._loss_var is None
                            else float(np.sqrt(self._loss_var))),
-            "recent_window_losses": [round(x, 6)
-                                     for x in self._recent_losses],
+            "recent_window_losses": [round(x, 6) for x in window],
             "last_anomaly": self._last_anomaly,
             "anomalies": anomalies,
             "replays_total": self._m_replays.n,

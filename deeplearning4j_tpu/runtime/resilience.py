@@ -25,9 +25,10 @@ serve it). Policy defaults read the ``DL4JTPU_RETRY_*`` /
 ``DL4JTPU_CIRCUIT_*`` env knobs at construction time (see
 docs/robustness.md for the knob table).
 
-This module is the one sanctioned home for backoff sleeps — fleet/ and
-the online/checkpoint runtime must not call ``time.sleep`` directly
-(grep-enforced by scripts/check.sh).
+This module is the one sanctioned home for backoff sleeps — nothing
+else in the tree may call ``time.sleep`` directly (rule DT404 in the
+runtime-guard lint tier, enforced by the scripts/check.sh self-scan;
+``# dl4jtpu: ignore[DT404]`` suppresses a justified exception inline).
 """
 
 from __future__ import annotations

@@ -129,12 +129,14 @@ class MicroBatcher:
         responsible for stopping admission first (the batcher itself
         keeps accepting — admission policy lives in the service).
         Returns True when fully drained within the timeout."""
-        deadline = time.perf_counter() + timeout_s
-        while time.perf_counter() < deadline:
+        from ..runtime.resilience import Deadline
+        deadline = Deadline(timeout_s)
+        while True:
             with self._lock:
                 if not self._pending:
                     return True
-            time.sleep(0.005)
+            if not deadline.pace(0.005):
+                break
         with self._lock:
             return not self._pending
 

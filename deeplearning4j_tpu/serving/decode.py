@@ -177,7 +177,10 @@ class DecodeServer:
                     x[slot, 0] = pend.features
                     mask[slot, 0] = 1.0
                 with self._net_lock:
-                    out = self.net.rnn_time_step(x, features_mask=mask)
+                    # _net_lock exists precisely to serialize the single
+                    # stateful net's rnn_time_step against swap()
+                    out = self.net.rnn_time_step(  # dl4jtpu: ignore[DT401]
+                        x, features_mask=mask)
                 out = np.asarray(out)
                 if out.ndim == 3:  # [slots, 1, C] -> [slots, C]
                     out = out[:, 0]

@@ -142,7 +142,9 @@ class _ModelEntry:
         now = time.perf_counter()
         at, value = self._p99_cache
         if now - at > _P99_REFRESH_S:
-            value = _percentile(list(self.latencies), 99)
+            with self.lock:  # batcher callbacks append concurrently
+                ring = list(self.latencies)
+            value = _percentile(ring, 99)
             self._p99_cache = (now, value)
         return value
 
@@ -457,7 +459,8 @@ class InferenceService:
 
     def _shed(self, entry: _ModelEntry, reason: str,
               retry_after_s: float) -> None:
-        entry.shed += 1
+        with entry.lock:
+            entry.shed += 1
         self.shed_total.labels(model=entry.name, reason=reason).inc()
         raise AdmissionError(entry.name, reason, round(retry_after_s, 3))
 
@@ -501,8 +504,9 @@ class InferenceService:
         self.latency.labels(model=name).observe(seconds)
         entry = self._models.get(name)
         if entry is not None:
-            entry.requests += 1
-            entry.latencies.append(float(seconds))
+            with entry.lock:  # logits/argmax/decode callbacks race here
+                entry.requests += 1
+                entry.latencies.append(float(seconds))
 
     def _record_batch(self, name: str, *, rows: int, requests: int,
                       seconds: float, queue_depth: int,
@@ -518,10 +522,11 @@ class InferenceService:
         self.batch_fill.labels(model=name).set(fill)
         entry = self._models.get(name)
         if entry is not None:
-            entry.rows += rows
-            entry.batches += 1
-            entry.fill_sum += fill
-            entry.last_dispatch = {
+            with entry.lock:
+                entry.rows += rows
+                entry.batches += 1
+                entry.fill_sum += fill
+                entry.last_dispatch = {
                 "kind": kind, "rows": rows, "requests": requests,
                 "bucket_rows": bucket, "fill_ratio": round(fill, 4),
                 "seconds": round(seconds, 6)}
@@ -549,7 +554,8 @@ class InferenceService:
 
         models = {}
         for name, e in entries.items():
-            lats = list(e.latencies)
+            with e.lock:  # the ring keeps appending while we snapshot
+                lats = list(e.latencies)
             lo = layout_of(e.net)
             models[name] = {
                 "layout": lo.describe() if lo is not None else None,

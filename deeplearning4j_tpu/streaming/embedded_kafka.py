@@ -55,16 +55,18 @@ class EmbeddedKafkaBroker:
         self._rr: Dict[str, int] = {}  # per-topic round-robin for unkeyed sends
 
     def _ensure_topic(self, topic: str) -> None:
-        for p in range(self.num_partitions):
-            self._logs.setdefault(TopicPartition(topic, p), [])
+        # owns the lock itself; topics are never deleted, so callers may
+        # ensure first and re-acquire for their own critical section
+        with self._lock:
+            for p in range(self.num_partitions):
+                self._logs.setdefault(TopicPartition(topic, p), [])
 
     def create_topic(self, topic: str) -> None:
-        with self._lock:
-            self._ensure_topic(topic)
+        self._ensure_topic(topic)
 
     def partitions_for(self, topic: str) -> List[TopicPartition]:
+        self._ensure_topic(topic)
         with self._lock:
-            self._ensure_topic(topic)
             return [tp for tp in self._logs if tp.topic == topic]
 
     def append(self, topic: str, value: bytes,
@@ -74,8 +76,8 @@ class EmbeddedKafkaBroker:
         Keyed messages hash to a stable partition (ordering per key);
         unkeyed messages round-robin — kafka's default partitioner contract.
         """
+        self._ensure_topic(topic)
         with self._lock:
-            self._ensure_topic(topic)
             if key is not None:
                 # deterministic across processes (hash() is seed-randomized)
                 part = zlib.crc32(bytes(key)) % self.num_partitions
@@ -198,8 +200,9 @@ class EmbeddedKafkaConsumer:
         {} — without that, a pipeline polling in a loop busy-spins at 100%
         CPU whenever the topic is drained.
         """
+        from ..runtime.resilience import Deadline
         self._check_open()
-        deadline = time.monotonic() + max(0, timeout_ms) / 1000.0
+        deadline = Deadline(max(0, timeout_ms) / 1000.0)
         while True:
             out: Dict[TopicPartition, List[ConsumerRecord]] = {}
             remaining = int(max_records)
@@ -214,9 +217,9 @@ class EmbeddedKafkaConsumer:
                     self._positions[tp] += len(recs)
                     remaining -= len(recs)
             self._rr += 1
-            if out or time.monotonic() >= deadline:
+            if out or deadline.expired:
                 return out
-            time.sleep(min(0.005, max(0.0005, timeout_ms / 1000.0 / 4)))
+            deadline.pace(min(0.005, max(0.0005, timeout_ms / 1000.0 / 4)))
 
     def close(self) -> None:
         self.closed = True

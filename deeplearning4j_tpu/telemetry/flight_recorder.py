@@ -38,15 +38,65 @@ logger = logging.getLogger(__name__)
 FLIGHT_DIR_ENV = "DL4JTPU_FLIGHT_DIR"
 SCHEMA = "dl4jtpu-flight-v1"
 
-# event kinds the ring records (free-form kinds are allowed too)
-STEP = "step"
-COMPILE = "compile"
-EVICTION = "eviction"
-BUCKET_SHAPE = "bucket_shape"
-STAGED_DISPATCH = "staged_dispatch"
-MEMORY = "memory"
-ANOMALY = "anomaly"
-DUMP = "dump"
+# ---------------------------------------------------------------------------
+# Event-kind registry. Every kind the ring records must be registered here
+# (or via register_event_kind at import time of the owning module) — the
+# DT406 telemetry-schema lint audits record() call sites against this set,
+# and replay tooling treats unregistered kinds as schema drift. record()
+# itself stays permissive at runtime: an unknown kind rings fine, it just
+# fails the static scan until someone declares it.
+_EVENT_KINDS: set = set()
+_EVENT_KINDS_LOCK = threading.Lock()
+
+
+def register_event_kind(kind: str) -> str:
+    """Declare a flight-recorder event kind; returns it (idempotent), so
+    owners can write ``MY_KIND = register_event_kind("my_kind")``."""
+    with _EVENT_KINDS_LOCK:
+        _EVENT_KINDS.add(str(kind))
+    return str(kind)
+
+
+def registered_event_kinds() -> frozenset:
+    with _EVENT_KINDS_LOCK:
+        return frozenset(_EVENT_KINDS)
+
+
+# kinds this module records
+STEP = register_event_kind("step")
+COMPILE = register_event_kind("compile")
+EVICTION = register_event_kind("eviction")
+BUCKET_SHAPE = register_event_kind("bucket_shape")
+STAGED_DISPATCH = register_event_kind("staged_dispatch")
+MEMORY = register_event_kind("memory")
+ANOMALY = register_event_kind("anomaly")
+DUMP = register_event_kind("dump")
+CRASH = register_event_kind("crash")
+
+# kinds owned by the rest of the stack. They live here, in the schema
+# owner, so the DT406 audit (and offline replay tools) can see the full
+# contract without importing jax-heavy modules; a module introducing a NEW
+# kind adds it to its own import-time register_event_kind call AND this
+# table stays the human-readable inventory.
+for _kind in (
+    # runtime/compile_manager.py, telemetry/session.py, analysis
+    "ir_finding",
+    # nn kernel selection + tuned-config auto-apply
+    "kernel_select", "tuned_config_applied",
+    # serving/service.py
+    "serve_dispatch", "serve_swap",
+    # runtime/online.py
+    "online_start", "online_stop", "online_pause", "online_resume",
+    "online_swap", "online_rollback", "online_rollback_skipped",
+    "online_poisoned_span", "online_replay", "online_replay_unsupported",
+    "online_replay_error", "online_source_error", "online_source_reconnect",
+    "online_loop_error",
+    # runtime/resilience.py
+    "resilience_retry", "resilience_giveup", "deadline_expired",
+    "circuit_closed", "circuit_open", "circuit_half_open",
+):
+    register_event_kind(_kind)
+del _kind
 
 
 class FlightRecorder:
@@ -73,6 +123,7 @@ class FlightRecorder:
         self.min_dump_interval_s = float(min_dump_interval_s)
         self.dropped = 0
         self.dumps: List[str] = []
+        self._dump_seq = 0  # filename sequence, reserved under _lock
         self.last_memory_report: Optional[dict] = None
         self._lock = threading.Lock()
         self._events: "collections.deque[dict]" = collections.deque(
@@ -134,7 +185,8 @@ class FlightRecorder:
         if event.kind not in self.auto_dump_kinds:
             return
         now = time.monotonic()
-        last = self._last_dump_t.get(event.kind)
+        with self._lock:
+            last = self._last_dump_t.get(event.kind)
         if last is not None and now - last < self.min_dump_interval_s:
             return
         try:
@@ -148,12 +200,15 @@ class FlightRecorder:
         events = self.events
         if last is not None and last >= 0:
             events = events[-last:]
+        with self._lock:  # dumps/dropped race concurrent dump()/record()
+            dumps = list(self.dumps)
+            dropped = self.dropped
         return {
             "capacity": self.capacity,
             "recorded": len(events),
-            "dropped": self.dropped,
+            "dropped": dropped,
             "events": events,
-            "dumps": list(self.dumps),
+            "dumps": dumps,
         }
 
     def bundle(self, reason: str = "manual") -> dict:
@@ -221,14 +276,22 @@ class FlightRecorder:
             os.makedirs(directory, exist_ok=True)
             safe = "".join(c if c.isalnum() or c in "-_" else "-"
                            for c in str(reason))[:48]
+            # reserve the sequence number atomically — len(self.dumps)
+            # would hand two racing dumps the same filename
+            with self._lock:
+                seq = self._dump_seq
+                self._dump_seq += 1
             path = os.path.join(
                 directory,
                 f"flight_{time.strftime('%Y%m%d-%H%M%S')}_"
-                f"{os.getpid()}_{len(self.dumps)}_{safe}.json")
+                f"{os.getpid()}_{seq}_{safe}.json")
         with open(path, "w", encoding="utf-8") as fh:
             json.dump(bundle, fh, default=str)
-        self._last_dump_t[str(reason)] = time.monotonic()
-        self.dumps.append(path)
+        # publish under the ring lock: snapshot() iterates dumps and
+        # watchdog_sink reads _last_dump_t from other threads
+        with self._lock:
+            self._last_dump_t[str(reason)] = time.monotonic()
+            self.dumps.append(path)
         self.record(DUMP, reason=str(reason), path=path)
         try:
             self._dumps_total.labels(reason=str(reason)).inc()

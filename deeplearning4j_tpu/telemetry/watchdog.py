@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -82,6 +83,9 @@ class Watchdog:
         self.stall_warmup_steps = int(stall_warmup_steps)
         self.events: List[AnomalyEvent] = []
         self._step_times: List[float] = []
+        # guards sinks/events/_step_times: observe() runs on the fetch
+        # thread while add_sink()/emit() arrive from serving/online threads
+        self._lock = threading.Lock()
         reg = registry if registry is not None else get_registry()
         self._anomalies = reg.counter(
             "dl4jtpu_anomalies_total",
@@ -90,15 +94,18 @@ class Watchdog:
         )
 
     def add_sink(self, sink: Callable[[AnomalyEvent], None]) -> None:
-        self.sinks.append(sink)
+        with self._lock:
+            self.sinks.append(sink)
 
     def _emit(self, kind: str, iteration: int, value: float,
               threshold: float, message: str) -> None:
         event = AnomalyEvent(kind=kind, iteration=iteration, value=value,
                              threshold=threshold, message=message)
-        self.events.append(event)
+        with self._lock:
+            self.events.append(event)
+            sinks = list(self.sinks)
         self._anomalies.labels(kind=kind).inc()
-        for sink in self.sinks:
+        for sink in sinks:
             try:
                 sink(event)
             except Exception:  # a broken sink must never kill the train loop
@@ -135,9 +142,12 @@ class Watchdog:
         limit = None
         if self.step_time_limit_s is not None:
             limit = float(self.step_time_limit_s)
-        elif len(self._step_times) >= self.stall_warmup_steps:
-            med = sorted(self._step_times)[len(self._step_times) // 2]
-            limit = med * self.stall_factor
+        else:
+            with self._lock:
+                if len(self._step_times) >= self.stall_warmup_steps:
+                    med = sorted(self._step_times)[
+                        len(self._step_times) // 2]
+                    limit = med * self.stall_factor
         if limit is not None and step_time_s > limit:
             self._emit(
                 STALLED_STEP_TIME, iteration, step_time_s, limit,
@@ -146,6 +156,7 @@ class Watchdog:
             )
         else:
             # stalls don't poison the baseline median
-            self._step_times.append(float(step_time_s))
-            if len(self._step_times) > 256:
-                del self._step_times[0]
+            with self._lock:
+                self._step_times.append(float(step_time_s))
+                if len(self._step_times) > 256:
+                    del self._step_times[0]

@@ -211,7 +211,9 @@ class ChaosSource:
                 self._down_left = max(self._down_left, int(fault.get("polls", 1)))
                 self.outages += 1
             elif fault["fault"] == "source-slow":
-                time.sleep(float(fault.get("seconds", 0.05)))
+                from ..runtime.resilience import Deadline  # noqa: PLC0415
+                seconds = float(fault.get("seconds", 0.05))
+                Deadline(seconds).pace(seconds)
         if self._down_left > 0:
             self._down_left -= 1
             raise ConnectionError("chaos: source outage")

@@ -71,17 +71,18 @@ class EnvScope:
     def set(self, name: str, value) -> None:
         if name not in self._saved:
             self._saved[name] = os.environ.get(name, _MISSING)
+        # EnvScope IS the sanctioned mutation site DT403 points callers at
         if value is None:
-            os.environ.pop(name, None)
+            os.environ.pop(name, None)  # dl4jtpu: ignore[DT403]
         else:
-            os.environ[name] = str(value)
+            os.environ[name] = str(value)  # dl4jtpu: ignore[DT403]
 
     def restore(self) -> None:
         for name, prior in self._saved.items():
             if prior is _MISSING:
-                os.environ.pop(name, None)
+                os.environ.pop(name, None)  # dl4jtpu: ignore[DT403]
             else:
-                os.environ[name] = prior
+                os.environ[name] = prior  # dl4jtpu: ignore[DT403]
         self._saved.clear()
 
     def __enter__(self) -> "EnvScope":

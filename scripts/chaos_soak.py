@@ -108,6 +108,7 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
     from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
     from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
     from deeplearning4j_tpu.runtime.online import OnlineTrainer
+    from deeplearning4j_tpu.runtime.resilience import Deadline
     from deeplearning4j_tpu.serving import InferenceService
     from deeplearning4j_tpu.streaming import QueueSource, ReplayBufferSource
     from deeplearning4j_tpu.testing.chaos import ChaosSource
@@ -182,12 +183,12 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
                  for _ in range(slow_consumers)]
 
     def wait_for(pred, seconds):
-        end = time.monotonic() + seconds
-        while time.monotonic() < end:
+        d = Deadline(seconds)
+        while True:
             if pred():
                 return True
-            time.sleep(0.05)
-        return False
+            if not d.pace(0.05):
+                return False
 
     t_start = time.monotonic()
     for _ in range(warm):
@@ -211,7 +212,7 @@ def _run_soak_inner(records, batch, stage, feature_dim, classes, hidden,
         produced += 1
         n += 1
         if n % 512 == 0:
-            time.sleep(0.05)  # producer jitter: forces ragged tails
+            Deadline(0.05).pace(0.05)  # producer jitter: forces ragged tails
     assert wait_for(
         lambda: (trainer.stats()["records_total"] >= produced
                  or not trainer.alive),

@@ -32,19 +32,45 @@ env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis \
     deeplearning4j_tpu/parallel/roles.py \
     deeplearning4j_tpu/parallel/ring_attention.py \
     deeplearning4j_tpu/analysis/shard_flow.py \
+    deeplearning4j_tpu/analysis/concurrency.py \
+    deeplearning4j_tpu/analysis/runtime_checks.py \
     deeplearning4j_tpu/tune/ \
     --fail-on warning
 
-echo "== dl4jtpu-check: no bespoke retry sleeps outside runtime/resilience.py"
-# Failure handling must flow through the shared typed policies; a raw
-# time.sleep in a fleet/online/checkpoint retry loop is a regression.
-if grep -nE 'time\.sleep\(' \
-    deeplearning4j_tpu/fleet/*.py \
-    deeplearning4j_tpu/runtime/online.py \
-    deeplearning4j_tpu/runtime/checkpoint.py; then
-    echo "FAIL: bespoke time.sleep in a failure-handling module — use" \
-         "RetryPolicy/Deadline from deeplearning4j_tpu/runtime/resilience.py" >&2
-    exit 1
+echo "== dl4jtpu-check: DT4xx runtime-guard self-scan (serving/fleet/runtime/telemetry/streaming, --fail-on warning)"
+# The concurrency/env/telemetry tier applied to the threaded stack it was
+# built for: races (DT400), blocking-under-lock (DT401), lock-order
+# inversions (DT402), raw environ writes (DT403), bare sleeps (DT404),
+# trace-unsafe handler mutations (DT405), metric/event schema drift
+# (DT406). Every pragma in these trees carries its justification inline.
+if env JAX_PLATFORMS=cpu python -c 'import deeplearning4j_tpu.analysis.concurrency' 2>/dev/null; then
+    env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis --concurrency \
+        deeplearning4j_tpu/serving/ \
+        deeplearning4j_tpu/fleet/ \
+        deeplearning4j_tpu/runtime/ \
+        deeplearning4j_tpu/telemetry/ \
+        deeplearning4j_tpu/streaming/ \
+        --fail-on warning
+
+    echo "== dl4jtpu-check: full-tree DT406 telemetry-schema audit"
+    # Metric declarations and flight-recorder event kinds live all over the
+    # tree, not just the five runtime dirs — schema drift is global.
+    env JAX_PLATFORMS=cpu python -m deeplearning4j_tpu.analysis --concurrency \
+        deeplearning4j_tpu/ \
+        --ignore DT400,DT401,DT402,DT403,DT404,DT405 \
+        --fail-on warning
+else
+    # bootstrap fallback: if the analyzer itself can't import (mid-rebase,
+    # broken deps), keep at least the original grep gate on retry sleeps
+    echo "== dl4jtpu-check: DT4xx unavailable; falling back to sleep grep gate"
+    if grep -nE 'time\.sleep\(' \
+        deeplearning4j_tpu/fleet/*.py \
+        deeplearning4j_tpu/runtime/online.py \
+        deeplearning4j_tpu/runtime/checkpoint.py; then
+        echo "FAIL: bespoke time.sleep in a failure-handling module — use" \
+             "RetryPolicy/Deadline from deeplearning4j_tpu/runtime/resilience.py" >&2
+        exit 1
+    fi
 fi
 
 echo "== dl4jtpu-irlint: IR self-scan of the repo's own step functions (--fail-on warning)"
@@ -646,6 +672,7 @@ from deeplearning4j_tpu import (
 )
 from deeplearning4j_tpu.fleet import FleetRouter, build_bundle, save_bundle
 from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+from deeplearning4j_tpu.runtime.resilience import Deadline
 
 with tempfile.TemporaryDirectory() as work:
     net = MultiLayerNetwork(MultiLayerConfiguration(
@@ -694,23 +721,24 @@ with tempfile.TemporaryDirectory() as work:
                 x = rng.normal(size=8).astype(np.float32)
                 y = np.eye(4, dtype=np.float32)[int(np.argmax(x @ w))]
                 source.put(x, y)
-            deadline = time.monotonic() + 60
-            while (time.monotonic() < deadline
-                   and trainer.stats()["steps_total"] < 1):
-                time.sleep(0.05)
+            deadline = Deadline(60)
+            while (trainer.stats()["steps_total"] < 1
+                   and deadline.pace(0.05)):
+                pass
             assert trainer.stats()["steps_total"] >= 1
             v2 = trainer.checkpoint_now(swap=False)
         finally:
             trainer.stop(checkpoint=False)
         assert v2 == 2, v2
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
+        deadline = Deadline(60)
+        while True:
             stats = router.stats()
             if stats["rollouts"] >= 1 and all(
                     w["version"] == 2 for w in stats["workers"]
                     if w["ready"]):
                 break
-            time.sleep(0.1)
+            if not deadline.pace(0.1):
+                break
         stats = router.stats()
         assert stats["rollouts"] >= 1, stats
         assert all(w["version"] == 2 for w in stats["workers"]
@@ -725,12 +753,13 @@ with tempfile.TemporaryDirectory() as work:
         # SIGKILL one worker -> the supervisor respawns it warm at v2
         victim = router.workers[0]
         os.kill(victim.proc.pid, signal.SIGKILL)
-        deadline = time.monotonic() + 90
-        while time.monotonic() < deadline:
+        deadline = Deadline(90)
+        while True:
             snap = router.stats()["workers"][0]
             if snap["ready"] and snap["respawns"] >= 1:
                 break
-            time.sleep(0.2)
+            if not deadline.pace(0.2):
+                break
         snap = router.stats()["workers"][0]
         assert snap["ready"] and snap["respawns"] >= 1, snap
         assert snap["version"] == 2, snap
@@ -781,6 +810,7 @@ from deeplearning4j_tpu import (
 from deeplearning4j_tpu.fleet import FleetRouter, build_bundle, save_bundle
 from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
 from deeplearning4j_tpu.runtime.online import OnlineTrainer
+from deeplearning4j_tpu.runtime.resilience import Deadline
 from deeplearning4j_tpu.streaming import QueueSource, ReplayBufferSource
 from deeplearning4j_tpu.testing.chaos import ChaosSource, FaultPlan
 from deeplearning4j_tpu.tune import scoped_env
@@ -789,12 +819,12 @@ SEED = 1405
 
 
 def wait_for(pred, seconds, what):
-    end = time.monotonic() + seconds
-    while time.monotonic() < end:
+    d = Deadline(seconds)
+    while True:
         if pred():
             return
-        time.sleep(0.1)
-    raise AssertionError(f"chaos self-scan: {what} never happened")
+        if not d.pace(0.1):
+            raise AssertionError(f"chaos self-scan: {what} never happened")
 
 
 with tempfile.TemporaryDirectory() as work:
