@@ -29,9 +29,22 @@ touches jax. It owns three loops:
 
 ``/api/fleet`` aggregates per-worker liveness/version/queue depth and
 merges the workers' bounded latency rings into EXACT fleet-wide
-p50/p99; ``/metrics`` exposes the router's own ``dl4jtpu_fleet_*``
-series. In-process routers register process-globally
-(:func:`get_fleet_routers`) so ``ui/server.py`` can surface them.
+p50/p99 (rings from dead/stale workers are excluded and counted in
+``dl4jtpu_fleet_stale_rings_total``); ``/metrics`` exposes the
+router's own ``dl4jtpu_fleet_*`` series. In-process routers register
+process-globally (:func:`get_fleet_routers`) so ``ui/server.py`` can
+surface them.
+
+**Tracing** (docs/observability.md § Distributed tracing): POST
+``/predict`` adopts an ``x-dl4jtpu-trace`` header or mints a
+head-sampled root context, opens the ``fleet.request`` root span, and
+forwards a sibling ``fleet.attempt`` context to each tried worker —
+the response always carries ``x-dl4jtpu-trace-id``. ``GET
+/api/trace/<trace_id>`` merges the router's spans with every live
+worker's into one Chrome-trace document, splicing
+rollout/respawn/swap events as instants; ``GET /api/slo`` exposes the
+router-level burn rates (objectives are env-opt-in via
+``DL4JTPU_SLO_*``).
 """
 
 from __future__ import annotations
@@ -51,6 +64,13 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..runtime.resilience import Deadline, DeadlinePolicy, RetryPolicy
+from ..telemetry.tracing import (
+    TRACE_HEADER,
+    TraceContext,
+    get_trace_ring,
+    record_trace_event,
+    trace_span,
+)
 from ..utils.subproc import forced_cpu_env
 from .worker import READY_SENTINEL
 
@@ -58,6 +78,16 @@ __all__ = ["FleetRouter", "get_fleet_routers", "main"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _flight(kind: str, **payload) -> None:
+    """Best-effort flight-recorder event — never raises."""
+    try:
+        from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+        get_flight_recorder().record(kind, **payload)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _percentile(values, q: float):
@@ -86,6 +116,7 @@ class WorkerHandle:
         self.next_spawn_at = 0.0
         self.latency_samples: List[float] = []
         self.last_health: dict = {}
+        self.last_seen = 0.0  # monotonic ts of the last healthy probe
         self.lock = threading.Lock()
 
     def snapshot(self) -> dict:
@@ -196,6 +227,23 @@ class FleetRouter:
             "dl4jtpu_fleet_workers_alive", "live, ready fleet workers")
         self._m_version = registry.gauge(
             "dl4jtpu_fleet_version", "fleet-wide target serving version")
+        self._m_stale_rings = registry.counter(
+            "dl4jtpu_fleet_stale_rings_total",
+            "worker latency rings excluded from fleet percentiles because "
+            "the worker's last heartbeat predates the scrape")
+        # router-level SLOs are env-opt-in, same contract as the service
+        try:
+            from ..telemetry import slo as _slo  # noqa: PLC0415
+
+            if any(os.environ.get(k) for k in (
+                    _slo.SLO_LATENCY_BUDGET_ENV,
+                    _slo.SLO_LATENCY_TARGET_ENV,
+                    _slo.SLO_AVAILABILITY_TARGET_ENV)):
+                _slo.get_slo_monitor().declare_from_env(
+                    self.model, latency_budget_ms=self.worker_args.get(
+                        "latency_budget_ms"))
+        except Exception:  # noqa: BLE001 - observability never blocks ctor
+            pass
 
     # ------------------------------------------------------------ spawn
     def _spawn_env(self) -> dict:
@@ -362,6 +410,7 @@ class FleetRouter:
                     handle.queue_depth = int(health.get("queue_depth") or 0)
                     handle.latency_samples = list(
                         health.get("latency_samples") or [])
+                    handle.last_seen = time.monotonic()
         if dead and handle.alive:
             with handle.lock:
                 handle.alive = False
@@ -375,6 +424,8 @@ class FleetRouter:
                 handle.respawns += 1
                 self._m_respawns.labels(reason=cause).inc()
                 self.respawn_policy.record_success()
+                _flight("fleet_respawn", worker=handle.wid, reason=cause,
+                        port=handle.port, version=handle.version)
             else:
                 with handle.lock:
                     self._backoff(handle)
@@ -411,6 +462,8 @@ class FleetRouter:
                     swapped = json.loads(resp.read())
                 with handle.lock:
                     handle.version = int(swapped["version"])
+                _flight("fleet_rollout", worker=handle.wid,
+                        version=int(swapped["version"]), port=handle.port)
             except Exception:  # noqa: BLE001 - converge via respawn
                 if handle.proc is not None and handle.proc.poll() is None:
                     handle.proc.kill()
@@ -425,15 +478,26 @@ class FleetRouter:
             return None
         return min(ready, key=lambda h: h.outstanding)
 
-    def route_predict(self, payload: dict) -> tuple:
+    def route_predict(self, payload: dict, trace=None) -> tuple:
         """Returns (http_status, body dict, headers dict). The one
         failover retry on a dead worker routes through the shared
         ``fleet.router.failover`` RetryPolicy (max_attempts=2, no
-        backoff — a second worker is tried immediately)."""
+        backoff — a second worker is tried immediately).
+
+        ``trace`` is the request's root :class:`TraceContext` (minted or
+        propagated by the HTTP front). Each routing attempt opens a
+        SIBLING ``fleet.attempt`` span under it and forwards its context
+        to the picked worker via the ``x-dl4jtpu-trace`` header, so a
+        failover shows up as two attempt spans with distinct workers
+        under one request. Sheds and errors upgrade the sample decision
+        so every degraded request is traced end-to-end from this hop on.
+        """
         if self._draining:
             return 503, {"error": "fleet draining"}, {}
+        attempt_no = [0]
 
         def attempt():
+            attempt_no[0] += 1
             handle = self._pick()
             if handle is None:
                 raise _NoWorker("no ready worker")
@@ -443,21 +507,42 @@ class FleetRouter:
                     self.shed_total += 1
                 self._m_shed.inc()
                 retry = round(max(0.05, 0.01 * handle.outstanding), 3)
+                if trace is not None:
+                    trace.upgrade("shed:fleet_saturated")
+                    record_trace_event(
+                        trace.child(), "fleet.shed", worker=handle.wid,
+                        reason="fleet_saturated", retry_after_s=retry)
+                self._observe_slo(shed=True, trace=trace)
                 return (429, {"error": "fleet saturated",
                               "retry_after_s": retry},
                         {"Retry-After": f"{retry:.3f}"})
             with handle.lock:
                 handle.outstanding += 1
+            # sibling span per attempt: same parent (the fleet.request
+            # span), fresh span_id — the worker parents under it
+            a_ctx = trace.child() if trace is not None else None
+            t0 = time.perf_counter()
+            ts_us = time.time() * 1e6
             try:
                 body = json.dumps(payload).encode()
+                headers_out = {"Content-Type": "application/json"}
+                if a_ctx is not None:
+                    headers_out[TRACE_HEADER] = a_ctx.to_header()
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{handle.port}/predict", body,
-                    {"Content-Type": "application/json"})
+                    headers_out)
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     out = json.loads(resp.read())
                 with self._stats_lock:
                     self.requests_total += 1
                 self._m_requests.inc()
+                elapsed = time.perf_counter() - t0
+                if a_ctx is not None and a_ctx.sampled:
+                    record_trace_event(
+                        a_ctx, "fleet.attempt", duration_s=elapsed,
+                        ts_us=ts_us, worker=handle.wid, port=handle.port,
+                        attempt=attempt_no[0], status=200)
+                self._observe_slo(latency_s=elapsed, trace=a_ctx)
                 return 200, out, {}
             except urllib.error.HTTPError as e:
                 detail = {}
@@ -472,9 +557,17 @@ class FleetRouter:
                     headers = {}
                     if e.headers.get("Retry-After"):
                         headers["Retry-After"] = e.headers["Retry-After"]
+                    if trace is not None:
+                        trace.upgrade("shed:worker")
+                        record_trace_event(
+                            trace.child(), "fleet.shed", worker=handle.wid,
+                            reason="worker_shed", attempt=attempt_no[0])
+                    self._observe_slo(shed=True, trace=trace)
                     return 429, detail or {"error": "worker shed"}, headers
                 if e.code in (400, 404):
                     return e.code, detail or {"error": str(e)}, {}
+                self._trace_attempt_error(a_ctx, handle, attempt_no[0],
+                                          t0, ts_us, e)
                 raise _WorkerFailed(detail.get("error", str(e))) from e
             except _WorkerFailed:
                 raise
@@ -482,6 +575,8 @@ class FleetRouter:
                 with handle.lock:
                     handle.alive = False
                     handle.ready = False
+                self._trace_attempt_error(a_ctx, handle, attempt_no[0],
+                                          t0, ts_us, e)
                 raise _WorkerFailed(str(e)) from e
             finally:
                 with handle.lock:
@@ -492,22 +587,72 @@ class FleetRouter:
         except _NoWorker as e:
             with self._stats_lock:
                 self.failed_total += 1
+            self._observe_slo(error=True, trace=trace)
             return 503, {"error": f"no worker served the request ({e})"}, {}
         except Exception as e:  # noqa: BLE001 - RetryError wraps the cause
             with self._stats_lock:
                 self.failed_total += 1
+            self._observe_slo(error=True, trace=trace)
             cause = getattr(e, "last", e)
             return 503, {"error": f"no worker served the request "
                                   f"({cause})"}, {}
 
+    def _trace_attempt_error(self, a_ctx, handle, attempt, t0, ts_us,
+                             exc) -> None:
+        """Failed attempt span — upgrades the sample decision first so
+        the error span (and the failover sibling that follows) records."""
+        if a_ctx is None:
+            return
+        a_ctx.upgrade("error:worker_failed")
+        record_trace_event(
+            a_ctx, "fleet.attempt", duration_s=time.perf_counter() - t0,
+            ts_us=ts_us, worker=handle.wid, port=handle.port,
+            attempt=attempt, error=repr(exc)[:200])
+
+    def _observe_slo(self, *, latency_s=None, shed=False, error=False,
+                     trace=None) -> None:
+        """Feed the router-level SLO monitor (no-op unless the model was
+        declared — declaration is env-opt-in in ``__init__``)."""
+        try:
+            from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+
+            mon = get_slo_monitor()
+            if mon.objectives(self.model) is None:
+                return
+            tid = (trace.trace_id
+                   if trace is not None and getattr(trace, "sampled", False)
+                   else None)
+            mon.observe(self.model, latency_s=latency_s, shed=shed,
+                        error=error, trace_id=tid)
+            mon.maybe_evaluate()
+        except Exception:  # noqa: BLE001 - observability never fails routing
+            pass
+
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         """The /api/fleet payload: per-worker liveness + merged EXACT
-        percentiles over every worker's bounded latency ring."""
+        percentiles over every worker's bounded latency ring.
+
+        A dead worker's handle still holds the ring from its last healthy
+        probe; merging it would freeze stale samples into fleet p50/p99
+        long after the worker stopped serving. Rings whose last heartbeat
+        predates the scrape by more than ~5 poll intervals (or whose
+        worker is down) are excluded and counted in
+        ``dl4jtpu_fleet_stale_rings_total``."""
         merged: List[float] = []
+        stale_cutoff = max(5.0 * self.poll_s, 2.0)
+        now = time.monotonic()
+        stale_rings = 0
         for handle in self.workers:
             with handle.lock:  # _check_worker swaps the ring concurrently
-                merged.extend(handle.latency_samples)
+                fresh = (handle.ready and handle.alive
+                         and now - handle.last_seen <= stale_cutoff)
+                if fresh:
+                    merged.extend(handle.latency_samples)
+                elif handle.latency_samples:
+                    stale_rings += 1
+        if stale_rings:
+            self._m_stale_rings.inc(stale_rings)
         return {
             "store": self.store_dir,
             "model": self.model,
@@ -522,6 +667,68 @@ class FleetRouter:
                 "p50": _percentile(merged, 50),
                 "p99": _percentile(merged, 99),
                 "samples": len(merged),
+            },
+        }
+
+    # ------------------------------------------------------------ trace
+    def trace_merged(self, trace_id: str) -> dict:
+        """The ``GET /api/trace/<trace_id>`` payload: one Chrome/Perfetto
+        trace document merging the router's own spans with every live
+        worker's spans for the trace, plus rollout/respawn/swap flight
+        events inside the covered interval spliced as instant events
+        (``ph:"i"``) so an operator sees a request straddling a version
+        swap in one timeline."""
+        events = list(get_trace_ring().spans_for(trace_id))
+        worker_docs = []
+        swap_events: List[dict] = []
+        for handle in self.workers:
+            if not handle.ready or handle.port is None:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{handle.port}/api/trace/"
+                        f"{trace_id}", timeout=10) as resp:
+                    doc = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - a dead worker loses its spans
+                continue
+            spans = doc.get("spans") or []
+            events.extend(spans)
+            swap_events.extend(doc.get("swap_events") or [])
+            worker_docs.append({"id": handle.wid, "pid": doc.get("pid"),
+                                "port": handle.port,
+                                "spans": len(spans)})
+        # splice fleet + worker lifecycle flight events that fall inside
+        # the trace's covered interval (with a small margin) as instants
+        if events:
+            lo = min(e.get("ts", 0.0) for e in events)
+            hi = max(e.get("ts", 0.0) + e.get("dur", 0.0) for e in events)
+            margin_us = 1e6  # 1s either side catches the triggering swap
+            try:
+                from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+                fleet_events = [
+                    e for e in get_flight_recorder().events
+                    if e.get("kind") in ("fleet_rollout", "fleet_respawn")]
+            except Exception:  # noqa: BLE001
+                fleet_events = []
+            for ev in fleet_events + swap_events:
+                ts_us = float(ev.get("ts", 0.0)) * 1e6
+                if lo - margin_us <= ts_us <= hi + margin_us:
+                    events.append({
+                        "name": ev.get("kind", "event"), "ph": "i",
+                        "ts": ts_us, "pid": ev.get("pid", os.getpid()),
+                        "tid": 0, "s": "g",
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ts", "kind")}})
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "trace_id": trace_id,
+                "model": self.model,
+                "router_pid": os.getpid(),
+                "workers": worker_docs,
             },
         }
 
@@ -596,6 +803,12 @@ class FleetRouter:
                 elif self.path == "/api/resilience":
                     from ..runtime.resilience import resilience_stats  # noqa: PLC0415
                     self._send(200, resilience_stats())
+                elif self.path.startswith("/api/trace/"):
+                    trace_id = self.path.rsplit("/", 1)[-1]
+                    self._send(200, router.trace_merged(trace_id))
+                elif self.path == "/api/slo":
+                    from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+                    self._send(200, get_slo_monitor().stats())
                 elif self.path == "/metrics":
                     self._send(200,
                                router.registry.prometheus_text().encode(),
@@ -615,7 +828,24 @@ class FleetRouter:
                     self._send(400, {"error": "invalid JSON body"})
                     return
                 if self.path == "/predict":
-                    code, body, headers = router.route_predict(payload)
+                    # the fleet front is where a trace is born: adopt an
+                    # incoming context or mint a head-sampled root, open
+                    # the fleet.request root span, and always hand the
+                    # trace id back so clients can fetch the merged trace
+                    ctx = TraceContext.from_header(
+                        self.headers.get(TRACE_HEADER))
+                    if ctx is None:
+                        ctx = TraceContext.new(
+                            baggage={"model": router.model})
+                    with trace_span(ctx, "fleet.request",
+                                    model=router.model) as sp:
+                        code, body, headers = router.route_predict(
+                            payload, trace=sp.ctx if sp.ctx is not None
+                            else ctx)
+                    headers = dict(headers or {})
+                    headers["x-dl4jtpu-trace-id"] = ctx.trace_id
+                    headers["x-dl4jtpu-trace-sampled"] = (
+                        "1" if ctx.sampled else "0")
                     self._send(code, body, headers=headers)
                 elif self.path == "/rollout":
                     version = payload.get(

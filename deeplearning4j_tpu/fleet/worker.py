@@ -24,7 +24,10 @@ Lifecycle contract (what the router and the tests rely on):
 HTTP endpoints: POST ``/predict`` ``{features, argmax?}`` → ``{output |
 classes, version}`` (429 + Retry-After when admission sheds, 503 while
 draining/not ready), POST ``/swap`` ``{version?}``, POST ``/drain``,
-GET ``/healthz``, GET ``/metrics``, GET ``/api/worker``.
+GET ``/healthz``, GET ``/metrics`` (exemplar-carrying), GET
+``/api/worker``, GET ``/api/trace/<trace_id>`` (this process's spans for
+one distributed trace), GET ``/api/slo``. POST ``/predict`` honors the
+``x-dl4jtpu-trace`` context header (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -175,6 +178,15 @@ class FleetWorker:
         if self.watch:
             threading.Thread(target=self._watch_loop, daemon=True,
                              name="dl4jtpu-fleet-watch").start()
+        try:
+            # traces minted or continued in this process carry the served
+            # model + checkpoint version as baggage
+            from ..telemetry.tracing import set_default_baggage  # noqa: PLC0415
+
+            set_default_baggage("model", self.model)
+            set_default_baggage("checkpoint_version", str(self.version))
+        except Exception:  # noqa: BLE001 - observability never blocks boot
+            pass
         self.compiles_at_ready = self._counter.count
         self.ready = True
         return self
@@ -197,6 +209,12 @@ class FleetWorker:
                 self.model, params=self._loader.params,
                 state=self._loader.state, version=target)
             self.version = target
+            try:
+                from ..telemetry.tracing import set_default_baggage  # noqa: PLC0415
+
+                set_default_baggage("checkpoint_version", str(target))
+            except Exception:  # noqa: BLE001
+                pass
             return target
 
     def _watch_loop(self) -> None:
@@ -268,15 +286,30 @@ class FleetWorker:
         samples = list(entry.latencies)[-cap:]
         return [round(s, 6) for s in samples]
 
-    def predict_payload(self, payload: dict) -> dict:
+    def predict_payload(self, payload: dict, trace=None) -> dict:
         features = np.asarray(payload["features"], np.float32)
         argmax = bool(payload.get("argmax", False))
         version = self.version  # pre-dispatch tag; body proves the params
-        out = self.service.predict(self.model, features, argmax=argmax)
+        out = self.service.predict(self.model, features, argmax=argmax,
+                                   trace=trace)
         with self._stats_lock:
             self.requests_total += 1
         key = "classes" if argmax else "output"
         return {key: np.asarray(out).tolist(), "version": version}
+
+    def trace_payload(self, trace_id: str) -> dict:
+        """This process's view of one trace: matching spans from the local
+        ring plus swap flight events (the router splices those into the
+        merged trace as instant events)."""
+        from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+        from ..telemetry.tracing import get_trace_ring  # noqa: PLC0415
+
+        spans = get_trace_ring().spans_for(trace_id)
+        swap_events = [e for e in get_flight_recorder().events
+                       if e.get("kind") in ("serve_swap", "online_swap")]
+        return {"trace_id": trace_id, "pid": os.getpid(),
+                "port": self.port, "model": self.model,
+                "spans": spans, "swap_events": swap_events}
 
     def _make_handler(self):
         worker = self
@@ -336,6 +369,12 @@ class FleetWorker:
                     body = worker.healthz()
                     body["service"] = worker.service.stats()
                     self._send(200, body)
+                elif self.path.startswith("/api/trace/"):
+                    self._send(200, worker.trace_payload(
+                        self.path.rsplit("/", 1)[-1]))
+                elif self.path == "/api/slo":
+                    from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+                    self._send(200, get_slo_monitor().stats())
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -354,8 +393,22 @@ class FleetWorker:
                     if not worker.ready:
                         self._send(503, {"error": "not ready"})
                         return
+                    from ..telemetry.tracing import (  # noqa: PLC0415
+                        TRACE_HEADER, TraceContext, trace_span)
+
+                    ctx = TraceContext.from_header(
+                        self.headers.get(TRACE_HEADER))
                     try:
-                        self._send(200, worker.predict_payload(payload))
+                        if ctx is not None and ctx.sampled:
+                            with trace_span(ctx, "worker.predict",
+                                            model=worker.model,
+                                            version=worker.version,
+                                            port=worker.port) as sp:
+                                body = worker.predict_payload(
+                                    payload, trace=sp.ctx)
+                        else:
+                            body = worker.predict_payload(payload, trace=ctx)
+                        self._send(200, body)
                     except ServiceDraining as e:
                         self._send(503, {"error": str(e),
                                          "draining": True})

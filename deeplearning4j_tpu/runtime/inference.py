@@ -49,9 +49,12 @@ compile guarantee under mixed request shapes.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+from ..telemetry.tracing import current_trace, record_trace_event
 
 __all__ = [
     "fast_path_enabled",
@@ -211,6 +214,40 @@ def _layout_put_tree(layout, tree, rows: Optional[int] = None):
         lambda a: _layout_put(layout, a, rows), tree)
 
 
+def _traced_call(cm, kind: str, key, build, args, rows=None,
+                 bucket_rows=None):
+    """``cm.aot`` + execute, recording an ``infer.dispatch`` span when the
+    dispatching thread carries a sampled trace (the batcher installs the
+    batch's context around dispatch). The span annotates compile-cache
+    behavior via before/after counter deltas — a warm request shows
+    ``compiles=0, cache_hit=true``, the proof the zero-warm-compile
+    invariant holds under tracing."""
+    ctx = current_trace()
+    if ctx is None or not ctx.sampled:
+        compiled = cm.aot(key, build, args)
+        return compiled(*args)
+    t0 = time.perf_counter()
+    ts_us = time.time() * 1e6
+    c0, h0 = cm.compiles.value, cm.cache_hits.value
+    try:
+        compiled = cm.aot(key, build, args)
+        out = compiled(*args)
+    except Exception as e:
+        record_trace_event(ctx.child(), "infer.dispatch",
+                           duration_s=time.perf_counter() - t0,
+                           ts_us=ts_us, kind=kind,
+                           error=f"{type(e).__name__}: {e}"[:200])
+        raise
+    record_trace_event(
+        ctx.child(), "infer.dispatch",
+        duration_s=time.perf_counter() - t0, ts_us=ts_us, kind=kind,
+        rows=None if rows is None else int(rows),
+        bucket_rows=None if bucket_rows is None else int(bucket_rows),
+        compiles=int(cm.compiles.value - c0),
+        cache_hit=bool(cm.cache_hits.value - h0 > 0))
+    return out
+
+
 # ------------------------------------------------------------ MultiLayer
 def mln_output(net, x, features_mask=None, argmax: bool = False) -> np.ndarray:
     """Bucketed AOT forward for :class:`MultiLayerNetwork`. With ``argmax``
@@ -248,8 +285,9 @@ def mln_output(net, x, features_mask=None, argmax: bool = False) -> np.ndarray:
 
         return jax.jit(fn, donate_argnums=_donate(2, 3))
 
-    compiled = cm.aot(key, build, args)
-    return _slice_output(compiled(*args), b, t, target_t, argmax=argmax)
+    out = _traced_call(cm, "mln_infer", key, build, args,
+                       rows=b, bucket_rows=target_b)
+    return _slice_output(out, b, t, target_t, argmax=argmax)
 
 
 def mln_rnn_step(net, x, features_mask=None):
@@ -296,8 +334,8 @@ def mln_rnn_step(net, x, features_mask=None):
 
         return jax.jit(fn, donate_argnums=_donate(2, 3))
 
-    compiled = cm.aot(key, build, args)
-    out, net._rnn_state = compiled(*args)
+    out, net._rnn_state = _traced_call(cm, "mln_rnn_step", key, build,
+                                       args, rows=b)
     res = _slice_output(out, b, t, target_t)
     if single_step and res.ndim == 3:
         res = res[:, 0, :]
@@ -383,8 +421,8 @@ def graph_output(net, inputs, masks=None, argmax: bool = False):
 
         return jax.jit(fn, donate_argnums=_donate(2, 3))
 
-    compiled = cm.aot(key, build, args)
-    outs = compiled(*args)
+    outs = _traced_call(cm, "graph_infer", key, build, args,
+                        rows=b, bucket_rows=target_b)
     # per-output time cut: outputs follow their driving input's time bucket
     # only when shapes say so; (t, target_t) of input 0 is the best witness
     t0, tt0 = times[0] if times else (None, None)
@@ -426,8 +464,8 @@ def graph_rnn_step(net, inputs, features_masks=None):
 
         return jax.jit(fn, donate_argnums=_donate(2, 3))
 
-    compiled = cm.aot(key, build, args)
-    outs, net._rnn_state = compiled(*args)
+    outs, net._rnn_state = _traced_call(cm, "graph_rnn_step", key, build,
+                                        args, rows=b)
     t0, tt0 = times[0] if times else (None, None)
     res = [_slice_output(o, b, t0, tt0) for o in outs]
     if single_step:

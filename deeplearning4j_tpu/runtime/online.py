@@ -388,6 +388,15 @@ class OnlineTrainer:
         self.flight.record("online_swap", trainer=self.name,
                            model=self._serve_name, version=int(version),
                            iteration=int(self.net.iteration))
+        try:
+            # every trace minted in this process from now on carries the
+            # serving checkpoint version in its baggage — a request that
+            # straddles a swap is attributable to the version it actually ran
+            from ..telemetry.tracing import set_default_baggage  # noqa: PLC0415
+
+            set_default_baggage("checkpoint_version", str(int(version)))
+        except Exception:  # observability must never fail a swap
+            pass
 
     # ---------------------------------------------------------- checkpoints
     def checkpoint_now(self, swap: Optional[bool] = None,

@@ -88,6 +88,15 @@ def _flight(kind: str, **payload) -> None:
         pass
 
 
+def _current_trace():
+    """Best-effort read of the thread's trace context — never raises."""
+    try:
+        from ..telemetry.tracing import current_trace  # noqa: PLC0415
+        return current_trace()
+    except Exception:
+        return None
+
+
 # --------------------------------------------------------------- site registry
 
 _SITES: Dict[str, Any] = {}
@@ -232,9 +241,15 @@ class RetryPolicy:
         is set / ``deadline`` expires between attempts).
         """
         waiter = stop if stop is not None else threading.Event()
+        # read the caller's trace ONCE: re-executions of the body (possibly
+        # after another thread mutated thread-local state) must all parent
+        # under the SAME span, each attempt a child — a retry storm reads
+        # as N sibling resilience.attempt spans, not a lost parent
+        parent = _current_trace()
         attempt = 0
         while True:
             attempt += 1
+            t0 = time.perf_counter()
             try:
                 result = fn(*args, **kwargs)
             except self.retry_on as e:
@@ -250,16 +265,42 @@ class RetryPolicy:
                     self._m_giveups.inc()
                     _flight("resilience_giveup", site=self.name,
                             attempts=attempt, error=repr(e))
+                    self._attempt_span(parent, attempt, t0, backoff_s=0.0,
+                                       error=repr(e), giveup=True)
                     raise RetryError(self.name, attempt, e) from e
                 pause = self.record_failure(error=e, key=key, attempt=attempt)
                 if deadline is not None:
                     pause = min(pause, max(0.0, deadline.remaining()))
                 _flight("resilience_retry", site=self.name, attempt=attempt,
                         backoff_s=round(pause, 4), error=repr(e))
+                self._attempt_span(parent, attempt, t0,
+                                   backoff_s=round(pause, 4), error=repr(e))
                 waiter.wait(pause)
             else:
                 self.record_success()
+                self._attempt_span(parent, attempt, t0, backoff_s=0.0)
                 return result
+
+    def _attempt_span(self, parent, attempt: int, t0: float,
+                      backoff_s: float, error: Optional[str] = None,
+                      giveup: bool = False) -> None:
+        """Record one ``resilience.attempt`` child span (sampled traces
+        only; never raises — observability must not fail the retry loop)."""
+        if parent is None or not getattr(parent, "sampled", False):
+            return
+        try:
+            from ..telemetry.tracing import record_trace_event  # noqa: PLC0415
+
+            args = {"site": self.name, "attempt": int(attempt),
+                    "backoff_s": float(backoff_s)}
+            if error is not None:
+                args["error"] = error[:200]
+            if giveup:
+                args["giveup"] = True
+            record_trace_event(parent.child(), "resilience.attempt",
+                               duration_s=time.perf_counter() - t0, **args)
+        except Exception:  # pragma: no cover - defensive
+            pass
 
     def stats(self) -> dict:
         with self._lock:

@@ -23,6 +23,7 @@ Semantics:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -31,6 +32,8 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
+
+from ..telemetry.tracing import record_trace_event, use_trace
 
 __all__ = ["MicroBatcher", "MAX_DELAY_ENV", "MAX_BATCH_ENV"]
 
@@ -41,6 +44,8 @@ MAX_BATCH_ENV = "DL4JTPU_SERVE_MAX_BATCH"
 _DEFAULT_DELAY_MS = 2.0
 _DEFAULT_MAX_BATCH = 64
 
+_NULL_CM = contextlib.nullcontext()
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -50,12 +55,13 @@ def _env_float(name: str, default: float) -> float:
 
 
 class _Request:
-    __slots__ = ("features", "future", "enqueued")
+    __slots__ = ("features", "future", "enqueued", "trace")
 
-    def __init__(self, features: np.ndarray):
+    def __init__(self, features: np.ndarray, trace=None):
         self.features = features
         self.future: "Future[np.ndarray]" = Future()
         self.enqueued = time.perf_counter()
+        self.trace = trace  # Optional[telemetry.tracing.TraceContext]
 
 
 class MicroBatcher:
@@ -70,7 +76,7 @@ class MicroBatcher:
                  max_delay_ms: Optional[float] = None,
                  max_batch: Optional[int] = None,
                  on_batch: Optional[Callable[..., None]] = None,
-                 on_request: Optional[Callable[[float], None]] = None):
+                 on_request: Optional[Callable[..., None]] = None):
         self._dispatch = dispatch
         self.max_delay_s = (
             _env_float(MAX_DELAY_ENV, _DEFAULT_DELAY_MS)
@@ -90,15 +96,16 @@ class MicroBatcher:
         self._worker.start()
 
     # ------------------------------------------------------------- client
-    def submit(self, features) -> "Future[np.ndarray]":
+    def submit(self, features, trace=None) -> "Future[np.ndarray]":
         """Enqueue one request ([rows, ...features]); returns a Future of
-        the row-aligned output."""
+        the row-aligned output. ``trace`` (a sampled ``TraceContext``)
+        rides the request so the coalesced dispatch can link back to it."""
         features = np.asarray(features)
         if features.ndim < 2:
             raise ValueError(
                 f"request must be batched ([rows, ...]); got shape "
                 f"{features.shape}")
-        req = _Request(features)
+        req = _Request(features, trace=trace)
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is stopped")
@@ -215,14 +222,39 @@ class MicroBatcher:
         t0 = time.perf_counter()
         feats = (group[0].features if len(group) == 1 else
                  np.concatenate([r.features for r in group]))
+        # ONE dispatch span for the coalesced batch: parented under the
+        # first sampled member, with fan-in links to EVERY sampled member's
+        # span — the trace shows exactly which strangers a request shared
+        # device work with. Installed as current so the inference fast path
+        # (infer.dispatch) parents under it.
+        traced = [r.trace for r in group
+                  if r.trace is not None and r.trace.sampled]
+        dispatch_ctx = traced[0].child() if traced else None
+        ts_us = time.time() * 1e6
         try:
-            out = self._dispatch(feats)
+            with use_trace(dispatch_ctx) if dispatch_ctx is not None \
+                    else _NULL_CM:
+                out = self._dispatch(feats)
         except Exception as e:  # noqa: BLE001 - reject THIS batch only
+            if dispatch_ctx is not None:
+                record_trace_event(
+                    dispatch_ctx, "serve.batch",
+                    duration_s=time.perf_counter() - t0, ts_us=ts_us,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    links=[{"trace_id": t.trace_id, "span_id": t.span_id}
+                           for t in traced])
             for req in group:
                 if not req.future.cancelled():
                     req.future.set_exception(e)
             return
         seconds = time.perf_counter() - t0
+        if dispatch_ctx is not None:
+            record_trace_event(
+                dispatch_ctx, "serve.batch", duration_s=seconds,
+                ts_us=ts_us, rows=int(feats.shape[0]),
+                requests=len(group), sampled_members=len(traced),
+                links=[{"trace_id": t.trace_id, "span_id": t.span_id}
+                       for t in traced])
         out = np.asarray(out)
         offset = 0
         done = time.perf_counter()
@@ -231,7 +263,7 @@ class MicroBatcher:
             if not req.future.cancelled():
                 req.future.set_result(out[offset:offset + n])
             if self._on_request is not None:
-                self._on_request(done - req.enqueued)
+                self._on_request(done - req.enqueued, req.trace)
             offset += n
         if self._on_batch is not None:
             self._on_batch(rows=int(feats.shape[0]),

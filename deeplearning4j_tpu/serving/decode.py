@@ -29,6 +29,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..telemetry.tracing import current_trace, record_trace_event
 from .batcher import MAX_DELAY_ENV, _env_float
 
 __all__ = ["DecodeServer", "DECODE_SLOTS_ENV"]
@@ -40,12 +41,13 @@ _DEFAULT_SLOTS = 8
 
 
 class _Pending:
-    __slots__ = ("features", "future", "enqueued")
+    __slots__ = ("features", "future", "enqueued", "trace")
 
-    def __init__(self, features: np.ndarray):
+    def __init__(self, features: np.ndarray, trace=None):
         self.features = features
         self.future: "Future[np.ndarray]" = Future()
         self.enqueued = time.perf_counter()
+        self.trace = trace  # session lineage (TraceContext) for this tick
 
 
 class DecodeServer:
@@ -72,13 +74,20 @@ class DecodeServer:
         self._net_lock = threading.Lock()
         self._sessions: Dict[str, int] = {}           # session id -> slot
         self._pending: Dict[int, _Pending] = {}       # slot -> request
+        # session id -> sampled TraceContext: every tick of a session
+        # parents under the SAME context, so a session's trace reads as one
+        # lineage across ticks instead of disconnected fragments
+        self._traces: Dict[str, object] = {}
         self._closed = False
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------ sessions
-    def open(self) -> str:
-        """Claim a free slot; returns the session id."""
+    def open(self, trace=None) -> str:
+        """Claim a free slot; returns the session id. A sampled ``trace``
+        (or the thread's current context) becomes the session's lineage:
+        every subsequent tick span parents under it."""
+        ctx = trace if trace is not None else current_trace()
         with self._lock:
             used = set(self._sessions.values())
             free = next((i for i in range(self.capacity) if i not in used),
@@ -89,12 +98,18 @@ class DecodeServer:
                     f"(raise {DECODE_SLOTS_ENV})")
             sid = uuid.uuid4().hex[:12]
             self._sessions[sid] = free
+            if ctx is not None and ctx.sampled:
+                session_ctx = ctx.child()
+                self._traces[sid] = session_ctx
+                record_trace_event(session_ctx, "decode.open",
+                                   session=sid, slot=free)
             self._reset_slot(free)
             return sid
 
     def close(self, session_id: str) -> None:
         with self._cv:
             slot = self._sessions.pop(session_id, None)
+            self._traces.pop(session_id, None)
             pend = self._pending.pop(slot, None) if slot is not None else None
         if pend is not None:
             pend.future.set_exception(RuntimeError("session closed"))
@@ -134,7 +149,7 @@ class DecodeServer:
             if slot in self._pending:
                 raise RuntimeError(
                     f"session {session_id!r} already has a step in flight")
-            pend = _Pending(features)
+            pend = _Pending(features, trace=self._traces.get(session_id))
             self._pending[slot] = pend
             self._cv.notify()
         return pend.future.result(timeout=timeout_s)
@@ -192,8 +207,13 @@ class DecodeServer:
             done = time.perf_counter()
             for slot, pend in batch.items():
                 pend.future.set_result(out[slot])
+                if pend.trace is not None and pend.trace.sampled:
+                    record_trace_event(
+                        pend.trace.child(), "decode.tick", slot=slot,
+                        duration_s=done - pend.enqueued,
+                        tick_rows=len(batch))
                 if self._on_request is not None:
-                    self._on_request(done - pend.enqueued)
+                    self._on_request(done - pend.enqueued, pend.trace)
             if self._on_batch is not None:
                 self._on_batch(rows=len(batch), requests=len(batch),
                                seconds=seconds, queue_depth=0,

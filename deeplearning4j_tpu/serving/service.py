@@ -42,6 +42,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..telemetry.tracing import current_trace, record_trace_event, trace_span
 from .batcher import MAX_BATCH_ENV, MAX_DELAY_ENV, MicroBatcher
 from .decode import DecodeServer
 
@@ -303,13 +304,13 @@ class InferenceService:
             dispatch,
             max_delay_ms=delay_ms, max_batch=rows_cap,
             on_batch=lambda **kw: self._record_batch(name, **kw),
-            on_request=lambda s: self._record_request(name, s))
+            on_request=lambda s, t=None: self._record_request(name, s, t))
         argmax_batcher = MicroBatcher(
             dispatch_argmax,
             max_delay_ms=delay_ms, max_batch=rows_cap,
             on_batch=lambda **kw: self._record_batch(name, kind="argmax",
                                                      **kw),
-            on_request=lambda s: self._record_request(name, s))
+            on_request=lambda s, t=None: self._record_request(name, s, t))
         entry = _ModelEntry(
             name, net, batcher, argmax_batcher,
             max_queue_depth=(None if max_queue_depth is None
@@ -322,6 +323,21 @@ class InferenceService:
             self._models[name] = entry
         if old is not None:
             old.stop()
+        # SLO declaration is env-opt-in (DL4JTPU_SLO_*): fleets that want
+        # burn-rate alerting set the knobs; unset, nothing evaluates and
+        # existing behavior (tests included) is untouched. Programmatic
+        # declaration stays available via get_slo_monitor().declare().
+        try:
+            from ..telemetry import slo as _slo  # noqa: PLC0415
+
+            if any(os.environ.get(k) for k in (
+                    _slo.SLO_LATENCY_BUDGET_ENV,
+                    _slo.SLO_LATENCY_TARGET_ENV,
+                    _slo.SLO_AVAILABILITY_TARGET_ENV)):
+                _slo.get_slo_monitor().declare_from_env(
+                    name, latency_budget_ms=entry.latency_budget_ms)
+        except Exception:  # observability must never fail registration
+            pass
         return self
 
     def unregister(self, name: str) -> None:
@@ -415,33 +431,50 @@ class InferenceService:
         return warmed
 
     def predict(self, name: str, features, *, argmax: bool = False,
-                timeout_s: float = 30.0) -> np.ndarray:
+                timeout_s: float = 30.0, trace=None) -> np.ndarray:
         """Serve one request through the model's micro-batcher. ``argmax``
         requests coalesce on their OWN batcher (mixing them with logits
         requests would force two device transfers per batch) and dispatch
         on the fused-argmax executable — only int32 class indices cross
         the device boundary, same as the old direct path.
 
+        ``trace``: an optional :class:`TraceContext` (falls back to the
+        thread's current context). A sampled request records a
+        ``serve.request`` span wrapping admission and the batched wait,
+        and rides into the coalesced dispatch for fan-in linking; a shed
+        or over-budget request upgrades an unsampled context post-hoc.
+
         Raises :class:`ServiceDraining` while the service drains and
         :class:`AdmissionError` when the model's queue-depth cap or
         latency budget would be breached (shed now beats queueing into a
         latency spiral — the caller backs off ``retry_after_s``)."""
+        ctx = trace if trace is not None else current_trace()
+        if ctx is None or not ctx.sampled:
+            return self._predict(name, features, argmax, timeout_s, ctx)
+        with trace_span(ctx, "serve.request", model=name,
+                        argmax=bool(argmax)) as sp:
+            return self._predict(name, features, argmax, timeout_s, sp.ctx)
+
+    def _predict(self, name: str, features, argmax: bool,
+                 timeout_s: float, ctx) -> np.ndarray:
         if self._draining:
             raise ServiceDraining(f"service draining; model {name!r} "
                                   "not admitting new requests")
         entry = self._entry(name)
-        self._admit(entry)
+        self._admit(entry, ctx)
         features = np.asarray(features)
         if features.ndim >= 1:
             self.request_rows.labels(model=name).observe(
                 int(features.shape[0]))
         batcher = entry.argmax_batcher if argmax else entry.batcher
-        fut = batcher.submit(features)
+        fut = batcher.submit(
+            features,
+            trace=ctx if ctx is not None and ctx.sampled else None)
         self.queue_depth.labels(model=name).set(
             entry.batcher.queue_depth() + entry.argmax_batcher.queue_depth())
         return fut.result(timeout=timeout_s)
 
-    def _admit(self, entry: _ModelEntry) -> None:
+    def _admit(self, entry: _ModelEntry, ctx=None) -> None:
         depth = entry.depth()
         if (entry.max_queue_depth is not None
                 and depth >= entry.max_queue_depth):
@@ -450,18 +483,36 @@ class InferenceService:
             cycles = depth / max(1, entry.batcher.max_batch)
             retry = max(0.05, cycles * max(entry.batcher.max_delay_s,
                                            0.002))
-            self._shed(entry, "queue_depth", retry)
+            self._shed(entry, "queue_depth", retry, ctx)
         if entry.latency_budget_ms is not None:
             p99 = entry.recent_p99()
             if p99 is not None and p99 * 1000.0 > entry.latency_budget_ms:
                 self._shed(entry, "latency_budget",
-                           max(0.05, 2 * entry.latency_budget_ms / 1000.0))
+                           max(0.05, 2 * entry.latency_budget_ms / 1000.0),
+                           ctx)
 
     def _shed(self, entry: _ModelEntry, reason: str,
-              retry_after_s: float) -> None:
+              retry_after_s: float, ctx=None) -> None:
         with entry.lock:
             entry.shed += 1
         self.shed_total.labels(model=entry.name, reason=reason).inc()
+        tid = None
+        if ctx is not None:
+            # always-sample on shed: upgrade an unsampled head post-hoc so
+            # the 429 the client sees has a trace behind it
+            ctx.upgrade(f"shed:{reason}")
+            record_trace_event(ctx.child(), "serve.shed",
+                               model=entry.name, reason=reason,
+                               retry_after_s=round(retry_after_s, 3))
+            tid = ctx.trace_id
+        try:
+            from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+
+            mon = get_slo_monitor()
+            mon.observe(entry.name, shed=True, trace_id=tid)
+            mon.maybe_evaluate()
+        except Exception:  # observability must never fail a shed
+            pass
         raise AdmissionError(entry.name, reason, round(retry_after_s, 3))
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -495,18 +546,36 @@ class InferenceService:
                     max_delay_ms=self.max_delay_ms,
                     on_batch=lambda **kw: self._record_batch(
                         name, kind="decode", **kw),
-                    on_request=lambda s: self._record_request(name, s))
+                    on_request=lambda s, t=None: self._record_request(
+                        name, s, t))
             return entry.decoder
 
     # ------------------------------------------------------------ metrics
-    def _record_request(self, name: str, seconds: float) -> None:
-        self.requests_total.labels(model=name).inc()
-        self.latency.labels(model=name).observe(seconds)
+    def _record_request(self, name: str, seconds: float,
+                        trace=None) -> None:
         entry = self._models.get(name)
+        if trace is not None and entry is not None \
+                and entry.latency_budget_ms is not None \
+                and seconds * 1000.0 > entry.latency_budget_ms:
+            # always-sample on latency over budget (post-hoc upgrade)
+            trace.upgrade("latency_budget")
+        tid = (trace.trace_id
+               if trace is not None and trace.sampled else None)
+        self.requests_total.labels(model=name).inc()
+        # exemplar: tail buckets on /metrics point at a concrete trace
+        self.latency.labels(model=name).observe(seconds, exemplar=tid)
         if entry is not None:
             with entry.lock:  # logits/argmax/decode callbacks race here
                 entry.requests += 1
                 entry.latencies.append(float(seconds))
+        try:
+            from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+
+            mon = get_slo_monitor()
+            mon.observe(name, latency_s=float(seconds), trace_id=tid)
+            mon.maybe_evaluate()
+        except Exception:  # observability must never fail a request
+            pass
 
     def _record_batch(self, name: str, *, rows: int, requests: int,
                       seconds: float, queue_depth: int,

@@ -22,6 +22,13 @@ syncs; see docs/observability.md):
   the single live ``device_memory_stats`` source.
 - :mod:`flight_recorder` — bounded event ring + post-mortem JSON dump
   bundles, auto-triggered by watchdog anomalies.
+- :mod:`tracing` — distributed request tracing: head-sampled
+  :class:`TraceContext` propagated across processes via the
+  ``x-dl4jtpu-trace`` header, per-hop Chrome-trace spans in a bounded
+  ring, latency-histogram exemplars.
+- :mod:`slo` — declared objectives (latency budget, availability) with
+  multi-window burn-rate alerting over serving observations; breaches
+  emit ``slo-burn`` watchdog anomalies and flight bundles.
 """
 
 from .flight_recorder import (
@@ -44,12 +51,28 @@ from .registry import (
     get_registry,
 )
 from .session import Telemetry
+from .slo import SLOMonitor, get_slo_monitor, set_slo_monitor
 from .spans import Span, SpanRecorder, get_recorder, span
+from .tracing import (
+    TRACE_HEADER,
+    TRACE_SAMPLE_ENV,
+    TraceContext,
+    TraceRing,
+    current_trace,
+    get_trace_ring,
+    record_trace_event,
+    sample_rate,
+    set_default_baggage,
+    should_sample,
+    trace_span,
+    use_trace,
+)
 from .watchdog import (
     EXPLODING_GRAD_NORM,
     INPUT_SHIFT,
     LOSS_DRIFT,
     NAN_LOSS,
+    SLO_BURN,
     STALLED_STEP_TIME,
     AnomalyEvent,
     Watchdog,
@@ -74,6 +97,22 @@ __all__ = [
     "STALLED_STEP_TIME",
     "LOSS_DRIFT",
     "INPUT_SHIFT",
+    "SLO_BURN",
+    "TRACE_HEADER",
+    "TRACE_SAMPLE_ENV",
+    "TraceContext",
+    "TraceRing",
+    "current_trace",
+    "get_trace_ring",
+    "record_trace_event",
+    "sample_rate",
+    "set_default_baggage",
+    "should_sample",
+    "trace_span",
+    "use_trace",
+    "SLOMonitor",
+    "get_slo_monitor",
+    "set_slo_monitor",
     "FlightRecorder",
     "get_flight_recorder",
     "install_crash_hook",

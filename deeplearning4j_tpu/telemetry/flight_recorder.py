@@ -31,7 +31,7 @@ import time
 from typing import List, Optional
 
 from .registry import MetricsRegistry, get_registry
-from .watchdog import EXPLODING_GRAD_NORM, NAN_LOSS, STALLED_STEP_TIME
+from .watchdog import EXPLODING_GRAD_NORM, NAN_LOSS, SLO_BURN, STALLED_STEP_TIME
 
 logger = logging.getLogger(__name__)
 
@@ -94,6 +94,13 @@ for _kind in (
     # runtime/resilience.py
     "resilience_retry", "resilience_giveup", "deadline_expired",
     "circuit_closed", "circuit_open", "circuit_half_open",
+    # telemetry/tracing.py (post-hoc sample upgrade on shed/error/slow)
+    "trace_upgrade",
+    # telemetry/slo.py (multi-window burn-rate breach)
+    "slo_burn",
+    # fleet/router.py (rolling rollout + dead-worker respawn, spliced into
+    # merged traces as instant events)
+    "fleet_rollout", "fleet_respawn",
 ):
     register_event_kind(_kind)
 del _kind
@@ -113,7 +120,7 @@ class FlightRecorder:
                  dump_dir: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
                  auto_dump_kinds=(NAN_LOSS, EXPLODING_GRAD_NORM,
-                                  STALLED_STEP_TIME),
+                                  STALLED_STEP_TIME, SLO_BURN),
                  min_dump_interval_s: float = 30.0):
         if int(capacity) < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")

@@ -119,8 +119,12 @@ class _Histogram:
         self._count = 0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # last exemplar per bucket index ((trace_id, value) or None) —
+        # lets prometheus_text() point tail buckets at concrete sampled
+        # traces (OpenMetrics exemplar syntax)
+        self._exemplars = [None] * (len(self.buckets) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:
             i = 0
@@ -131,6 +135,8 @@ class _Histogram:
             self._count += 1
             self._min = v if self._min is None else min(self._min, v)
             self._max = v if self._max is None else max(self._max, v)
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v)
 
     def summary(self) -> dict:
         """JSON-ready summary: the shape bench artifacts embed."""
@@ -215,8 +221,8 @@ class MetricFamily:
     def set(self, value: float) -> None:
         self._default_child().set(value)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        self._default_child().observe(value, exemplar=exemplar)
 
     @property
     def value(self) -> float:
@@ -305,18 +311,32 @@ class MetricsRegistry:
                     with child._lock:
                         counts = list(child._counts)
                         total, s = child._count, child._sum
-                    for bound, c in zip(child.buckets, counts):
+                        exemplars = list(child._exemplars)
+                    for i, (bound, c) in enumerate(
+                            zip(child.buckets, counts)):
                         cum += c
-                        lines.append(
+                        line = (
                             f"{fam.name}_bucket"
                             f"{_render_labels(fam.labelnames, key, ('le', _fmt(bound)))}"
                             f" {cum}"
                         )
-                    lines.append(
+                        ex = exemplars[i]
+                        if ex is not None:
+                            # OpenMetrics exemplar: the sampled trace whose
+                            # observation last landed in this bucket
+                            line += (f' # {{trace_id="{_escape_label(ex[0])}"'
+                                     f"}} {_fmt(ex[1])}")
+                        lines.append(line)
+                    inf_line = (
                         f"{fam.name}_bucket"
                         f"{_render_labels(fam.labelnames, key, ('le', '+Inf'))}"
                         f" {total}"
                     )
+                    ex = exemplars[len(child.buckets)]
+                    if ex is not None:
+                        inf_line += (f' # {{trace_id="{_escape_label(ex[0])}"'
+                                     f"}} {_fmt(ex[1])}")
+                    lines.append(inf_line)
                     lines.append(f"{fam.name}_sum{base} {_fmt(s)}")
                     lines.append(f"{fam.name}_count{base} {total}")
                 else:

@@ -38,6 +38,10 @@ STALLED_STEP_TIME = "stalled-step-time"
 # per-step kinds above; see docs/streaming.md)
 LOSS_DRIFT = "loss-drift"
 INPUT_SHIFT = "input-shift"
+# SLO burn-rate breach (emitted by telemetry/slo.py through Watchdog.emit;
+# auto-dumps a flight bundle whose spans section carries the offending
+# sampled traces — see docs/observability.md)
+SLO_BURN = "slo-burn"
 
 
 @dataclass(frozen=True)

@@ -41,6 +41,9 @@ Pages:
 - ``/api/resilience`` — live state of every registered failure-handling
   site: retry policies (attempts/backoff), deadlines (expiries) and
   circuit breakers (state/cooldown) (see docs/robustness.md).
+- ``/api/slo``        — declared SLOs, fast/slow-window burn rates per
+  model and objective, and the recent breach history (see
+  docs/observability.md § SLO burn-rate monitoring).
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
   and continuous-decode endpoints over the process serving front-end
   (``serving.get_service()``; see docs/serving.md).
@@ -521,6 +524,13 @@ class _Handler(BaseHTTPRequestHandler):
 
             return self._send(200, json.dumps(
                 resilience_stats(), default=str).encode())
+        if path == "/api/slo":
+            # declared objectives + multi-window burn rates + recent
+            # breaches (docs/observability.md § SLO burn-rate monitoring)
+            from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
+
+            return self._send(200, json.dumps(
+                get_slo_monitor().stats(), default=str).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]

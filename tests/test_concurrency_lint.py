@@ -317,6 +317,38 @@ class TestSchemaAggregation:
                '    recorder.record("online_rollback")\n')
         assert check_runtime_source(src, "k.py") == []
 
+    def test_tracing_and_slo_kinds_are_registered(self):
+        # the tracing/SLO subsystem's event kinds went through the same
+        # single-owner registration as every other family — emitting them
+        # must not trip the unregistered-kind arm of DT406
+        src = ('def note(recorder):\n'
+               '    recorder.record("trace_upgrade")\n'
+               '    recorder.record("slo_burn")\n'
+               '    recorder.record("fleet_rollout")\n'
+               '    recorder.record("fleet_respawn")\n')
+        assert check_runtime_source(src, "k.py") == []
+
+    def test_unregistered_trace_kind_fires(self):
+        src = ('def note(recorder):\n'
+               '    recorder.record("trace_upgrade_v2_unregistered")\n')
+        assert "DT406" in _ids(check_runtime_source(src, "k.py"))
+
+    def test_slo_family_cross_file_conflict_fires(self):
+        # two modules each claiming dl4jtpu_slo_burn_rate with different
+        # label sets — the shared schema must flag the second owner
+        one = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'g = get_registry().gauge("dl4jtpu_slo_burn_rate", "h",\n'
+               '        labelnames=("model", "objective"))\n')
+        two = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'g = get_registry().gauge("dl4jtpu_slo_burn_rate", "h",\n'
+               '        labelnames=("model",))\n')
+        schema = TelemetrySchema()
+        findings = []
+        findings += check_runtime_source(one, "one.py", schema=schema)
+        findings += check_runtime_source(two, "two.py", schema=schema)
+        findings += schema.findings()
+        assert "DT406" in _ids(findings), findings
+
 
 class TestDeterminism:
     def test_same_source_scans_identically(self):

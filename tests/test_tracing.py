@@ -1,0 +1,536 @@
+"""Distributed tracing + SLO burn-rate tests (ISSUE 17).
+
+Fast tier: header codec round-trip, head-sampling determinism at the
+edges, retry-attempt child spans (the RetryPolicy parent-loss bugfix),
+micro-batch fan-in links (N member spans -> ONE dispatch span), the
+always-sample-on-shed upgrade, SLO fast/slow window burn math on
+synthetic rings, serve-path bit-exactness traced vs untraced, and the
+zero-warm-compile guarantee with tracing on.
+
+Slow tier (real OS processes, same recipe as test_fleet): cross-process
+header propagation router -> worker and the merged-trace endpoint
+returning spans from >= 2 processes. check.sh's tracing self-scan
+re-proves the cross-process contract in CI.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DenseLayer, InputType,
+                                MultiLayerConfiguration, MultiLayerNetwork,
+                                OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.runtime.resilience import RetryPolicy
+from deeplearning4j_tpu.serving import InferenceService, MicroBatcher
+from deeplearning4j_tpu.telemetry import MetricsRegistry
+from deeplearning4j_tpu.telemetry.slo import SLOMonitor
+from deeplearning4j_tpu.telemetry.tracing import (TRACE_HEADER,
+                                                  TraceContext,
+                                                  get_trace_ring,
+                                                  sample_rate,
+                                                  should_sample, trace_span,
+                                                  use_trace)
+from deeplearning4j_tpu.tune.knobs import scoped_env
+
+
+def _toy_net(n_in=8, n_out=4, seed=7):
+    return MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=n_out, activation="softmax",
+                            loss="mcxent")],
+        input_type=InputType.feed_forward(n_in),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        seed=seed)).init()
+
+
+def _spans(trace_id, name=None):
+    spans = get_trace_ring().spans_for(trace_id)
+    if name is None:
+        return spans
+    return [s for s in spans if s["name"] == name]
+
+
+# ---------------------------------------------------------------------------
+# header codec
+# ---------------------------------------------------------------------------
+class TestHeaderCodec:
+    def test_round_trip_with_baggage(self):
+        ctx = TraceContext.new(sampled=True,
+                               baggage={"model": "m x",
+                                        "checkpoint_version": "3",
+                                        "k;=": "v;="})
+        back = TraceContext.from_header(ctx.to_header())
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+        assert back.baggage == ctx.baggage  # ;/=/space survive quoting
+
+    def test_unsampled_flag_round_trips(self):
+        ctx = TraceContext.new(sampled=False)
+        assert TraceContext.from_header(ctx.to_header()).sampled is False
+
+    @pytest.mark.parametrize("raw", [None, "", "garbage", "a:b",
+                                     ":" * 5, "only-one-field"])
+    def test_malformed_header_is_none(self, raw):
+        assert TraceContext.from_header(raw) is None
+
+    def test_child_keeps_trace_links_parent(self):
+        root = TraceContext.new(sampled=True)
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_id == root.span_id
+        assert kid.span_id != root.span_id
+        assert kid.sampled is True
+
+
+# ---------------------------------------------------------------------------
+# head sampling
+# ---------------------------------------------------------------------------
+class TestSampling:
+    def test_deterministic_edges(self):
+        with scoped_env(DL4JTPU_TRACE_SAMPLE="1.0"):
+            assert all(should_sample() for _ in range(64))
+        with scoped_env(DL4JTPU_TRACE_SAMPLE="0"):
+            assert not any(should_sample() for _ in range(64))
+
+    def test_ratio_syntax(self):
+        with scoped_env(DL4JTPU_TRACE_SAMPLE="1/4"):
+            assert sample_rate() == 0.25
+
+    def test_garbage_falls_back_to_default(self):
+        with scoped_env(DL4JTPU_TRACE_SAMPLE="not-a-rate"):
+            assert sample_rate() == 1.0 / 256.0
+
+    def test_upgrade_flips_once_and_records(self):
+        from deeplearning4j_tpu.telemetry.flight_recorder import \
+            get_flight_recorder
+
+        ctx = TraceContext.new(sampled=False)
+        assert ctx.upgrade("shed:test") is True
+        assert ctx.sampled is True
+        assert ctx.upgrade("again") is False  # already sampled: no-op
+        kinds = [e for e in get_flight_recorder().events
+                 if e.get("kind") == "trace_upgrade"
+                 and e.get("trace_id") == ctx.trace_id]
+        assert len(kinds) == 1 and kinds[0]["reason"] == "shed:test"
+
+
+# ---------------------------------------------------------------------------
+# retry attempts are CHILD spans of one stable parent (the bugfix: the
+# span must not lose its parent when RetryPolicy.run re-executes the body)
+# ---------------------------------------------------------------------------
+class TestRetryAttemptSpans:
+    def test_three_attempt_schedule_yields_sibling_children(self):
+        policy = RetryPolicy("test.traced_site", max_attempts=3,
+                             base_s=0.001, cap_s=0.001, jitter=0.0,
+                             retry_on=(ValueError,),
+                             registry=MetricsRegistry())
+        root = TraceContext.new(sampled=True)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ValueError(f"boom {calls[0]}")
+            return "ok"
+
+        with use_trace(root):
+            assert policy.run(flaky) == "ok"
+        spans = _spans(root.trace_id, "resilience.attempt")
+        assert len(spans) == 3, spans
+        # every attempt parents under the SAME span — the context read
+        # once before the loop, not re-read per re-execution
+        assert {s["args"]["parent_id"] for s in spans} == {root.span_id}
+        assert [s["args"]["attempt"] for s in spans] == [1, 2, 3]
+        assert all(s["args"]["site"] == "test.traced_site" for s in spans)
+        failed = [s for s in spans if "error" in s["args"]]
+        assert len(failed) == 2 and all(
+            s["args"]["backoff_s"] > 0 for s in failed)
+        ok = [s for s in spans if "error" not in s["args"]]
+        assert len(ok) == 1 and ok[0]["args"]["backoff_s"] == 0.0
+
+    def test_unsampled_parent_records_nothing(self):
+        policy = RetryPolicy("test.untraced_site", max_attempts=2,
+                             base_s=0.0, cap_s=0.0, jitter=0.0,
+                             registry=MetricsRegistry())
+        root = TraceContext.new(sampled=False)
+        with use_trace(root):
+            policy.run(lambda: "ok")
+        assert _spans(root.trace_id) == []
+
+
+# ---------------------------------------------------------------------------
+# micro-batch fan-in: N member spans -> ONE dispatch span with links
+# ---------------------------------------------------------------------------
+class TestBatcherFanIn:
+    def test_coalesced_dispatch_links_every_member(self):
+        b = MicroBatcher(lambda feats: feats, max_delay_ms=500.0,
+                         max_batch=3)
+        try:
+            root = TraceContext.new(sampled=True)
+            members = [root.child() for _ in range(3)]
+            futs = [b.submit(np.full((1, 2), i, np.float32), trace=m)
+                    for i, m in enumerate(members)]
+            rows = [f.result(timeout=10) for f in futs]
+            assert all(r.shape == (1, 2) for r in rows)
+        finally:
+            b.stop()
+        batches = _spans(root.trace_id, "serve.batch")
+        assert len(batches) == 1, batches  # ONE span for the whole group
+        span = batches[0]
+        assert span["args"]["requests"] == 3
+        assert span["args"]["rows"] == 3
+        linked = {l["span_id"] for l in span["args"]["links"]}
+        assert linked == {m.span_id for m in members}
+        assert all(l["trace_id"] == root.trace_id
+                   for l in span["args"]["links"])
+
+    def test_unsampled_members_cost_no_span(self):
+        b = MicroBatcher(lambda feats: feats, max_delay_ms=0.0, max_batch=4)
+        try:
+            ctx = TraceContext.new(sampled=False)
+            b.submit(np.zeros((1, 2), np.float32),
+                     trace=ctx).result(timeout=10)
+        finally:
+            b.stop()
+        assert _spans(ctx.trace_id) == []
+
+
+# ---------------------------------------------------------------------------
+# always-sample on shed
+# ---------------------------------------------------------------------------
+class TestShedUpgrade:
+    def test_shed_upgrades_and_records_span(self):
+        from deeplearning4j_tpu.serving import AdmissionError
+
+        svc = InferenceService(max_delay_ms=0.0)
+        try:
+            svc.register("m", _toy_net(), max_queue_depth=1)
+            entry = svc._entry("m")
+            entry.batcher.queue_depth = lambda: 5  # look saturated
+            ctx = TraceContext.new(sampled=False)  # head said NO
+            with use_trace(ctx):
+                with pytest.raises(AdmissionError):
+                    svc.predict("m", np.zeros((1, 8), np.float32))
+            assert ctx.sampled is True  # the shed flipped the decision
+            sheds = _spans(ctx.trace_id, "serve.shed")
+            assert len(sheds) == 1
+            assert sheds[0]["args"]["reason"] == "queue_depth"
+            assert sheds[0]["args"]["retry_after_s"] > 0
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate window math (synthetic rings, injected clocks)
+# ---------------------------------------------------------------------------
+class TestSLOBurn:
+    class _Dog:
+        def __init__(self):
+            self.emitted = []
+
+        def emit(self, kind, iteration, value, threshold, message):
+            self.emitted.append((kind, value, threshold, message))
+
+    def _monitor(self):
+        dog = self._Dog()
+        mon = SLOMonitor(registry=MetricsRegistry(), watchdog=dog)
+        mon.declare("m", latency_budget_ms=100.0, latency_target=0.99,
+                    availability_target=0.999)
+        return mon, dog
+
+    def test_fast_and_slow_window_math(self):
+        mon, _ = self._monitor()
+        # 90 good observations spread over the slow window, then a burst
+        # of 10 bad ones inside the fast window
+        for i in range(90):
+            mon.observe("m", latency_s=0.05, now=1000.0 + i * 30.0)
+        for i in range(10):
+            mon.observe("m", latency_s=0.5, trace_id=f"t{i}",
+                        now=3900.0 + i)
+        rates = mon.burn_rates("m", now=3910.0)
+        lat = rates["latency"]
+        # fast window [3610, 3910]: 3 good (ts 3610/3640/3670) + 10 bad
+        assert lat["fast_total"] == 13
+        assert lat["fast"] == pytest.approx((10 / 13) / 0.01)
+        # slow window [310, 3910]: every sample still in range
+        assert lat["slow_total"] == 100
+        assert lat["slow"] == pytest.approx((10 / 100) / 0.01)
+        assert set(lat["offending_traces"]) == {f"t{i}" for i in range(10)}
+
+    def test_breach_requires_both_windows(self):
+        mon, dog = self._monitor()
+        # fast window burns hot but the slow window is healthy -> a blip,
+        # not a breach (the multi-window rule's whole point)
+        for i in range(500):
+            mon.observe("m", latency_s=0.05, now=100.0 + i * 7.0)
+        for i in range(5):
+            mon.observe("m", latency_s=0.9, now=3595.0 + i)
+        assert mon.evaluate(now=3600.0) == []
+        assert dog.emitted == []
+
+    def test_sustained_burn_breaches_and_lists_traces(self):
+        mon, dog = self._monitor()
+        for i in range(120):
+            bad = i % 2 == 0  # 50% over budget for a full hour
+            mon.observe("m", latency_s=0.5 if bad else 0.05,
+                        trace_id=f"t{i}" if bad else None,
+                        now=100.0 + i * 30.0)
+        fired = mon.evaluate(now=100.0 + 119 * 30.0)
+        assert [f["objective"] for f in fired] == ["latency"]
+        assert fired[0]["fast_burn"] >= 14.4
+        assert fired[0]["slow_burn"] >= 6.0
+        assert fired[0]["offending_traces"]
+        assert len(dog.emitted) == 1
+        kind, value, threshold, message = dog.emitted[0]
+        assert kind == "slo-burn"
+        assert "latency" in message
+        # the breach surfaces in stats() for /api/slo
+        stats = mon.stats()
+        assert stats["breaches_total"] == 1
+        assert stats["recent_breaches"][0]["model"] == "m"
+
+    def test_availability_objective_counts_sheds_and_errors(self):
+        mon, dog = self._monitor()
+        for i in range(100):
+            mon.observe("m", latency_s=0.01, now=1000.0 + i)
+        for i in range(50):
+            mon.observe("m", shed=(i % 2 == 0), error=(i % 2 == 1),
+                        trace_id=f"s{i}", now=1100.0 + i)
+        rates = mon.burn_rates("m", now=1150.0)
+        avail = rates["availability"]
+        assert avail["fast_total"] == 150
+        assert avail["fast"] == pytest.approx((50 / 150) / 0.001)
+        fired = mon.evaluate(now=1150.0)
+        assert "availability" in [f["objective"] for f in fired]
+        assert any(k == "slo-burn" for k, *_ in dog.emitted)
+
+    def test_burn_zero_on_empty_ring(self):
+        mon, _ = self._monitor()
+        rates = mon.burn_rates("m", now=1000.0)
+        assert rates["latency"]["fast"] == 0.0
+        assert rates["availability"]["slow"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# serve-path invariants with tracing on: bit-exactness + zero warm compiles
+# ---------------------------------------------------------------------------
+class TestServePathInvariants:
+    def test_traced_output_bit_exact_and_no_new_compiles(self):
+        from deeplearning4j_tpu.runtime.compile_manager import \
+            get_compile_manager
+
+        svc = InferenceService(max_delay_ms=0.0)
+        try:
+            svc.register("m", _toy_net())
+            probe = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+            ref = svc.predict("m", probe)  # untraced warm-up compile
+            cm = get_compile_manager()
+            c0 = cm.compiles.value
+            ctx = TraceContext.new(sampled=True)
+            with use_trace(ctx):
+                traced = svc.predict("m", probe)
+            assert np.array_equal(ref, traced)  # tracing never perturbs
+            assert cm.compiles.value == c0  # and never compiles
+            dispatch = _spans(ctx.trace_id, "infer.dispatch")
+            assert len(dispatch) == 1
+            assert dispatch[0]["args"]["compiles"] == 0
+            assert dispatch[0]["args"]["cache_hit"] is True
+        finally:
+            svc.stop()
+
+    def test_request_span_chain_reaches_dispatch(self):
+        svc = InferenceService(max_delay_ms=0.0)
+        try:
+            svc.register("m", _toy_net())
+            probe = np.zeros((1, 8), np.float32)
+            svc.predict("m", probe)  # warm
+            ctx = TraceContext.new(sampled=True)
+            with use_trace(ctx):
+                svc.predict("m", probe)
+            names = {s["name"] for s in _spans(ctx.trace_id)}
+            assert {"serve.request", "serve.batch",
+                    "infer.dispatch"} <= names
+            # the batch span fans in to the request's member span
+            batch = _spans(ctx.trace_id, "serve.batch")[0]
+            assert len(batch["args"]["links"]) == 1
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-process propagation (slow): router -> worker -> merged endpoint
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestFleetTracing:
+    @pytest.fixture()
+    def fleet(self, tmp_path):
+        from deeplearning4j_tpu.fleet import (FleetRouter, build_bundle,
+                                              save_bundle)
+        from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+
+        net = _toy_net()
+        store = CheckpointStore(str(tmp_path / "store"))
+        store.save(net)
+        save_bundle(store, build_bundle(
+            net, example=np.zeros((1, 8), np.float32), argmax=True,
+            max_batch=8))
+        with scoped_env(DL4JTPU_TRACE_SAMPLE="1"):
+            router = FleetRouter(
+                str(tmp_path / "store"), workers=2, poll_s=0.2,
+                worker_args={"max_delay_ms": 0, "max_batch": 8}).start()
+            try:
+                yield router
+            finally:
+                router.stop()
+
+    def _predict(self, port, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read()), dict(resp.headers)
+
+    def test_propagation_and_merged_trace(self, fleet):
+        router = fleet
+        trace_ids = set()
+        lock = threading.Lock()
+        errors = []
+
+        def client():
+            try:
+                out, headers = self._predict(
+                    router.port, {"features": np.zeros((1, 8)).tolist()})
+                assert len(out["output"]) == 1
+                assert headers.get("x-dl4jtpu-trace-id")
+                assert headers.get("x-dl4jtpu-trace-sampled") == "1"
+                with lock:
+                    trace_ids.add(headers["x-dl4jtpu-trace-id"])
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+        # concurrent requests so least-outstanding SPREADS them — serial
+        # requests would all tie-break onto worker 0. Batches repeat until
+        # merged traces show spans from both worker processes.
+        deadline = time.monotonic() + 90
+        pids = set()
+        docs = {}
+        while time.monotonic() < deadline and len(pids) < 2:
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors[:3]
+            for tid in trace_ids - set(docs):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{router.port}/api/trace/{tid}",
+                        timeout=30) as resp:
+                    docs[tid] = json.loads(resp.read())
+                pids.update(e["pid"] for e in docs[tid]["traceEvents"]
+                            if e["name"] == "worker.predict")
+        assert len(pids) == 2, pids  # spans pulled from BOTH workers
+        # every merged trace chains router -> worker -> service ->
+        # batcher -> device dispatch with the fleet annotations
+        for tid, doc in docs.items():
+            assert doc["displayTimeUnit"] == "ms"
+            assert doc["otherData"]["trace_id"] == tid
+            events = doc["traceEvents"]
+            names = {e["name"] for e in events}
+            assert {"fleet.request", "fleet.attempt", "worker.predict",
+                    "serve.request", "serve.batch",
+                    "infer.dispatch"} <= names, names
+            dispatch = [e for e in events if e["name"] == "infer.dispatch"]
+            assert dispatch[0]["args"]["compiles"] == 0  # warm-boot proof
+            batch = [e for e in events if e["name"] == "serve.batch"]
+            assert batch[0]["args"]["links"]
+            worker_spans = [e for e in events
+                            if e["name"] == "worker.predict"]
+            assert worker_spans[0]["args"]["version"] == 1
+
+    def test_worker_slo_endpoint_shape(self, fleet):
+        router = fleet
+        handle = next(h for h in router.workers if h.ready)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{handle.port}/api/slo",
+                timeout=15) as resp:
+            doc = json.loads(resp.read())
+        assert "objectives" in doc and "windows" in doc
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/api/slo",
+                timeout=15) as resp:
+            doc = json.loads(resp.read())
+        assert "objectives" in doc
+
+
+# ---------------------------------------------------------------------------
+# the /api/fleet stale-ring bugfix
+# ---------------------------------------------------------------------------
+class TestStaleRingExclusion:
+    def test_dead_worker_ring_excluded_from_percentiles(self, tmp_path):
+        from deeplearning4j_tpu.fleet import FleetRouter
+
+        router = FleetRouter(str(tmp_path), workers=2, respawn=False,
+                             poll_s=0.2, registry=MetricsRegistry())
+        fresh, dead = router.workers
+        now = time.monotonic()
+        with fresh.lock:
+            fresh.alive = fresh.ready = True
+            fresh.latency_samples = [0.010] * 50
+            fresh.last_seen = now
+        with dead.lock:
+            dead.alive = dead.ready = False  # heartbeat long gone
+            dead.latency_samples = [9.0] * 50  # would poison p99
+            dead.last_seen = now - 3600.0
+        stats = router.stats()
+        assert stats["latency_seconds"]["samples"] == 50
+        assert stats["latency_seconds"]["p99"] < 1.0
+        assert router._m_stale_rings.value == 1
+        # a second scrape counts the still-stale ring again
+        router.stats()
+        assert router._m_stale_rings.value == 2
+
+    def test_fresh_rings_all_merge(self, tmp_path):
+        from deeplearning4j_tpu.fleet import FleetRouter
+
+        router = FleetRouter(str(tmp_path), workers=2, respawn=False,
+                             registry=MetricsRegistry())
+        now = time.monotonic()
+        for h in router.workers:
+            with h.lock:
+                h.alive = h.ready = True
+                h.latency_samples = [0.02] * 10
+                h.last_seen = now
+        stats = router.stats()
+        assert stats["latency_seconds"]["samples"] == 20
+        assert router._m_stale_rings.value == 0
+
+
+# ---------------------------------------------------------------------------
+# exemplars on /metrics
+# ---------------------------------------------------------------------------
+class TestExemplars:
+    def test_histogram_exposes_last_exemplar_per_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("dl4jtpu_test_latency_seconds", "h",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="aaa")
+        h.observe(0.06, exemplar="bbb")  # replaces aaa in the 0.1 bucket
+        h.observe(5.0, exemplar="ccc")  # lands on +Inf
+        h.observe(0.5)  # no exemplar: bucket renders bare
+        text = reg.prometheus_text()
+        lines = [l for l in text.splitlines() if "_bucket" in l]
+        assert any('le="0.1"' in l and 'trace_id="bbb"' in l
+                   for l in lines), lines
+        assert not any('trace_id="aaa"' in l for l in lines)
+        assert any('le="+Inf"' in l and 'trace_id="ccc"' in l
+                   for l in lines), lines
+        assert any('le="1"' in l and "trace_id" not in l
+                   for l in lines), lines
