@@ -330,13 +330,21 @@ def jaxpr_cost(closed_jaxpr) -> dict:
     return acc
 
 
-def apply_roofline(cost: dict, *, comm_bytes: Optional[int] = None) -> dict:
+def apply_roofline(cost: dict, *, comm_bytes: Optional[int] = None,
+                   pipeline: Optional[dict] = None) -> dict:
     """(Re)compute ``cost["roofline"]`` from its flops/bytes and a per-step
     communication volume. ``comm_bytes`` defaults to the jaxpr-level
     collective tally; the sharding-flow pass calls this again with its
     predicted census total, so ``predicted_step_seconds`` covers the
     communication-bound regime and ``bound`` can come back
-    ``"communication"``."""
+    ``"communication"``.
+
+    ``pipeline={"stages": P, "microbatches": M}`` models a pipelined step:
+    compute/memory work divides across the P stages, and the interleaved
+    schedule idles a bubble fraction ``(P-1)/(M+P-1)`` of every tick window
+    — the predicted seconds inflate by ``1/(1-bubble)``. Communication
+    (the per-microbatch stage handoffs are already in ``comm_bytes``) rides
+    the same schedule, so it inflates too."""
     flops = cost.get("flops", 0)
     nbytes = cost.get("hbm_bytes", 0)
     if comm_bytes is None:
@@ -345,7 +353,19 @@ def apply_roofline(cost: dict, *, comm_bytes: Optional[int] = None) -> dict:
     compute_s = flops / rl["peak_flops"] if rl["peak_flops"] else 0.0
     memory_s = (nbytes / (rl["hbm_gbps"] * 1e9)) if rl["hbm_gbps"] else 0.0
     comm_s = (comm_bytes / (rl["ici_gbps"] * 1e9)) if rl["ici_gbps"] else 0.0
-    rl["predicted_step_seconds"] = max(compute_s, memory_s, comm_s)
+    if pipeline:
+        p = max(int(pipeline.get("stages", 1)), 1)
+        m = max(int(pipeline.get("microbatches", 1)), 1)
+        bubble = (p - 1) / (m + p - 1)
+        rl["pipeline_stages"] = p
+        rl["pipeline_microbatches"] = m
+        rl["bubble_fraction"] = bubble
+        compute_s /= p
+        memory_s /= p
+        rl["predicted_step_seconds"] = (
+            max(compute_s, memory_s, comm_s) / (1.0 - bubble))
+    else:
+        rl["predicted_step_seconds"] = max(compute_s, memory_s, comm_s)
     rl["compute_seconds"] = compute_s
     rl["memory_seconds"] = memory_s
     rl["communication_seconds"] = comm_s

@@ -352,6 +352,18 @@ register_rule(Rule(
     "P(None, 'tp', None)) or gate dim for LSTM kernels, so each device "
     "computes whole heads locally — the ROADMAP 'head-aware tp specs' item.",
 ))
+register_rule(Rule(
+    "DT306", "per-microbatch collective inside a pipeline stage", "warning",
+    "ir",
+    "A non-pipe-axis collective inside the pipelined region repeats once "
+    "per micro-batch tick (the piped twin of DT304): with M micro-batches "
+    "the payload multiplies by M per optimizer step, each a latency-bound "
+    "small transfer riding the same ICI the stage handoffs need.",
+    "Hoist it above the schedule's tick loop — e.g. all-gather fsdp-sharded "
+    "stage params ONCE per step before the micro-batch loop (the transpose "
+    "becomes one reduce-scatter of the stage gradient), not inside the "
+    "stage body.",
+))
 
 # ------------------------------------------------------ runtime-guard rules
 # Pass 5 (analysis/concurrency.py + analysis/runtime_checks.py): AST lint

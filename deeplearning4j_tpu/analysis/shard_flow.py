@@ -181,7 +181,12 @@ class _Flow:
               trip: int, record: bool, param: bool = False) -> None:
         if not record or payload <= 0:
             return
-        axes = tuple(sorted(set(axes)))
+        # size-1 mesh axes compile to nothing (XLA elides the trivial
+        # replica group) — shard_map's transpose still psums over every
+        # axis absent from an in_spec, so a 5-axis mesh with tp=seq=1
+        # would otherwise predict phantom all-reduces the measured HLO
+        # census can never show
+        axes = tuple(sorted({a for a in axes if self.sizes.get(a, 1) > 1}))
         if not axes:
             return
         if kind == "all_reduce" and len(axes) > 1:
@@ -193,13 +198,14 @@ class _Flow:
                     "kind": kind, "axes": (a,), "bytes": int(payload),
                     "count": int(max(1, mult)), "cause": cause,
                     "prim": prim, "scope": scope, "trip": int(trip),
-                    "param": bool(param),
+                    "param": bool(param), "manual": bool(self._manual),
                 })
             return
         self.events.append({
             "kind": kind, "axes": axes, "bytes": int(payload),
             "count": int(max(1, mult)), "cause": cause, "prim": prim,
             "scope": scope, "trip": int(trip), "param": bool(param),
+            "manual": bool(self._manual),
         })
 
     def _gather(self, st: _St, dim_axes: Dict[int, set], *, cause: str,
@@ -1121,9 +1127,13 @@ def flow_report(flow: _Flow) -> dict:
 def shard_findings(flow: _Flow, *, source: str = IR_SOURCE,
                    dt300_floor: int = DT300_FLOOR_BYTES,
                    dt301_floor: int = DT301_FLOOR_BYTES,
-                   dt302_floor: int = DT302_FLOOR_BYTES) -> List[Finding]:
+                   dt302_floor: int = DT302_FLOOR_BYTES,
+                   pipeline_microbatches: Optional[int] = None,
+                   pipe_axis: str = "pipe") -> List[Finding]:
     """DT300-DT304 over the recorded events (DT305 needs layer knowledge
-    and is emitted by :func:`check_network_shard_flow`)."""
+    and is emitted by :func:`check_network_shard_flow`); DT306 — the piped
+    twin of DT304 — when ``pipeline_microbatches`` is given: a collective
+    inside a pipeline stage body repeating once per micro-batch tick."""
     findings: List[Finding] = []
     batch = flow.batch_axes
     for e in flow.events:
@@ -1167,6 +1177,32 @@ def shard_findings(flow: _Flow, *, source: str = IR_SOURCE,
                 f"{e['count']}x ~{_fmt_bytes(payload)} over ({axes}) per "
                 f"optimizer step (trip count {e['trip']})",
                 file=source, context=e["prim"]))
+    if pipeline_microbatches and pipeline_microbatches > 1:
+        # DT306: inside the (manual) pipelined region, the pipe-axis
+        # ppermute handoffs and final psum ARE the schedule — but any other
+        # collective appearing >= M times is running once per micro-batch
+        # tick (e.g. an fsdp param gather traced inside a stage body
+        # instead of hoisted before the tick loop)
+        per_tick: Dict[Tuple[str, Tuple[str, ...], str], dict] = {}
+        for e in flow.events:
+            if not e.get("manual"):
+                continue
+            if pipe_axis in e["axes"]:
+                continue
+            key = (e["kind"], e["axes"], e["prim"])
+            row = per_tick.setdefault(key, {"count": 0, "bytes": 0})
+            row["count"] += e["count"]
+            row["bytes"] += e["bytes"] * e["count"]
+        for (kind, e_axes, prim), row in sorted(per_tick.items()):
+            if row["count"] >= pipeline_microbatches:
+                findings.append(get_rule("DT306").finding(
+                    f"{kind} over ({', '.join(e_axes)}) repeats inside the "
+                    f"pipeline stage body: {row['count']}x per step "
+                    f"(~{_fmt_bytes(row['bytes'])} total) with "
+                    f"{pipeline_microbatches} micro-batches — hoist it "
+                    "above the tick loop so it runs once per step, not "
+                    "once per micro-batch",
+                    file=source, context=prim))
     return merge_findings(findings)
 
 
@@ -1183,11 +1219,14 @@ def _flatten_specs(spec_tree) -> List[Any]:
 
 def analyze_shard_flow(fn, example_args, in_specs, layout, *,
                        declared_out_specs=None, param_argnums: Sequence[int]
-                       = (), source: str = IR_SOURCE) -> dict:
+                       = (), pipeline_microbatches: Optional[int] = None,
+                       source: str = IR_SOURCE) -> dict:
     """Trace ``fn`` over ``example_args`` (arrays or ShapeDtypeStructs —
     nothing executes) and run the propagation seeded with ``in_specs`` (a
     pytree-of-PartitionSpecs per argument, or flat list). Returns
-    ``{"findings": [...], **flow_report}``."""
+    ``{"findings": [...], **flow_report}``. ``pipeline_microbatches``
+    enables the DT306 per-microbatch-collective advisory for pipelined
+    steps."""
     import jax  # noqa: PLC0415
 
     closed = jax.make_jaxpr(fn)(*example_args)
@@ -1202,7 +1241,8 @@ def analyze_shard_flow(fn, example_args, in_specs, layout, *,
                                if declared_out_specs is not None else None),
                            param_flags=flags)
     report = flow_report(flow)
-    report["findings"] = shard_findings(flow, source=source)
+    report["findings"] = shard_findings(
+        flow, source=source, pipeline_microbatches=pipeline_microbatches)
     return report
 
 

@@ -30,8 +30,11 @@ from .param_server import (
 )
 from .ring_attention import all_to_all_attention, attention, ring_attention
 from .pipeline import (
+    PipelinePlan,
+    PipelinedTrainer,
     pipeline_apply,
     pipeline_shardings,
+    plan_stages,
     sequential_apply,
     stack_stage_params,
 )
@@ -62,8 +65,11 @@ __all__ = [
     "ParameterServerParallelWrapper",
     "attention",
     "ring_attention",
+    "PipelinePlan",
+    "PipelinedTrainer",
     "pipeline_apply",
     "pipeline_shardings",
+    "plan_stages",
     "sequential_apply",
     "stack_stage_params",
     "all_to_all_attention",

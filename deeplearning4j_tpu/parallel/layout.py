@@ -7,9 +7,9 @@ trainable model was bounded by one chip's HBM. This module is the single
 GSPMD-style layout authority (per Xu et al., *GSPMD*; ZeRO-style parameter
 sharding per Rajbhandari et al., *ZeRO*) the ROADMAP tentpole names:
 
-- **One named mesh** with ``("data", "fsdp", "tp")`` axes. Any axis of size
-  1 collapses out of the emitted PartitionSpecs (the mesh keeps all three
-  names so specs stay portable across layouts).
+- **One named mesh** with ``("data", "fsdp", "tp", "seq", "pipe")`` axes.
+  Any axis of size 1 collapses out of the emitted PartitionSpecs (the mesh
+  keeps all names so specs stay portable across layouts).
 - **Parameter-name→spec assignment** in the style of SNIPPETS.md [2]
   (``SpecLayout``): 2-D+ kernels shard their last dim over ``tp`` when
   divisible and a divisible non-tp dim over ``fsdp``; 1-D vectors follow
@@ -106,28 +106,32 @@ class MeshLayout:
     """One named mesh + the spec rules every scale path shares."""
 
     def __init__(self, data: Optional[int] = None, fsdp: int = 1, tp: int = 1,
-                 seq: int = 1, *, devices: Optional[Sequence] = None,
+                 seq: int = 1, pipe: int = 1, *,
+                 devices: Optional[Sequence] = None,
                  params_dtype: Optional[str] = None, zero_stage: int = 3,
                  roles: bool = False):
         import jax
         from jax.sharding import Mesh
 
-        fsdp, tp, seq = int(fsdp), int(tp), int(seq)
-        if fsdp < 1 or tp < 1 or seq < 1:
+        fsdp, tp, seq, pipe = int(fsdp), int(tp), int(seq), int(pipe)
+        if fsdp < 1 or tp < 1 or seq < 1 or pipe < 1:
             raise ValueError(
-                f"axis sizes must be >= 1, got fsdp={fsdp} tp={tp} seq={seq}")
+                f"axis sizes must be >= 1, got fsdp={fsdp} tp={tp} "
+                f"seq={seq} pipe={pipe}")
         devs = list(devices) if devices is not None else jax.devices()
         if data is None:
-            data = max(1, len(devs) // (fsdp * tp * seq))
+            data = max(1, len(devs) // (fsdp * tp * seq * pipe))
         data = int(data)
-        need = data * fsdp * tp * seq
+        need = data * fsdp * tp * seq * pipe
         if need > len(devs):
             raise ValueError(
                 f"layout data={data} x fsdp={fsdp} x tp={tp} x seq={seq} "
-                f"needs {need} devices, have {len(devs)}")
-        arr = np.array(devs[:need]).reshape(data, fsdp, tp, seq)
-        self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp", "seq"))
-        self._init_axes({"data": data, "fsdp": fsdp, "tp": tp, "seq": seq},
+                f"x pipe={pipe} needs {need} devices, have {len(devs)}")
+        arr = np.array(devs[:need]).reshape(data, fsdp, tp, seq, pipe)
+        self.mesh = Mesh(arr, axis_names=("data", "fsdp", "tp", "seq",
+                                          "pipe"))
+        self._init_axes({"data": data, "fsdp": fsdp, "tp": tp, "seq": seq,
+                         "pipe": pipe},
                         params_dtype=params_dtype, zero_stage=zero_stage,
                         roles=roles)
 
@@ -151,12 +155,17 @@ class MeshLayout:
             self._expert_axis = None
             self._seq_axis = ("seq" if self._axis_sizes.get("seq", 1) > 1
                               else None)
+            self._pipe_axis = ("pipe" if self._axis_sizes.get("pipe", 1) > 1
+                               else None)
         else:
             # legacy from_mesh semantics: every non-model/expert axis is a
-            # batch axis, size-1 included (spec spellings feed cache keys)
+            # batch axis, size-1 included (spec spellings feed cache keys).
+            # An axis literally named "pipe" carries pipeline stages, never
+            # batch rows — the legacy GPipe path's silent divergence was
+            # exactly a hand-rolled rule set that had to know this.
             self._batch_axes = tuple(
                 a for a in self._axis_sizes
-                if a not in (model_axis, expert_axis))
+                if a not in (model_axis, expert_axis, "pipe"))
             self._fsdp_axis = "fsdp" if (
                 self._axis_sizes.get("fsdp", 1) > 1
                 and "fsdp" not in (model_axis, expert_axis)) else None
@@ -168,6 +177,7 @@ class MeshLayout:
             if self._seq_axis is not None:
                 self._batch_axes = tuple(
                     a for a in self._batch_axes if a != "seq")
+            self._pipe_axis = "pipe" if "pipe" in self._axis_sizes else None
         self.zero_stage = int(zero_stage)
         self.precision = PrecisionPolicy(params_dtype=params_dtype)
         self.roles = bool(roles)
@@ -201,7 +211,8 @@ class MeshLayout:
 
     @classmethod
     def abstract(cls, data: int = 1, fsdp: int = 1, tp: int = 1,
-                 seq: int = 1, *, params_dtype: Optional[str] = None,
+                 seq: int = 1, pipe: int = 1, *,
+                 params_dtype: Optional[str] = None,
                  zero_stage: int = 3, roles: bool = False) -> "MeshLayout":
         """A device-less layout: pure spec algebra (``param_spec``,
         ``batch_spec``, the sharding-flow pass) with NO jax mesh behind it —
@@ -211,7 +222,7 @@ class MeshLayout:
         self = cls.__new__(cls)
         self.mesh = None
         self._init_axes({"data": int(data), "fsdp": int(fsdp),
-                         "tp": int(tp), "seq": int(seq)},
+                         "tp": int(tp), "seq": int(seq), "pipe": int(pipe)},
                         params_dtype=params_dtype, zero_stage=zero_stage,
                         roles=roles)
         return self
@@ -233,6 +244,16 @@ class MeshLayout:
         """How many ways the batch dim shards (global batch must divide it)."""
         return int(np.prod([self.mesh.shape[a] for a in self._batch_axes],
                            dtype=np.int64)) if self._batch_axes else 1
+
+    @property
+    def pipe_axis(self) -> Optional[str]:
+        return self._pipe_axis
+
+    @property
+    def pipe_size(self) -> int:
+        """Pipeline stage count (1 = no pipe axis)."""
+        return self._size(self._pipe_axis) if self._pipe_axis else int(
+            self._axis_sizes.get("pipe", 1))
 
     @property
     def num_devices(self) -> int:
@@ -264,6 +285,26 @@ class MeshLayout:
         if self._seq_axis is not None and ndim is not None and ndim >= 3:
             return P(self._batch_axes or None, self._seq_axis)
         return self.batch_spec()
+
+    def stage_spec(self, shape=None):
+        """Spec for a stage-stacked leaf ``[P, ...]``: dim 0 over the pipe
+        axis, every other dim replicated — the one rule the pipeline path
+        shares with everything else (``pipeline_shardings`` routes here
+        instead of hand-building NamedShardings)."""
+        from jax.sharding import PartitionSpec as P
+
+        if self._pipe_axis is None and "pipe" not in self._axis_sizes:
+            raise ValueError(
+                "stage_spec needs a pipe axis; this layout has axes "
+                f"{tuple(self._axis_sizes)}")
+        return P("pipe")
+
+    def stage_specs(self, tree):
+        """PartitionSpec pytree for a stage-stacked param tree."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: self.stage_spec(np.shape(a)), tree)
 
     def input_sharding(self, arr=None):
         """NamedSharding for one input tensor (:meth:`input_spec` of its
@@ -563,6 +604,12 @@ class MeshLayout:
         ParallelWrapper) discovers the placement. Idempotent."""
         import jax
 
+        if self._pipe_axis is not None:
+            raise ValueError(
+                f"pipe={self._size(self._pipe_axis)} stages layers across "
+                "devices — generic leaf-wise placement cannot express it. "
+                "Use parallel.pipeline.PipelinedTrainer(net, layout) for "
+                "pipelined training")
         net.init()
         self.bind(net)
         if self._seq_axis is not None:
@@ -739,6 +786,7 @@ class MeshLayout:
             "fsdp_axis": self._fsdp_axis,
             "tp_axis": self._tp_axis,
             "seq_axis": self._seq_axis,
+            "pipe_axis": self._pipe_axis,
             "expert_axis": self._expert_axis,
             "devices": self.num_devices,
             "zero_stage": self.zero_stage,

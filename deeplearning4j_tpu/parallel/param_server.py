@@ -175,35 +175,49 @@ class ParameterServerParallelWrapper:
     Reference: ParameterServerParallelWrapper.java — N trainer threads, each
     with a model replica, pushing gradients and pulling fresh parameters
     per minibatch (no barrier; the 'hogwild-over-transport' topology).
+
+    Mesh handling folds onto :class:`~.layout.MeshLayout` (the one
+    layout/spec source): pass ``layout=`` and the wrapper DT008-validates
+    the net's param specs against it up front (``layout.validate``) and
+    places every pulled snapshot with ``layout.put_params`` so replicas
+    live on the layout's shardings instead of a bespoke placement rule.
+    The flat wire vector comes from ``jax.flatten_util.ravel_pytree`` —
+    no hand-rolled shape/offset bookkeeping to drift from the net.
     """
 
     def __init__(self, net, workers: int = 2, learning_rate: float = 0.01,
-                 port: int = 0):
-        import jax  # noqa: PLC0415
+                 port: int = 0, layout=None):
+        from jax.flatten_util import ravel_pytree  # noqa: PLC0415
 
         self.net = net
         net.init()
+        self.layout = layout
+        if layout is not None:
+            findings = layout.validate(
+                net.params, net=net,
+                source="<ParameterServerParallelWrapper>")
+            errors = [f for f in findings if f.severity == "error"]
+            if errors:
+                raise ValueError(
+                    "layout failed DT008 validation: "
+                    + "; ".join(f.message for f in errors))
         self.workers = int(workers)
-        leaves, self._treedef = jax.tree_util.tree_flatten(net.params)
-        self._shapes = [np.shape(l) for l in leaves]
-        self._sizes = [int(np.prod(s)) for s in self._shapes]
-        flat = np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
-        self.server = ParameterServer(flat, learning_rate=learning_rate, port=port)
+        flat, self._unravel = ravel_pytree(net.params)
+        self.server = ParameterServer(
+            np.ascontiguousarray(np.asarray(flat), np.float32),
+            learning_rate=learning_rate, port=port)
 
     def _unflatten(self, flat: np.ndarray):
-        import jax  # noqa: PLC0415
-
-        leaves, off = [], 0
-        for shape, size in zip(self._shapes, self._sizes):
-            leaves.append(flat[off : off + size].reshape(shape))
-            off += size
-        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+        params = self._unravel(np.asarray(flat))
+        if self.layout is not None and self.layout.mesh is not None:
+            params = self.layout.put_params(params)
+        return params
 
     def _flatten_tree(self, tree) -> np.ndarray:
-        import jax  # noqa: PLC0415
+        from jax.flatten_util import ravel_pytree  # noqa: PLC0415
 
-        leaves = jax.tree_util.tree_leaves(tree)
-        return np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
+        return np.ascontiguousarray(
+            np.asarray(ravel_pytree(tree)[0]), np.float32)
 
     def fit(self, data, epochs: int = 1) -> "ParameterServerParallelWrapper":
         import jax  # noqa: PLC0415

@@ -191,6 +191,12 @@ def _register_builtins() -> None:
              "call", cost_hint="memory", contexts=(),
              doc="parameter storage dtype (parallel.PrecisionPolicy); "
                  "trial-scoped — changing a live net's dtype re-inits it"))
+    add(Knob("pipe_microbatches", (2, 4, 8, 16), 4, "call",
+             cost_hint="memory", contexts=(),
+             doc="micro-batches per pipelined step (PipelinedTrainer "
+                 "microbatches=): more shrinks the (P-1)/(M+P-1) schedule "
+                 "bubble, but every in-flight micro-batch stashes its "
+                 "activations — the HBM preflight arbitrates"))
     # ---- env knobs: surfaces read these dynamically; scoped apply only
     add(Knob("donation", (True, False), True, "env", env=DONATE_ENV,
              cost_hint="memory", contexts=(),

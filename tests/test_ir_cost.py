@@ -596,7 +596,7 @@ class TestRuleCatalog:
         assert ir_rules == {"DT200", "DT201", "DT202", "DT203", "DT204",
                             "DT205", "DT206", "DT207",
                             "DT300", "DT301", "DT302", "DT303", "DT304",
-                            "DT305"}
+                            "DT305", "DT306"}
 
     def test_ir_rules_registered_with_hints(self):
         for rid, rule in RULES.items():

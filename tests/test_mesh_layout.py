@@ -60,7 +60,8 @@ def _data(n=32, features=16, classes=4, seed=0):
 class TestSpecRules:
     def test_canonical_mesh_axes(self):
         lo = MeshLayout(data=2, fsdp=2, tp=1, devices=_devices())
-        assert lo.axis_sizes == {"data": 2, "fsdp": 2, "tp": 1, "seq": 1}
+        assert lo.axis_sizes == {"data": 2, "fsdp": 2, "tp": 1, "seq": 1,
+                                 "pipe": 1}
         assert lo.batch_axes == ("data", "fsdp")
         assert lo.batch_factor == 4
 

@@ -51,3 +51,84 @@ def test_frame_length_caps_reject_hostile_prefixes():
     c2.close()
     t.join(5)
     t2.join(5)
+
+
+def test_wrapper_layout_fold_places_and_validates():
+    """ISSUE 18: the wrapper's mesh handling folds onto MeshLayout — a
+    passed layout DT008-validates the net's specs up front, and every
+    pulled snapshot comes back placed with the layout's NamedShardings
+    (no bespoke flatten/placement bookkeeping left to drift)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.parallel import (
+        MeshLayout,
+        ParameterServerParallelWrapper,
+    )
+
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        dtype="float32",
+    )).init()
+    ref_shapes = [[leaf.shape for leaf in jax.tree_util.tree_leaves(p)]
+                  for p in net.params]
+    lo = MeshLayout(data=4, devices=jax.devices()[:4])
+    w = ParameterServerParallelWrapper(net, workers=2, learning_rate=0.01,
+                                       layout=lo)
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        w.fit(DataSet(x, y))
+        assert w.server.num_updates >= 1
+        for p, ref in zip(net.params, ref_shapes):
+            for leaf, shape in zip(jax.tree_util.tree_leaves(p), ref):
+                assert leaf.shape == shape
+                assert leaf.dtype == np.float32
+                assert leaf.sharding == lo.sharding(lo.param_spec(shape))
+    finally:
+        w.shutdown()
+
+
+def test_wrapper_rejects_dt008_invalid_layout():
+    """A layout whose role-resolved specs fail DT008 (tp not dividing the
+    head count) must be rejected at construction, not at first pull."""
+    import jax
+    import pytest
+
+    from deeplearning4j_tpu import (
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.parallel import (
+        MeshLayout,
+        ParameterServerParallelWrapper,
+    )
+
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[SelfAttentionLayer(n_out=96, n_heads=3,
+                                   activation="identity"),
+                RnnOutputLayer(n_in=96, n_out=8, activation="softmax",
+                               loss="mcxent")],
+        input_type=InputType.recurrent(16),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+    )).init()
+    lo = MeshLayout(data=2, tp=2, roles=True, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="DT008"):
+        ParameterServerParallelWrapper(net, layout=lo)

@@ -1,0 +1,222 @@
+"""Pipeline mesh axis (ISSUE 18): the 1F1B micro-batch interleaved schedule
+through MeshLayout(pipe=N) + PipelinedTrainer.
+
+The bar matches PR 15's seq axis: trajectory parity against the unpiped
+trainer (the schedule reorders work, not math), predicted-vs-measured
+collective census parity (the static flow pass must follow the pipelined
+shard_map natively), cost-balanced stage partitioning beating equal-count
+on a skewed model, the HBM preflight catching an over-stash micro-batch
+count BEFORE any compile, and zero warm compiles on the fit path.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.parallel import MeshLayout, PipelinedTrainer, plan_stages
+from deeplearning4j_tpu.runtime.compile_manager import get_compile_manager
+
+
+def _dense_net(hidden=32, feat=16, classes=8, depth=3, seed=7):
+    return MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=hidden, activation="relu")
+                for _ in range(depth)]
+        + [OutputLayer(n_out=classes, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(feat),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )).init()
+
+
+def _char_net(vocab=12, hidden=16, seed=3):
+    """charrnn-shaped stacked LSTM, but with DEFAULT backprop: tbptt
+    truncation would change the unpiped reference's math, and the parity
+    oracle needs both sides computing the same loss."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+
+    return MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[GravesLSTM(n_in=vocab, n_out=hidden, activation="tanh"),
+                GravesLSTM(n_in=hidden, n_out=hidden, activation="tanh"),
+                RnnOutputLayer(n_in=hidden, n_out=vocab,
+                               activation="softmax", loss="mcxent")],
+        input_type=InputType.recurrent(vocab),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-2),
+        seed=seed,
+    )).init()
+
+
+def _dense_batch(b=32, feat=16, classes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(b, feat)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, b)]
+    return x, y
+
+
+def _assert_params_close(piped_net, ref_net, rtol=2e-4):
+    import jax
+
+    for i, (a, b) in enumerate(zip(jax.tree_util.tree_leaves(piped_net.params),
+                                   jax.tree_util.tree_leaves(ref_net.params))):
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b, np.float64),
+                                   rtol=rtol, atol=1e-6,
+                                   err_msg=f"param leaf {i} diverged")
+
+
+class TestTrajectoryParity:
+    """The pipelined step must walk the SAME optimizer trajectory as the
+    unpiped net — micro-batching, the tick schedule, ppermute handoffs and
+    the packed parameter layout are all implementation detail."""
+
+    def test_dense_piped_vs_unpiped(self):
+        x, y = _dense_batch()
+        tr = PipelinedTrainer(_dense_net(), MeshLayout(data=2, pipe=2),
+                              microbatches=4)
+        losses = tr.fit(x, y, steps=3)
+        assert np.all(np.isfinite(losses))
+        tr.unpack_to_net()
+
+        ref = _dense_net()
+        ref.fit(DataSet(x, y), epochs=3)
+        _assert_params_close(tr.net, ref)
+
+    def test_charrnn_piped_vs_unpiped(self):
+        vocab, b, t = 12, 16, 6
+        rng = np.random.default_rng(1)
+        x = np.eye(vocab, dtype=np.float32)[
+            rng.integers(0, vocab, (b, t))]
+        y = np.eye(vocab, dtype=np.float32)[
+            rng.integers(0, vocab, (b, t))]
+        tr = PipelinedTrainer(_char_net(vocab), MeshLayout(data=2, pipe=2),
+                              microbatches=4)
+        losses = tr.fit(x, y, steps=2)
+        assert np.all(np.isfinite(losses))
+        tr.unpack_to_net()
+
+        ref = _char_net(vocab)
+        ref.fit(DataSet(x, y), epochs=2)
+        _assert_params_close(tr.net, ref)
+
+
+class TestCensusParity:
+    """The static flow pass walks the pipelined shard_map natively: its
+    predicted census (per-microbatch ppermute attribution included) must
+    match the collectives parsed from the compiled step's post-SPMD HLO."""
+
+    @pytest.mark.parametrize("layout_kw", [
+        {"data": 2, "pipe": 2},
+        {"tp": 2, "pipe": 2},
+    ], ids=["pipe_x_dp", "pipe_x_tp"])
+    def test_predicted_matches_measured(self, layout_kw):
+        from deeplearning4j_tpu.analysis.shard_flow import compare_census
+
+        x, y = _dense_batch()
+        tr = PipelinedTrainer(_dense_net(), MeshLayout(**layout_kw),
+                              microbatches=4)
+        flow = tr.analyze(x, y)
+        assert flow["findings"] == [], \
+            [f.format_human() for f in flow["findings"]]
+        assert any(r["kind"] == "collective_permute"
+                   and r["axes"] == ["pipe"] for r in flow["census"]), \
+            flow["census"]
+        res = compare_census(flow["census"], tr.measured_census(x, y))
+        assert res["ok"], (res["problems"], flow["census"])
+
+
+class TestStagePartitioning:
+    def test_cost_balanced_beats_equal_count(self):
+        """Skewed model: two wide layers up front, two narrow behind. The
+        equal-count split pairs the wide ones on stage 0; the FLOPs/bytes
+        walker must do better."""
+        net = MultiLayerNetwork(MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=256, activation="relu"),
+                    DenseLayer(n_out=256, activation="relu"),
+                    DenseLayer(n_out=16, activation="relu"),
+                    DenseLayer(n_out=16, activation="relu"),
+                    OutputLayer(n_out=8, activation="softmax",
+                                loss="mcxent")],
+            input_type=InputType.feed_forward(64),
+            updater=UpdaterConfig(updater="adam", learning_rate=1e-3),
+        )).init()
+        balanced = plan_stages(net, 2, 32, balance=True)
+        naive = plan_stages(net, 2, 32, balance=False)
+        assert balanced.balanced and not naive.balanced
+        assert naive.stages == ((0, 1), (2, 3))
+        assert balanced.max_cost < naive.max_cost, (
+            balanced.describe(), naive.describe())
+
+    def test_needs_enough_layers(self):
+        with pytest.raises(ValueError, match="stage"):
+            plan_stages(_dense_net(depth=1), 4, 32)
+
+
+class TestPreflight:
+    def test_over_stash_microbatches_raises(self):
+        """Every in-flight micro-batch stashes its stage activations; an
+        over-eager microbatches= must fail the projection BEFORE a doomed
+        compile, naming the worst stage."""
+        from deeplearning4j_tpu.telemetry.memory import MemoryPreflightError
+
+        x, y = _dense_batch()
+        tr = PipelinedTrainer(_dense_net(), MeshLayout(data=2, pipe=2),
+                              microbatches=4)
+        rep = tr.preflight(x, y)
+        peak = rep["pipeline"]["projected_peak_bytes_per_device"]
+        assert rep["pipeline"]["in_flight"] == 4 + 2 - 1
+        assert peak > 0
+        with pytest.raises(MemoryPreflightError, match="micro-batch"):
+            tr.preflight(x, y, limit_bytes=peak // 2)
+
+    def test_stash_grows_with_microbatches(self):
+        lo = MeshLayout(data=2, pipe=2)
+        stash = []
+        for m in (2, 8):
+            x, y = _dense_batch(b=16 * m)
+            tr = PipelinedTrainer(_dense_net(), lo, microbatches=m)
+            rep = tr.preflight(x, y)
+            stash.append(max(r["stash_bytes"]
+                             for r in rep["pipeline"]["stages"]))
+        # fixed micro-batch SIZE: every extra in-flight micro-batch stashes
+        # another full set of stage residuals (M+P-1 of them total)
+        assert stash[1] > stash[0], stash
+
+
+class TestCompileDiscipline:
+    def test_zero_warm_compiles(self):
+        x, y = _dense_batch()
+        tr = PipelinedTrainer(_dense_net(), MeshLayout(data=2, pipe=2),
+                              microbatches=4)
+        tr.warm_up(x, y)
+        cm = get_compile_manager()
+        before = cm.compiles.value
+        tr.fit(x, y, steps=4)
+        assert cm.compiles.value - before == 0
+
+
+class TestLayoutContract:
+    def test_seq_axis_rejected(self):
+        with pytest.raises(ValueError, match="seq"):
+            PipelinedTrainer(_dense_net(), MeshLayout(seq=2, pipe=2),
+                             microbatches=2)
+
+    def test_apply_directs_to_trainer(self):
+        net = _dense_net()
+        with pytest.raises(ValueError, match="PipelinedTrainer"):
+            MeshLayout(data=2, pipe=2).apply(net)
+
+    def test_knob_registered(self):
+        from deeplearning4j_tpu.tune.knobs import get_knob
+
+        knob = get_knob("pipe_microbatches")
+        assert knob.default == 4 and knob.cost_hint == "memory"
+        # the default seeds PipelinedTrainer(microbatches=None)
+        tr = PipelinedTrainer(_dense_net(), MeshLayout(data=2, pipe=2))
+        assert tr.microbatches == knob.default

@@ -315,6 +315,73 @@ class TestDT300Family:
         assert "DT305" not in {f.rule_id for f in flow["findings"]}
 
 
+# ------------------------------------------------------------------ DT306
+class TestDT306:
+    """Per-microbatch collective inside a pipeline stage body (ISSUE 18) —
+    the piped twin of DT304. The pipe-axis ppermute handoffs ARE the 1F1B
+    schedule; any OTHER collective repeating >= M times inside the manual
+    region is paying its cost once per micro-batch tick."""
+
+    M = 4
+
+    def _lo(self):
+        return MeshLayout(tp=2, pipe=2, devices=_devices())
+
+    def _piped(self, lo, *, hoist):
+        """A pipe x tp manual region shaped like the 1F1B tick loop: per
+        tick a stage matmul, a pipe ppermute handoff, and — unless hoisted
+        — a tp psum of the activations inside the tick body."""
+        from jax.experimental.shard_map import shard_map
+
+        m, p = self.M, 2
+
+        def region(x, w):
+            acc = x[0]
+            if hoist:
+                w = jax.lax.psum(w, "tp")  # once per step: fine
+            for t in range(m + p - 1):
+                acc = jnp.tanh(acc @ w)
+                if not hoist:
+                    acc = jax.lax.psum(acc, "tp")  # once per TICK: DT306
+                acc = jax.lax.ppermute(acc, "pipe",
+                                       [(i, (i + 1) % p) for i in range(p)])
+            return acc[None]
+
+        return shard_map(region, lo.mesh,
+                         in_specs=(P("pipe"), P()),
+                         out_specs=P("pipe"), check_rep=False)
+
+    def _analyze(self, *, hoist, microbatches):
+        lo = self._lo()
+        return analyze_shard_flow(
+            self._piped(lo, hoist=hoist),
+            (jax.ShapeDtypeStruct((2, 8, 64), jnp.float32),
+             jax.ShapeDtypeStruct((64, 64), jnp.float32)),
+            (P("pipe"), P()), lo,
+            pipeline_microbatches=microbatches)
+
+    def test_fires_on_per_tick_collective(self):
+        rep = self._analyze(hoist=False, microbatches=self.M)
+        hits = [f for f in rep["findings"] if f.rule_id == "DT306"]
+        assert hits, [f.format_human() for f in rep["findings"]]
+        assert "hoist" in hits[0].message
+        # the schedule's own pipe-axis handoffs never count toward DT306
+        assert "pipe" not in hits[0].message.split("repeats")[0]
+
+    def test_silent_without_microbatch_count(self):
+        # the same trace analyzed as a NON-pipelined program (no
+        # pipeline_microbatches=) carries no DT306
+        rep = self._analyze(hoist=False, microbatches=None)
+        assert "DT306" not in {f.rule_id for f in rep["findings"]}
+
+    def test_clean_when_hoisted_above_tick_loop(self):
+        rep = self._analyze(hoist=True, microbatches=self.M)
+        assert "DT306" not in {f.rule_id for f in rep["findings"]}
+        # the handoffs themselves still land in the census, on the pipe axis
+        assert any(r["kind"] == "collective_permute"
+                   and r["axes"] == ["pipe"] for r in rep["census"])
+
+
 # ------------------------------------------------------------------ ZeRO-1
 class TestZero1:
     def test_spec_rules(self):
@@ -495,7 +562,8 @@ class TestCommunicationRoofline:
 class TestAbstractLayoutAndCli:
     def test_abstract_layout_spec_algebra(self):
         lo = MeshLayout.abstract(data=8, fsdp=4, tp=2)
-        assert lo.axis_sizes == {"data": 8, "fsdp": 4, "tp": 2, "seq": 1}
+        assert lo.axis_sizes == {"data": 8, "fsdp": 4, "tp": 2, "seq": 1,
+                                 "pipe": 1}
         assert lo.num_devices == 64
         assert lo.param_spec((128, 256)) == P("fsdp", "tp")
         assert lo.batch_spec() == P(("data", "fsdp"))
