@@ -1173,6 +1173,136 @@ def bench_fleet(feature_dim: int = 16, classes: int = 8,
     return result
 
 
+def bench_history(feature_dim: int = 16, classes: int = 8,
+                  clients: int = 4, requests_per_client: int = 40,
+                  max_rows: int = 8, rounds: int = 5,
+                  workers: int = 2) -> dict:
+    """History-plane overhead (ISSUE 19 acceptance): ONE warm-booted
+    2-worker fleet with the scrape loop + process sampler live, the SAME
+    offered load run in interleaved trials with history ingestion
+    toggled off/on (``set_history_enabled`` pauses the router scrape,
+    the process sampler and every worker's sampler). The gated metric is
+    history-ON throughput; ``overhead_ratio`` (median on / median off)
+    must stay within 3% of disabled — check.sh enforces the 1.03
+    ceiling. Select with BENCH_MODEL=history."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.fleet import FleetRouter, build_bundle, save_bundle
+    from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[
+            DenseLayer(n_out=32, activation="relu"),
+            OutputLayer(n_out=classes, activation="softmax", loss="mcxent"),
+        ],
+        input_type=InputType.feed_forward(feature_dim),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        seed=7,
+    )).init()
+    work = tempfile.mkdtemp(prefix="dl4jtpu-bench-history-")
+    store_dir = os.path.join(work, "store")
+    store = CheckpointStore(store_dir)
+    store.save(net)
+    save_bundle(store, build_bundle(
+        net, example=np.zeros((1, feature_dim), np.float32), argmax=True,
+        max_batch=max_rows))
+    rng = np.random.default_rng(0)
+    shapes = [rng.normal(size=(1 + int(r), feature_dim)).astype(np.float32)
+              for r in rng.integers(0, max_rows, size=64)]
+
+    def trial(router) -> float:
+        rows_served = [0] * clients
+        errors = []
+
+        def client(ci: int):
+            for i in range(requests_per_client):
+                x = shapes[(ci * requests_per_client + i) % len(shapes)]
+                status, body, _ = router.route_predict(
+                    {"features": x.tolist()})
+                if status == 200:
+                    rows_served[ci] += len(body["output"])
+                else:
+                    errors.append(status)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed requests: "
+                               f"{sorted(set(errors))}")
+        return sum(rows_served) / dt
+
+    router = FleetRouter(
+        store_dir, workers=workers, poll_s=0.5, scrape_s=0.5,
+        history=True, shed_outstanding=4096, respawn=False,
+        worker_args={"max_delay_ms": 0, "max_batch": max_rows})
+    router.start()
+    off, on = [], []
+    try:
+        trial(router)  # warm both workers' compiled paths
+        for _ in range(rounds):  # interleaved so drift hits both arms
+            router.set_history_enabled(False)
+            off.append(trial(router))
+            router.set_history_enabled(True)
+            on.append(trial(router))
+        router.scrape_once()  # the artifact carries a live sensor proof
+        history_stats = router.history.stats()
+        sensor_series = sorted(
+            n for n in router.history.series_names()
+            if n.startswith(("fleet.", "worker.")))
+        stats = router.stats()
+        worker_compiles = [w["compiles_since_ready"]
+                           for w in stats["workers"]]
+    finally:
+        router.stop()
+        shutil.rmtree(work, ignore_errors=True)
+    m_off = statistics.median(off)
+    m_on = statistics.median(on)
+    result = {
+        "metric": "history_on_samples_per_sec",
+        "value": round(m_on, 1),
+        "unit": "samples/sec",
+        "overhead_ratio": round(m_off / max(m_on, 1e-9), 4),
+        "samples_per_sec_off": round(m_off, 1),
+        "trials_off": [round(v, 1) for v in off],
+        "trials_on": [round(v, 1) for v in on],
+        "history_series": history_stats["series"],
+        "history_samples_total": history_stats["samples_total"],
+        "history_bytes": history_stats["bytes"],
+        "history_byte_budget": history_stats["byte_budget"],
+        "sensor_series": sensor_series,
+        "warm_compiles": worker_compiles,
+        "shape": {"feature_dim": feature_dim, "classes": classes,
+                  "clients": clients, "max_rows": max_rows,
+                  "requests_per_client": requests_per_client,
+                  "rounds": rounds, "workers": workers},
+    }
+    result["telemetry"] = _telemetry_block(
+        [1.0 / max(m_on, 1e-9)],
+        extra_gauges={
+            "bench_samples_per_sec": result["value"],
+            "bench_history_overhead_ratio": result["overhead_ratio"],
+        })
+    result["memory"] = _memory_block()
+    return result
+
+
 def bench_shard(batch: int = 256, hidden: int = 2048, feature_dim: int = 784,
                 classes: int = 10, steps: int = 12, groups: int = 2) -> dict:
     """Sharding-layout throughput + per-device HBM (ISSUE 8 acceptance):
@@ -1644,6 +1774,10 @@ def _tpu_child_main() -> int:
         # the fleet workers are forced-CPU subprocesses either way; the
         # measurement is the host-side router/warm-boot machinery
         result = bench_fleet(clients=_ienv("BENCH_CLIENTS", 8))
+    elif os.environ.get("BENCH_MODEL") == "history":
+        # same forced-CPU fleet; the measurement is the sampler + scrape
+        # plane's cost against the identical load with history paused
+        result = bench_history(clients=_ienv("BENCH_CLIENTS", 4))
     elif os.environ.get("BENCH_MODEL") == "autotune":
         result = bench_autotune()
     elif os.environ.get("BENCH_MODEL") == "attention":
@@ -1800,6 +1934,11 @@ if __name__ == "__main__":
                 # construction, so the fallback IS the measurement — the
                 # check.sh fleet gate runs exactly this
                 result = bench_fleet()
+            elif mode == "history":
+                # on-vs-off ratio over forced-CPU fleet workers: the CPU
+                # fallback IS the measurement — the check.sh history
+                # gate runs exactly this
+                result = bench_history()
             else:
                 result = bench_mlp_mnist()
             # The tunnel was unavailable THIS run; surface the most recent

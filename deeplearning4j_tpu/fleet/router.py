@@ -45,6 +45,21 @@ worker's into one Chrome-trace document, splicing
 rollout/respawn/swap events as instants; ``GET /api/slo`` exposes the
 router-level burn rates (objectives are env-opt-in via
 ``DL4JTPU_SLO_*``).
+
+**History scrape plane** (docs/observability.md § Metric history): a
+fourth loop polls every live worker's ``/metrics`` + ``/api/worker``
+each ``scrape_s`` under the ``fleet.router.scrape`` Deadline policy,
+ingests the samples into the process :class:`HistoryStore` with
+``{worker, model}`` labels, runs the :class:`FleetRecordingRules`
+pass (offered load, shed rate, exact p99, queue depth, boot→READY
+seconds, compile counts + ``dl4jtpu_forecast_*`` EWMA/Holt signals)
+over :meth:`stats`, and splices rollout/respawn/swap/slo-burn flight
+events onto the timeline as annotations. Workers past the PR 17
+stale-ring heartbeat cutoff have their series gap-marked stale, never
+flat-lined. ``GET /api/history`` serves the query endpoint; ``POST
+/history {"enabled": false}`` pauses ingestion fleet-wide (the bench
+overhead gate toggles this between interleaved trials). Disable with
+``DL4JTPU_HISTORY=0``.
 """
 
 from __future__ import annotations
@@ -111,6 +126,7 @@ class WorkerHandle:
         self.outstanding = 0
         self.respawns = 0
         self.fail_count = 0  # consecutive failures feeding the backoff
+        self.boot_seconds: Optional[float] = None  # spawn -> READY line
         self.down_reason: Optional[str] = None
         self.backoff_s = 0.0
         self.next_spawn_at = 0.0
@@ -133,6 +149,7 @@ class WorkerHandle:
             "respawns": self.respawns,
             "down_reason": self.down_reason,
             "backoff_s": round(self.backoff_s, 4),
+            "boot_seconds": self.boot_seconds,
             "compiles_since_ready":
                 self.last_health.get("compiles_since_ready"),
             "bundle_installed": self.last_health.get("bundle_installed"),
@@ -159,6 +176,8 @@ class FleetRouter:
                  shed_outstanding: int = 64,
                  boot_timeout_s: float = 120.0,
                  health_timeout_s: float = 5.0,
+                 scrape_s: Optional[float] = None,
+                 history: Optional[bool] = None,
                  registry=None):
         if registry is None:
             from ..telemetry import get_registry  # noqa: PLC0415
@@ -194,6 +213,28 @@ class FleetRouter:
             "fleet.router.health", self.health_timeout_s)
         self.boot_deadline = DeadlinePolicy(
             "fleet.router.boot", self.boot_timeout_s)
+
+        # history scrape plane (telemetry/history.py): per-worker
+        # /metrics + /api/worker fetches each run under this Deadline so
+        # a wedged worker can never stall the scrape tick indefinitely
+        from ..telemetry import history as _history  # noqa: PLC0415
+
+        self.scrape_s = (float(scrape_s) if scrape_s is not None
+                         else max(self.poll_s, 1.0))
+        self.history_enabled = (_history.history_enabled()
+                                if history is None else bool(history))
+        self.scrape_deadline = DeadlinePolicy(
+            "fleet.router.scrape", self.health_timeout_s)
+        self.history = _history.get_history_store() \
+            if self.history_enabled else None
+        self.history_rules = _history.FleetRecordingRules(
+            store=self.history, registry=registry) \
+            if self.history_enabled else None
+        # scrape-thread-private cursor state still gets a lock: the lint
+        # (and a future second reader) can't know the thread ownership
+        self._history_lock = threading.Lock()
+        self._history_paused = threading.Event()
+        self._ann_cursor_ts = time.time()
 
         self.workers: List[WorkerHandle] = [
             WorkerHandle(i) for i in range(self.n_workers)]
@@ -272,6 +313,7 @@ class FleetRouter:
         return cmd
 
     def _spawn(self, handle: WorkerHandle) -> bool:
+        spawn_t0 = time.perf_counter()
         handle.proc = subprocess.Popen(
             self._worker_cmd(), env=self._spawn_env(), cwd=_REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
@@ -306,6 +348,11 @@ class FleetRouter:
             handle.backoff_s = 0.0
             handle.fail_count = 0
             handle.down_reason = None
+            # the warm-pool sizing signal: spawn -> READY_SENTINEL wall
+            # seconds, surfaced per worker and recorded by the history
+            # recording rules as worker.boot_ready_seconds
+            handle.boot_seconds = round(
+                time.perf_counter() - spawn_t0, 4)
         # the ready pipe stays open; drain it so the worker never blocks
         threading.Thread(target=handle.proc.stdout.read,
                          daemon=True).start()
@@ -332,6 +379,13 @@ class FleetRouter:
         self._m_version.set(self.target_version)
         threading.Thread(target=self._supervise_loop, daemon=True,
                          name="dl4jtpu-fleet-supervisor").start()
+        if self.history_enabled:
+            from ..telemetry.history import ensure_default_sampler  # noqa: PLC0415
+
+            # the router's own dl4jtpu_fleet_* families grow history too
+            ensure_default_sampler()
+            threading.Thread(target=self._scrape_loop, daemon=True,
+                             name="dl4jtpu-fleet-scrape").start()
         self._httpd = ThreadingHTTPServer(
             ("127.0.0.1", self.port), self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -429,6 +483,127 @@ class FleetRouter:
             else:
                 with handle.lock:
                     self._backoff(handle)
+
+    # ---------------------------------------------------------- history
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.scrape_s):
+            if self._history_paused.is_set():
+                continue
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 - next tick retries
+                pass
+
+    def _fetch_worker(self, port: int) -> Tuple[str, dict]:
+        """One worker's /metrics text + /api/worker JSON, both fetched
+        under the shared ``fleet.router.scrape`` Deadline so a wedged
+        worker can't stall the scrape tick."""
+        deadline = self.scrape_deadline.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=max(0.001, deadline.remaining())) as resp:
+            metrics_text = resp.read().decode("utf-8", "replace")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/worker",
+                timeout=max(0.001, deadline.remaining())) as resp:
+            worker = json.loads(resp.read())
+        return metrics_text, worker
+
+    def scrape_once(self, now: Optional[float] = None) -> dict:
+        """One scrape tick (public so tests and check.sh drive it
+        synchronously with an injected clock): poll every live worker,
+        ingest with ``{worker, model}`` labels, gap-mark workers past
+        the stale-heartbeat cutoff, run the recording rules over
+        :meth:`stats`, splice flight events as annotations."""
+        store = self.history
+        if store is None:
+            return {}
+        stale_cutoff = max(5.0 * self.poll_s, 2.0)
+        mono = time.monotonic()
+        scraped, stale_marked = 0, 0
+        for handle in self.workers:
+            with handle.lock:
+                fresh = (handle.ready and handle.alive
+                         and mono - handle.last_seen <= stale_cutoff)
+                port = handle.port
+            wlab = {"worker": str(handle.wid), "model": self.model}
+            if not fresh or port is None:
+                # same rule that excludes stale latency rings from the
+                # fleet percentiles: the series gets an explicit gap
+                stale_marked += store.mark_stale(wlab, now=now)
+                continue
+            try:
+                metrics_text, worker = self._fetch_worker(port)
+            except Exception:  # noqa: BLE001 - worker died mid-scrape
+                stale_marked += store.mark_stale(wlab, now=now)
+                continue
+            store.ingest_prometheus(metrics_text, extra_labels=wlab,
+                                    now=now)
+            if worker.get("uptime_s") is not None:
+                store.record_gauge("worker.uptime_s", worker["uptime_s"],
+                                   wlab, now=now)
+            scraped += 1
+        sensors = self.history_rules.observe_fleet(self.stats(), now=now)
+        self._splice_annotations(store)
+        return {"scraped": scraped, "stale_marked": stale_marked,
+                "sensors": sensors}
+
+    def _splice_annotations(self, store) -> None:
+        """Flight events newer than the cursor whose kind belongs on the
+        serving timeline become history annotations."""
+        try:
+            from ..telemetry.flight_recorder import get_flight_recorder  # noqa: PLC0415
+
+            events = get_flight_recorder().events
+        except Exception:  # noqa: BLE001
+            return
+        kinds = ("fleet_rollout", "fleet_respawn", "serve_swap",
+                 "online_swap", "slo_burn")
+        with self._history_lock:
+            cursor = self._ann_cursor_ts
+            picked = [ev for ev in events
+                      if ev.get("kind") in kinds
+                      and float(ev.get("ts", 0.0)) > cursor]
+            if events:
+                self._ann_cursor_ts = max(
+                    cursor, max(float(e.get("ts", 0.0)) for e in events))
+        for ev in picked:
+            payload = {k: v for k, v in ev.items()
+                       if k not in ("ts", "kind")}
+            store.annotate(ev["kind"], now=float(ev["ts"]), **payload)
+
+    def set_history_enabled(self, enabled: bool) -> dict:
+        """Fleet-wide ingestion toggle: the router's scrape loop, the
+        process sampler, and every live worker's sampler (the bench
+        overhead gate interleaves trials with this)."""
+        from ..telemetry.history import get_default_sampler  # noqa: PLC0415
+
+        if enabled:
+            self._history_paused.clear()
+        else:
+            self._history_paused.set()
+        sampler = get_default_sampler()
+        if sampler is not None:
+            if enabled:
+                sampler.resume()
+            else:
+                sampler.pause()
+        body = json.dumps({"enabled": bool(enabled)}).encode()
+        workers_ok = 0
+        for handle in self.workers:
+            with handle.lock:
+                port = handle.port if handle.ready else None
+            if port is None:
+                continue
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/history", body,
+                    {"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).read()
+                workers_ok += 1
+            except Exception:  # noqa: BLE001 - a dead worker misses the toggle
+                pass
+        return {"enabled": bool(enabled), "workers": workers_ok}
 
     # ---------------------------------------------------------- rollout
     def _maybe_rollout(self) -> None:
@@ -809,6 +984,17 @@ class FleetRouter:
                 elif self.path == "/api/slo":
                     from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
                     self._send(200, get_slo_monitor().stats())
+                elif self.path.startswith("/api/history"):
+                    if router.history is None:
+                        self._send(503, {"error": "history disabled "
+                                                  "(DL4JTPU_HISTORY=0)"})
+                        return
+                    from urllib.parse import parse_qsl, urlparse  # noqa: PLC0415
+                    params = dict(parse_qsl(urlparse(self.path).query))
+                    try:
+                        self._send(200, router.history.http_query(params))
+                    except ValueError as e:
+                        self._send(400, {"error": str(e)})
                 elif self.path == "/metrics":
                     self._send(200,
                                router.registry.prometheus_text().encode(),
@@ -857,6 +1043,9 @@ class FleetRouter:
                 elif self.path == "/drain":
                     ok = router.drain()
                     self._send(200, {"drained": ok})
+                elif self.path == "/history":
+                    enabled = bool(payload.get("enabled", True))
+                    self._send(200, router.set_history_enabled(enabled))
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 

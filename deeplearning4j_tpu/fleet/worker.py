@@ -26,8 +26,13 @@ classes, version}`` (429 + Retry-After when admission sheds, 503 while
 draining/not ready), POST ``/swap`` ``{version?}``, POST ``/drain``,
 GET ``/healthz``, GET ``/metrics`` (exemplar-carrying), GET
 ``/api/worker``, GET ``/api/trace/<trace_id>`` (this process's spans for
-one distributed trace), GET ``/api/slo``. POST ``/predict`` honors the
-``x-dl4jtpu-trace`` context header (docs/observability.md).
+one distributed trace), GET ``/api/slo``, GET ``/api/history`` (this
+process's metric time-series store — the serving front-end starts a
+Deadline-paced :class:`HistorySampler` automatically unless
+``DL4JTPU_HISTORY=0``), POST ``/history`` ``{enabled}`` (pause/resume
+the sampler; the bench overhead gate interleaves trials with it). POST
+``/predict`` honors the ``x-dl4jtpu-trace`` context header
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -93,6 +98,7 @@ class FleetWorker:
         self.requests_total = 0
         self.shed_total = 0
         self.started_at = time.time()
+        self.boot_seconds: Optional[float] = None
         # ThreadingHTTPServer runs one thread per request: the request
         # counters increment under this lock, never bare
         self._stats_lock = threading.Lock()
@@ -188,6 +194,10 @@ class FleetWorker:
         except Exception:  # noqa: BLE001 - observability never blocks boot
             pass
         self.compiles_at_ready = self._counter.count
+        # process-internal boot->ready seconds (the router additionally
+        # measures spawn->READY wall time, which includes interpreter
+        # startup; both feed worker.boot_ready_seconds consumers)
+        self.boot_seconds = round(time.time() - self.started_at, 4)
         self.ready = True
         return self
 
@@ -263,6 +273,7 @@ class FleetWorker:
             "pid": os.getpid(),
             "port": self.port,
             "uptime_s": round(time.time() - self.started_at, 3),
+            "boot_seconds": self.boot_seconds,
             "bundle_installed": self.bundle_installed,
             "warmed_buckets": self.warmed_buckets,
             "compiles_total": compiles,
@@ -375,6 +386,16 @@ class FleetWorker:
                 elif self.path == "/api/slo":
                     from ..telemetry.slo import get_slo_monitor  # noqa: PLC0415
                     self._send(200, get_slo_monitor().stats())
+                elif self.path.startswith("/api/history"):
+                    from urllib.parse import parse_qsl, urlparse  # noqa: PLC0415
+
+                    from ..telemetry.history import get_history_store  # noqa: PLC0415
+                    params = dict(parse_qsl(urlparse(self.path).query))
+                    try:
+                        self._send(200,
+                                   get_history_store().http_query(params))
+                    except ValueError as e:
+                        self._send(400, {"error": str(e)})
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -434,6 +455,18 @@ class FleetWorker:
                     threading.Thread(target=worker.drain, daemon=True,
                                      name="dl4jtpu-fleet-drain").start()
                     self._send(200, {"draining": True})
+                elif self.path == "/history":
+                    from ..telemetry.history import get_default_sampler  # noqa: PLC0415
+
+                    enabled = bool(payload.get("enabled", True))
+                    sampler = get_default_sampler()
+                    if sampler is not None:
+                        if enabled:
+                            sampler.resume()
+                        else:
+                            sampler.pause()
+                    self._send(200, {"enabled": enabled,
+                                     "sampler": sampler is not None})
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 

@@ -211,6 +211,16 @@ class InferenceService:
             "dl4jtpu_serve_shed_total",
             "requests shed by admission control, by model and reason",
             labelnames=("model", "reason"))
+        # every serving process grows metric history automatically: the
+        # Deadline-paced sampler ticks the default registry into the
+        # process HistoryStore behind GET /api/history (no-op when
+        # DL4JTPU_HISTORY=0; idempotent across services)
+        try:
+            from ..telemetry.history import ensure_default_sampler  # noqa: PLC0415
+
+            ensure_default_sampler()
+        except Exception:  # noqa: BLE001 - observability never blocks ctor
+            pass
 
     # ------------------------------------------------------------ registry
     @staticmethod

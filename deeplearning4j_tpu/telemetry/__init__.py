@@ -29,12 +29,30 @@ syncs; see docs/observability.md):
 - :mod:`slo` — declared objectives (latency budget, availability) with
   multi-window burn-rate alerting over serving observations; breaches
   emit ``slo-burn`` watchdog anomalies and flight bundles.
+- :mod:`history` — bounded multi-resolution time-series store
+  (raw→1m→5m rollups, counter→rate, histogram-quantile series), the
+  Deadline-paced :class:`HistorySampler`, and the fleet recording
+  rules + EWMA/Holt ``dl4jtpu_forecast_*`` signals behind
+  ``GET /api/history`` — the autoscaler's sensor suite.
 """
 
 from .flight_recorder import (
     FlightRecorder,
     get_flight_recorder,
     install_crash_hook,
+)
+from .history import (
+    FleetRecordingRules,
+    Forecast,
+    HistorySampler,
+    HistoryStore,
+    ensure_default_sampler,
+    get_default_sampler,
+    get_history_store,
+    history_enabled,
+    parse_prometheus_text,
+    set_default_sampler,
+    set_history_store,
 )
 from .memory import (
     MemoryPreflightError,
@@ -113,6 +131,17 @@ __all__ = [
     "SLOMonitor",
     "get_slo_monitor",
     "set_slo_monitor",
+    "FleetRecordingRules",
+    "Forecast",
+    "HistorySampler",
+    "HistoryStore",
+    "ensure_default_sampler",
+    "get_default_sampler",
+    "get_history_store",
+    "history_enabled",
+    "parse_prometheus_text",
+    "set_default_sampler",
+    "set_history_store",
     "FlightRecorder",
     "get_flight_recorder",
     "install_crash_hook",

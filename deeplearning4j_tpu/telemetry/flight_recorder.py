@@ -101,6 +101,9 @@ for _kind in (
     # fleet/router.py (rolling rollout + dead-worker respawn, spliced into
     # merged traces as instant events)
     "fleet_rollout", "fleet_respawn",
+    # telemetry/history.py (flight event -> history-timeline annotation
+    # splice; rung once per annotation so the black box shows the splice)
+    "history_annotation",
 ):
     register_event_kind(_kind)
 del _kind

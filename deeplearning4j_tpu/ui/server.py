@@ -44,6 +44,10 @@ Pages:
 - ``/api/slo``        — declared SLOs, fast/slow-window burn rates per
   model and objective, and the recent breach history (see
   docs/observability.md § SLO burn-rate monitoring).
+- ``/api/history``    — the process metric time-series store: downsampled
+  series (select/range/step/agg grammar) + spliced timeline annotations;
+  ``/train/history`` renders live sparklines over it (see
+  docs/observability.md § Metric history & derived signals).
 - ``POST /serving/predict`` / ``POST /serving/rnn`` — the batch-inference
   and continuous-decode endpoints over the process serving front-end
   (``serving.get_service()``; see docs/serving.md).
@@ -77,6 +81,7 @@ _NAV = """<nav>
 <a href="/train/flow" id="nav-flow">@@train.nav.flow@@</a>
 <a href="/train/activations" id="nav-activations">@@train.nav.activations@@</a>
 <a href="/train/tsne" id="nav-tsne">@@train.nav.tsne@@</a>
+<a href="/train/history" id="nav-history">history</a>
 <span style="float:right">@@train.nav.language@@:
 <a href="/setlang/en">en</a> <a href="/setlang/ja">ja</a>
 <a href="/setlang/ko">ko</a> <a href="/setlang/de">de</a>
@@ -361,6 +366,82 @@ async function refresh(){
 refresh(); setInterval(refresh, 5000);
 </script>""")
 
+_HISTORY = _page("metric history", """
+<div class="card">
+<h3>Metric history &amp; derived signals</h3>
+<p style="font-size:13px;color:#555">Live sparklines over
+<code>GET /api/history</code> — the bounded multi-resolution store fed
+by the Deadline-paced sampler and the fleet scrape loop
+(docs/observability.md § Metric history &amp; derived signals).
+Vertical dashes mark spliced rollout/respawn/swap/slo-burn
+annotations; dotted segments are explicit stale gaps.</p>
+<label style="font-size:13px">series prefix
+<input id="prefix" value="fleet." size="14"></label>
+<label style="font-size:13px">window s
+<input id="range" value="600" size="6"></label>
+<span id="hstats" style="font-size:12px;color:#555"></span>
+</div>
+<div id="charts" class="hrow"></div>
+<div class="card"><h3>annotations</h3>
+<table id="anns"><tr><th>ts</th><th>kind</th><th>detail</th></tr></table>
+</div>
+<script>
+function sparkline(svg, pts, anns, t0, t1, color){
+  // pts: [ts, value|null] — nulls are stale gaps, drawn as path breaks
+  const W=+svg.getAttribute('width'), H=+svg.getAttribute('height'), pad=6;
+  const vals=pts.filter(p=>p[1]!==null).map(p=>p[1]);
+  if (!vals.length) return;
+  const ymin=Math.min(...vals), ymax=Math.max(...vals);
+  const px=t=>pad+(W-2*pad)*(t-t0)/Math.max(t1-t0,1e-9);
+  const py=v=>H-pad-(H-2*pad)*(v-ymin)/Math.max(ymax-ymin,1e-9);
+  let d='', pen='M';
+  for (const [t,v] of pts){
+    if (v===null){ pen='M'; continue; }
+    d+=pen+px(t).toFixed(1)+','+py(v).toFixed(1); pen=' L';
+  }
+  let s=`<path d="${d}" fill="none" stroke="${color||'#36c'}" stroke-width="1.2"/>`;
+  for (const a of anns){
+    const x=px(a.ts).toFixed(1);
+    s+=`<line x1="${x}" y1="0" x2="${x}" y2="${H}" stroke="#c63" `+
+       `stroke-dasharray="3,3"><title>${esc(a.kind)}</title></line>`;
+  }
+  s+=`<text x="2" y="10" font-size="9">${ymax.toPrecision(4)}</text>`;
+  s+=`<text x="2" y="${H-1}" font-size="9">${ymin.toPrecision(4)}</text>`;
+  svg.innerHTML=s;
+}
+async function refresh(){
+  const prefix=document.getElementById('prefix').value||'';
+  const range=+document.getElementById('range').value||600;
+  const sel=prefix?('&series='+encodeURIComponent(prefix+'*')):'';
+  const h=await getJSON('/api/history?range_s='+range+sel);
+  const charts=document.getElementById('charts'); charts.innerHTML='';
+  for (const s of h.series){
+    if (!s.points.some(p=>p[1]!==null)) continue;
+    const lab=Object.entries(s.labels).map(([k,v])=>k+'='+v).join(',');
+    const cell=document.createElement('div'); cell.className='hcell';
+    cell.innerHTML=`<h4>${esc(s.name)}${lab?' {'+esc(lab)+'}':''}`+
+      `${s.stale?' <b style="color:#c63">stale</b>':''}</h4>`+
+      `<svg width="260" height="64" style="background:#fff;`+
+      `border:1px solid #ddd"></svg>`;
+    charts.appendChild(cell);
+    sparkline(cell.querySelector('svg'), s.points, h.annotations,
+              h.start, h.end);
+  }
+  const tbl=document.getElementById('anns');
+  tbl.innerHTML='<tr><th>ts</th><th>kind</th><th>detail</th></tr>'+
+    h.annotations.slice(-30).reverse().map(a=>{
+      const rest=Object.entries(a).filter(([k])=>k!=='ts'&&k!=='kind')
+        .map(([k,v])=>k+'='+v).join(' ');
+      return `<tr><td>${new Date(a.ts*1000).toISOString()}</td>`+
+        `<td>${esc(a.kind)}</td><td>${esc(rest)}</td></tr>`;
+    }).join('');
+  document.getElementById('hstats').textContent =
+    ` ${h.series.length} series · source=${h.source} · `+
+    `${h.annotations.length} annotations`;
+}
+refresh(); setInterval(refresh, 3000);
+</script>""")
+
 _PAGES = {
     "/": _OVERVIEW,
     "/train": _OVERVIEW,
@@ -370,6 +451,7 @@ _PAGES = {
     "/train/flow": _FLOW,
     "/train/activations": _ACTIVATIONS,
     "/train/tsne": _TSNE,
+    "/train/history": _HISTORY,
 }
 
 _HIST_KEYS = ("param_histograms", "gradient_histograms", "update_histograms")
@@ -531,6 +613,18 @@ class _Handler(BaseHTTPRequestHandler):
 
             return self._send(200, json.dumps(
                 get_slo_monitor().stats(), default=str).encode())
+        if path == "/api/history":
+            # the process history store: downsampled series + spliced
+            # annotations (docs/observability.md § Metric history &
+            # derived signals; /train/history renders it)
+            from ..telemetry.history import get_history_store  # noqa: PLC0415
+
+            try:
+                out = get_history_store().http_query(self._query())
+            except ValueError as e:
+                return self._send(400, json.dumps(
+                    {"error": str(e)}).encode())
+            return self._send(200, json.dumps(out).encode())
         if path.startswith("/setlang/"):
             prov = i18n.get_instance()
             code = path.rsplit("/", 1)[1]

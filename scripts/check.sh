@@ -1178,6 +1178,113 @@ print(f"tracing overhead gate OK: 1/256 sampling at {ratio:.3f}x of "
       f"disabled ({m_on*1000:.1f}ms vs {m_off*1000:.1f}ms per 200 requests)")
 PY
 
+echo "== dl4jtpu-history self-scan: fleet scrape plane + recording rules + rollout annotation"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 19 acceptance: a REAL 2-worker warm-booted fleet under scripted
+# traffic grows downsampled history for every recording-rule series, a
+# rolling rollout lands on the timeline as an annotation, and the
+# derived p99 series agrees with /api/fleet's instantaneous exact p99
+# at the latest sample point.
+import json
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+with tempfile.TemporaryDirectory() as work:
+    from deeplearning4j_tpu import (
+        DenseLayer,
+        InputType,
+        MultiLayerConfiguration,
+        MultiLayerNetwork,
+        OutputLayer,
+        UpdaterConfig,
+    )
+    from deeplearning4j_tpu.fleet import (FleetRouter, build_bundle,
+                                          save_bundle)
+    from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+    from deeplearning4j_tpu.telemetry.history import RECORDING_RULES
+
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        seed=7)).init()
+    store = CheckpointStore(work + "/store")
+    store.save(net)
+    save_bundle(store, build_bundle(
+        net, example=np.zeros((1, 8), np.float32), argmax=True,
+        max_batch=8))
+    router = FleetRouter(work + "/store", workers=2, poll_s=0.2,
+                         scrape_s=0.5, history=True,
+                         worker_args={"max_delay_ms": 0,
+                                      "max_batch": 8}).start()
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=15) as r:
+                return json.loads(r.read())
+
+        probe = np.linspace(-1, 1, 8).reshape(1, 8)
+        body = json.dumps({"features": probe.tolist()}).encode()
+
+        def traffic(n):
+            for _ in range(n):
+                req = urllib.request.Request(
+                    base + "/predict", body,
+                    {"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=30).read()
+
+        traffic(12)
+        router.scrape_once()   # baseline tick for the rate sensors
+        time.sleep(1.1)
+        traffic(6)
+        tick = router.scrape_once()
+        assert tick["scraped"] == 2, tick
+        names = set(router.history.series_names())
+        missing = set(RECORDING_RULES) - names
+        assert not missing, f"recording rules absent: {missing}"
+
+        # publish v2 -> automatic rolling rollout -> timeline annotation
+        import jax
+        loader = store.restore(1)
+        loader.params = jax.tree_util.tree_map(
+            lambda p: p * np.float32(0.5), loader.params)
+        store.save(loader)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if router.stats()["rollouts"] >= 1:
+                break
+            time.sleep(0.2)
+        assert router.stats()["rollouts"] >= 1, "rollout never happened"
+        router.scrape_once()
+        anns = {a["kind"] for a in get(
+            "/api/history?range_s=600")["annotations"]}
+        assert "fleet_rollout" in anns, anns
+
+        # derived p99 == instantaneous exact p99 at the latest sample
+        fstats = get("/api/fleet")
+        router.scrape_once()
+        out = get("/api/history?series=fleet.latency_p99_seconds"
+                  "&range_s=600")
+        pts = [p for p in out["series"][0]["points"] if p[1] is not None]
+        want = fstats["latency_seconds"]["p99"]
+        assert abs(pts[-1][1] - want) < 1e-9, (pts[-1], want)
+        hstats = router.history.stats()
+        assert hstats["bytes"] <= hstats["byte_budget"], hstats
+        print(f"history self-scan OK: {hstats['series']} series, "
+              f"{hstats['samples_total']} samples in "
+              f"{hstats['bytes']/1024:.0f} KiB "
+              f"(budget {hstats['byte_budget']/2**20:.0f} MiB), "
+              f"all {len(RECORDING_RULES)} recording rules live, "
+              f"rollout annotated, p99 history==exact at latest sample")
+    finally:
+        router.stop()
+PY
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
@@ -1335,6 +1442,30 @@ else:
     print(f"fleet gate OK: {d['value']} samples/sec, scale-out {ratio}x "
           f"(recorded only — {cores} core(s), floor needs >=4), "
           f"0 errors, 0 warm compiles")
+PY
+
+echo "== bench regression gate (history mode vs BENCH_BASELINE.json)"
+rm -f /tmp/_bench_gate_history.json
+BENCH_FORCE_CPU=1 BENCH_MODEL=history BENCH_DEADLINE_S=360 python bench.py \
+    | tail -1 > /tmp/_bench_gate_history.json
+python scripts/bench_gate.py /tmp/_bench_gate_history.json
+python - <<'PY'
+# ISSUE 19 acceptance: sampler + scrape plane within 3% of disabled
+# throughput (interleaved trials on ONE warm fleet, medians), zero warm
+# compiles, and the store stayed inside its documented byte budget.
+import json
+
+d = json.load(open("/tmp/_bench_gate_history.json"))
+ratio = d["overhead_ratio"]
+assert ratio <= 1.03, (
+    f"history-on serving {ratio}x of disabled (>3% overhead): "
+    f"on={d['value']} off={d['samples_per_sec_off']} samples/sec")
+assert sum(d.get("warm_compiles") or [1]) == 0, d.get("warm_compiles")
+assert d["history_bytes"] <= d["history_byte_budget"], d
+print(f"history gate OK: on {d['value']} vs off "
+      f"{d['samples_per_sec_off']} samples/sec ({ratio}x, <=1.03), "
+      f"{d['history_series']} series / {d['history_samples_total']} "
+      f"samples ingested, 0 warm compiles")
 PY
 
 echo "== tier-1 tests"

@@ -349,6 +349,81 @@ class TestSchemaAggregation:
         findings += schema.findings()
         assert "DT406" in _ids(findings), findings
 
+    def test_history_kinds_are_registered(self):
+        # the metric-history plane registers its annotation kind through
+        # the same single-owner path as tracing/SLO; splicing flight
+        # events into the timeline must not trip DT406
+        src = ('def note(recorder):\n'
+               '    recorder.record("history_annotation")\n')
+        assert check_runtime_source(src, "k.py") == []
+
+    def test_unregistered_history_kind_fires(self):
+        src = ('def note(recorder):\n'
+               '    recorder.record("history_annotation_v2_bogus")\n')
+        assert "DT406" in _ids(check_runtime_source(src, "k.py"))
+
+    def test_history_family_cross_file_conflict_fires(self):
+        # two modules each claiming dl4jtpu_history_samples_total with
+        # different label sets — the shared schema flags the drift
+        one = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'c = get_registry().counter(\n'
+               '        "dl4jtpu_history_samples_total", "h",\n'
+               '        labelnames=("kind",))\n')
+        two = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'c = get_registry().counter(\n'
+               '        "dl4jtpu_history_samples_total", "h",\n'
+               '        labelnames=("kind", "worker"))\n')
+        schema = TelemetrySchema()
+        findings = []
+        findings += check_runtime_source(one, "one.py", schema=schema)
+        findings += check_runtime_source(two, "two.py", schema=schema)
+        findings += schema.findings()
+        assert "DT406" in _ids(findings), findings
+
+    def test_forecast_family_kind_conflict_fires(self):
+        # same forecast gauge re-declared as a counter elsewhere
+        one = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'g = get_registry().gauge(\n'
+               '        "dl4jtpu_forecast_offered_load", "h",\n'
+               '        labelnames=("model", "horizon"))\n')
+        two = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'c = get_registry().counter(\n'
+               '        "dl4jtpu_forecast_offered_load", "h",\n'
+               '        labelnames=("model", "horizon"))\n')
+        schema = TelemetrySchema()
+        findings = []
+        findings += check_runtime_source(one, "one.py", schema=schema)
+        findings += check_runtime_source(two, "two.py", schema=schema)
+        findings += schema.findings()
+        assert "DT406" in _ids(findings), findings
+
+    def test_history_clean_twin_single_owner(self):
+        # the shipped pattern: one module owns the history families and
+        # records only registered kinds — no findings
+        src = ('from deeplearning4j_tpu.telemetry import get_registry\n'
+               'samples = get_registry().counter(\n'
+               '        "dl4jtpu_history_samples_total", "h",\n'
+               '        labelnames=("kind",))\n'
+               'bytes_g = get_registry().gauge(\n'
+               '        "dl4jtpu_history_bytes", "h")\n'
+               'fc = get_registry().gauge(\n'
+               '        "dl4jtpu_forecast_queue_depth", "h",\n'
+               '        labelnames=("model", "horizon"))\n'
+               'def splice(recorder):\n'
+               '    recorder.record("history_annotation")\n')
+        assert check_runtime_source(src, "clean.py") == []
+
+    def test_shipped_history_modules_stay_clean(self):
+        # the real telemetry/history.py (and everything else the DT4xx
+        # self-scan covers) must stay at zero findings
+        from deeplearning4j_tpu.analysis.runtime_checks import (
+            check_runtime_package,
+        )
+        findings = check_runtime_package()
+        assert findings == [], [
+            (f.rule_id, f.filename, f.lineno, f.message) for f in findings
+        ]
+
 
 class TestDeterminism:
     def test_same_source_scans_identically(self):

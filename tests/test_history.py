@@ -1,0 +1,686 @@
+"""Metric time-series history + fleet scrape plane (ISSUE 19).
+
+Fast tier (injected ``now``, no threads): ring bounds and rollup
+correctness against a brute-force reference, counter->rate derivation
+with reset handling, histogram-quantile series against exact ring
+values, stale-gap marking + clean resume, query semantics (select/
+labels/step/aggregation/empty range/errors), the Prometheus text
+round-trip, Holt/EWMA forecasts recovering a scripted ramp's slope,
+recording rules over a faked fleet-stats payload, bit-exact model
+outputs with the sampler on vs off, and the memory bound proven by a
+soak ingest (>=1e5 samples across >=200 series staying within the
+documented byte budget, mirrored by ``dl4jtpu_history_bytes``).
+
+Slow tier (real OS processes): a 2-worker fleet under scripted traffic
+grows downsampled per-model sensor series spanning a mid-test
+SIGKILL->respawn (explicit stale gap, then the SAME worker label
+resumes), ``/api/history`` answers over HTTP with step/aggregation and
+agrees with ``/api/fleet``'s exact p99 at the latest sample point.
+"""
+
+import json
+import math
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DenseLayer, InputType,
+                                MultiLayerConfiguration, MultiLayerNetwork,
+                                OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.fleet import FleetRouter, build_bundle, save_bundle
+from deeplearning4j_tpu.runtime.checkpoint import CheckpointStore
+from deeplearning4j_tpu.telemetry import (Forecast, HistorySampler,
+                                          HistoryStore, get_registry,
+                                          parse_prometheus_text)
+from deeplearning4j_tpu.telemetry.history import (FleetRecordingRules,
+                                                  RECORDING_RULES,
+                                                  history_enabled)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+T0 = 1_700_000_000.0  # fixed epoch anchor for every injected clock
+
+
+def _store(**kw):
+    """A store over a private registry so tests never cross-talk."""
+    return HistoryStore(MetricsRegistry(), **kw)
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# rings + rollups
+# ---------------------------------------------------------------------------
+class TestRingsAndRollups:
+    def test_raw_ring_bounded(self):
+        st = _store(raw_len=16)
+        for i in range(100):
+            st.record_gauge("g", float(i), now=T0 + i)
+        out = st.query(select="g", start=T0, end=T0 + 100)
+        assert len(out["series"]) == 1
+        pts = out["series"][0]["points"]
+        assert len(pts) == 16  # bounded by construction, oldest dropped
+        assert pts[0] == [T0 + 84, 84.0]
+        assert pts[-1] == [T0 + 99, 99.0]
+
+    def test_rollups_match_brute_force(self):
+        """1m/5m buckets carry exactly the count/sum/min/max/last a
+        brute-force pass over the same scripted points produces."""
+        rng = np.random.RandomState(7)
+        ts = sorted(T0 + float(t) for t in rng.uniform(0, 1200, 400))
+        vals = rng.uniform(-5, 5, 400)
+        st = _store()
+        for t, v in zip(ts, vals):
+            st.record_gauge("g", float(v), now=t)
+        for res in (60.0, 300.0):
+            by_bucket = {}
+            for t, v in zip(ts, vals):
+                by_bucket.setdefault(math.floor(t / res) * res,
+                                     []).append(v)
+            # bucket-aligned window so step bins coincide with rollups
+            w0 = math.floor(T0 / res) * res
+            out = st.query(select="g", start=w0,
+                           end=T0 + 1201, step=res, now=T0 + 1200)
+            got = {p[0]: p[1] for p in out["series"][0]["points"]
+                   if p[1] is not None}
+            assert out["source"] == res
+            for start, vs in by_bucket.items():
+                assert got[start] == pytest.approx(np.mean(vs))
+            for agg, fn in (("min", np.min), ("max", np.max),
+                            ("sum", np.sum), ("last", lambda v: v[-1])):
+                out = st.query(select="g", start=w0, end=T0 + 1201,
+                               step=res, agg=agg, now=T0 + 1200)
+                got = {p[0]: p[1] for p in out["series"][0]["points"]
+                       if p[1] is not None}
+                for start, vs in by_bucket.items():
+                    assert got[start] == pytest.approx(fn(vs)), (res, agg)
+
+    def test_source_selection(self):
+        st = _store()
+        for i in range(10):
+            st.record_gauge("g", float(i), now=T0 + 60 * i)
+        short = st.query(select="g", range_s=300, now=T0 + 540)
+        assert short["source"] == "raw"
+        long = st.query(select="g", range_s=7200, now=T0 + 540)
+        assert long["source"] in (60.0, 300.0)
+        stepped = st.query(select="g", range_s=7200, step=300.0,
+                           now=T0 + 540)
+        assert stepped["source"] == 300.0
+
+    def test_series_lru_eviction(self):
+        st = _store(max_series=8)
+        for i in range(20):
+            st.record_gauge(f"s{i:02d}", 1.0, now=T0 + i)
+        stats = st.stats()
+        assert stats["series"] == 8
+        assert stats["evicted_total"] == 12
+        # the survivors are the most recently touched
+        assert st.series_names() == [f"s{i:02d}" for i in range(12, 20)]
+
+
+# ---------------------------------------------------------------------------
+# counter -> rate
+# ---------------------------------------------------------------------------
+class TestCounterRate:
+    def test_baseline_then_rates(self):
+        st = _store()
+        assert st.record_counter("c", 100, now=T0) is None  # baseline
+        assert st.record_counter("c", 120, now=T0 + 2) == 10.0
+        assert st.record_counter("c", 150, now=T0 + 4) == 15.0
+
+    def test_reset_uses_post_reset_value(self):
+        """A cumulative drop is a respawn: rate = value/dt (Prometheus
+        rate() convention), and the reset is counted on the series."""
+        st = _store()
+        st.record_counter("c", 1000, now=T0)
+        st.record_counter("c", 1100, now=T0 + 10)
+        assert st.record_counter("c", 30, now=T0 + 20) == 3.0
+        out = st.query(select="c", range_s=60, now=T0 + 20)
+        assert out["series"][0]["resets"] == 1
+        assert [p[1] for p in out["series"][0]["points"]] == [10.0, 3.0]
+
+    def test_non_advancing_clock_is_baseline_only(self):
+        st = _store()
+        st.record_counter("c", 10, now=T0)
+        assert st.record_counter("c", 20, now=T0) is None  # dt == 0
+
+
+# ---------------------------------------------------------------------------
+# histogram -> quantile series
+# ---------------------------------------------------------------------------
+class TestQuantileSeries:
+    def test_quantiles_vs_exact_ring_values(self):
+        """Feed the SAME scripted latencies into (a) an exact sorted ring
+        and (b) cumulative histogram snapshots; the interpolated p50/p99
+        must land inside the exact value's bucket interval."""
+        rng = np.random.RandomState(3)
+        lat = rng.gamma(2.0, 0.05, 500)  # latency-shaped, ~0.1s mean
+        bounds = [0.01, 0.05, 0.1, 0.25, 0.5, 1.0, float("inf")]
+
+        def cum(samples):
+            return {str(b): float(np.sum(samples <= b)) for b in bounds}
+
+        st = _store()
+        st.record_histogram("h", cum(lat[:1]), now=T0)  # baseline
+        out = st.record_histogram("h", cum(lat), now=T0 + 1)
+        assert set(out) == {"h:p50", "h:p99"}
+        interval = lat[1:]  # what arrived between the two snapshots
+        for q, name in ((0.5, "h:p50"), (0.99, "h:p99")):
+            exact = float(np.quantile(interval, q))
+            lo = max([b for b in bounds[:-1] if b < exact], default=0.0)
+            hi = min(b for b in bounds[:-1] if b >= exact)
+            assert lo <= out[name] <= hi, (q, exact, out[name])
+
+    def test_histogram_reset_recovers(self):
+        st = _store()
+        b1 = {"0.1": 10.0, "1": 20.0, "+Inf": 20.0}
+        st.record_histogram("h", b1, now=T0)
+        # respawned worker: cumulative counts fall back below baseline
+        b2 = {"0.1": 2.0, "1": 4.0, "+Inf": 4.0}
+        out = st.record_histogram("h", b2, now=T0 + 1)
+        assert out  # post-reset snapshot still yields quantiles
+        assert 0.0 < out["h:p50"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# stale-heartbeat rule
+# ---------------------------------------------------------------------------
+class TestStaleRule:
+    def test_gap_then_resume(self):
+        st = _store()
+        lab = {"worker": "0", "model": "m"}
+        st.record_gauge("worker.queue_depth", 3.0, lab, now=T0)
+        assert st.mark_stale(lab, now=T0 + 5) == 1
+        out = st.query(select="worker.queue_depth", range_s=60,
+                       now=T0 + 5)
+        s = out["series"][0]
+        assert s["stale"] is True
+        assert s["points"][-1] == [T0 + 5, None]  # explicit gap
+        # re-marking an already-stale series is a no-op
+        assert st.mark_stale(lab, now=T0 + 6) == 0
+        # the respawned worker resumes the SAME series cleanly
+        st.record_gauge("worker.queue_depth", 1.0, lab, now=T0 + 10)
+        out = st.query(select="worker.queue_depth", range_s=60,
+                       now=T0 + 10)
+        s = out["series"][0]
+        assert s["stale"] is False
+        assert s["points"][-2:] == [[T0 + 5, None], [T0 + 10, 1.0]]
+        assert st.stats()["stale_series"] == 0
+
+    def test_stale_counter_metric(self):
+        reg = MetricsRegistry()
+        st = HistoryStore(reg)
+        st.record_gauge("g", 1.0, {"worker": "1"}, now=T0)
+        st.record_gauge("g2", 1.0, {"worker": "1"}, now=T0)
+        st.mark_stale({"worker": "1"}, now=T0 + 3)
+        snap = reg.snapshot()
+        rows = snap["dl4jtpu_history_stale_series_total"]["values"]
+        assert rows[0]["value"] == 2
+
+    def test_label_subset_match_only(self):
+        st = _store()
+        st.record_gauge("g", 1.0, {"worker": "0", "model": "m"}, now=T0)
+        st.record_gauge("g", 1.0, {"worker": "1", "model": "m"}, now=T0)
+        assert st.mark_stale({"worker": "0"}, now=T0 + 1) == 1
+
+
+# ---------------------------------------------------------------------------
+# query semantics
+# ---------------------------------------------------------------------------
+class TestQuerySemantics:
+    def _seed(self):
+        st = _store()
+        for i in range(20):
+            st.record_gauge("fleet.queue_depth", float(i),
+                            {"model": "m"}, now=T0 + i)
+            st.record_gauge("worker.queue_depth", float(i),
+                            {"model": "m", "worker": "0"}, now=T0 + i)
+        return st
+
+    def test_select_exact_and_prefix(self):
+        st = self._seed()
+        assert len(st.query(select="fleet.queue_depth", range_s=60,
+                            now=T0 + 20)["series"]) == 1
+        names = {s["name"] for s in st.query(
+            select="fleet.*", range_s=60, now=T0 + 20)["series"]}
+        assert names == {"fleet.queue_depth"}
+        both = st.query(select=["fleet.*", "worker.*"], range_s=60,
+                        now=T0 + 20)
+        assert len(both["series"]) == 2
+
+    def test_label_filter(self):
+        st = self._seed()
+        out = st.query(labels={"worker": "0"}, range_s=60, now=T0 + 20)
+        assert [s["name"] for s in out["series"]] == [
+            "worker.queue_depth"]
+
+    def test_step_bins_with_explicit_gaps(self):
+        st = self._seed()
+        out = st.query(select="fleet.queue_depth", start=T0,
+                       end=T0 + 40, step=5.0, agg="mean", now=T0 + 40)
+        pts = out["series"][0]["points"]
+        assert [p[0] for p in pts] == [T0 + 5 * k for k in range(9)]
+        # bins past the data are explicit None gaps, never flat-lines
+        assert pts[0][1] == pytest.approx(np.mean([0, 1, 2, 3, 4]))
+        assert [p[1] for p in pts[4:]] == [None] * 5
+
+    def test_empty_range(self):
+        st = self._seed()
+        out = st.query(select="fleet.queue_depth", start=T0 + 1000,
+                       end=T0 + 2000, now=T0 + 2000)
+        assert out["series"][0]["points"] == []
+
+    def test_bad_agg_and_step_raise(self):
+        st = self._seed()
+        with pytest.raises(ValueError):
+            st.query(select="fleet.*", agg="p99", now=T0)
+        with pytest.raises(ValueError):
+            st.query(select="fleet.*", step=0.0, now=T0)
+
+    def test_http_query_param_mapping(self):
+        st = self._seed()
+        out = st.http_query({"series": "fleet.*,worker.queue_depth",
+                             "worker": "0", "range_s": "60",
+                             "step": "5", "agg": "max",
+                             "now": str(T0 + 20)})
+        assert out["agg"] == "max" and out["step"] == 5.0
+        assert [s["name"] for s in out["series"]] == [
+            "worker.queue_depth"]
+        with pytest.raises(ValueError):
+            st.http_query({"agg": "median"})
+
+    def test_annotations_windowed(self):
+        st = self._seed()
+        st.annotate("fleet_rollout", now=T0 + 5, record_flight=False,
+                    version=2)
+        st.annotate("fleet_respawn", now=T0 + 50, record_flight=False)
+        out = st.query(select="fleet.*", start=T0, end=T0 + 10,
+                       now=T0 + 10)
+        kinds = [a["kind"] for a in out["annotations"]]
+        assert kinds == ["fleet_rollout"]
+        assert out["annotations"][0]["version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# prometheus text round-trip
+# ---------------------------------------------------------------------------
+class TestPrometheusIngest:
+    TEXT = (
+        "# HELP dl4jtpu_serve_requests_total req\n"
+        "# TYPE dl4jtpu_serve_requests_total counter\n"
+        'dl4jtpu_serve_requests_total{model="m"} 100\n'
+        "# TYPE dl4jtpu_serve_queue_depth gauge\n"
+        "dl4jtpu_serve_queue_depth 3\n"
+        "# TYPE dl4jtpu_serve_latency_seconds histogram\n"
+        'dl4jtpu_serve_latency_seconds_bucket{le="0.1"} 5\n'
+        'dl4jtpu_serve_latency_seconds_bucket{le="0.5"} 9\n'
+        'dl4jtpu_serve_latency_seconds_bucket{le="+Inf"} 10\n'
+        "dl4jtpu_serve_latency_seconds_sum 1.5\n"
+        "dl4jtpu_serve_latency_seconds_count 10\n")
+
+    def test_parse(self):
+        types, samples = parse_prometheus_text(self.TEXT)
+        assert types["dl4jtpu_serve_requests_total"] == "counter"
+        assert types["dl4jtpu_serve_latency_seconds"] == "histogram"
+        assert ("dl4jtpu_serve_requests_total", {"model": "m"},
+                100.0) in samples
+
+    def test_ingest_with_worker_labels(self):
+        st = _store()
+        wlab = {"worker": "0", "model": "m"}
+        t2 = self.TEXT.replace(" 100", " 200").replace('"} 5', '"} 10') \
+                      .replace('"} 9', '"} 18').replace('"} 10\n', '"} 20\n') \
+                      .replace("count 10", "count 20")
+        st.ingest_prometheus(self.TEXT, extra_labels=wlab, now=T0)
+        st.ingest_prometheus(t2, extra_labels=wlab, now=T0 + 10)
+        names = st.series_names()
+        assert "dl4jtpu_serve_requests_total" in names       # rate
+        assert "dl4jtpu_serve_queue_depth" in names          # gauge
+        assert "dl4jtpu_serve_latency_seconds:count" in names
+        assert "dl4jtpu_serve_latency_seconds:p50" in names
+        assert "dl4jtpu_serve_latency_seconds:p99" in names
+        out = st.query(select="dl4jtpu_serve_requests_total",
+                       labels=wlab, range_s=60, now=T0 + 10)
+        assert out["series"][0]["points"] == [[T0 + 10, 10.0]]
+
+
+# ---------------------------------------------------------------------------
+# forecast: EWMA + Holt on a scripted ramp
+# ---------------------------------------------------------------------------
+class TestForecast:
+    def test_holt_recovers_ramp_slope(self):
+        fc = Forecast(alpha=0.5, beta=0.3)
+        for i in range(60):
+            fc.update(10.0 + 2.0 * i, T0 + float(i))  # slope 2/s
+        assert fc.trend == pytest.approx(2.0, abs=0.05)
+        assert fc.forecast(60.0) == pytest.approx(
+            fc.level + 2.0 * 60.0, rel=0.05)
+
+    def test_ewma_degenerate_has_zero_trend(self):
+        fc = Forecast(alpha=0.5, beta=0.0)
+        for i in range(60):
+            fc.update(10.0 + 2.0 * i, T0 + float(i))
+        assert fc.trend == 0.0
+        assert fc.forecast(300.0) == fc.level  # flat extrapolation
+
+    def test_irregular_intervals(self):
+        fc = Forecast(alpha=0.5, beta=0.3)
+        rng = np.random.RandomState(11)
+        t = T0
+        for _ in range(120):
+            t += float(rng.uniform(0.5, 3.0))
+            fc.update(5.0 - 0.5 * (t - T0), t)  # slope -0.5/s
+        assert fc.trend == pytest.approx(-0.5, abs=0.05)
+
+    def test_steady_state_is_flat(self):
+        fc = Forecast()
+        for i in range(50):
+            fc.update(42.0, T0 + i)
+        assert fc.level == pytest.approx(42.0)
+        assert fc.trend == pytest.approx(0.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# recording rules: the autoscaler sensor suite
+# ---------------------------------------------------------------------------
+class TestRecordingRules:
+    def _fleet_stats(self, i):
+        return {
+            "model": "toy",
+            "requests_total": 100 * i,
+            "shed_total": 5 * i,
+            "latency_seconds": {"p50": 0.01, "p99": 0.05 + 0.001 * i,
+                                "samples": 64},
+            "workers": [
+                {"id": 0, "ready": True, "queue_depth": i % 3,
+                 "boot_seconds": 4.2, "compiles_since_ready": 0},
+                {"id": 1, "ready": True, "queue_depth": 1,
+                 "boot_seconds": 3.9, "compiles_since_ready": 0},
+            ],
+        }
+
+    def test_sensor_series_and_forecasts(self):
+        reg = MetricsRegistry()
+        st = HistoryStore(reg)
+        rules = FleetRecordingRules(store=st, registry=reg)
+        for i in range(30):
+            sensors = rules.observe_fleet(self._fleet_stats(i),
+                                          now=T0 + float(i))
+        # every recording-rule series materialised
+        names = set(st.series_names())
+        assert set(RECORDING_RULES) <= names, (
+            set(RECORDING_RULES) - names)
+        # rate sensors derived correctly: 100 req / 1 s, 5 shed / 1 s
+        assert sensors["offered_load"] == pytest.approx(100.0)
+        assert sensors["shed_rate"] == pytest.approx(5.0)
+        # forecast gauges exported with horizon labels
+        snap = reg.snapshot()
+        fam = snap["dl4jtpu_forecast_offered_load"]
+        horizons = {dict(r["labels"])["horizon"]: r["value"]
+                    for r in fam["values"]}
+        assert set(horizons) == {"ewma", "trend_per_s", "60s", "300s"}
+        assert horizons["ewma"] == pytest.approx(100.0, rel=0.05)
+        assert horizons["trend_per_s"] == pytest.approx(0.0, abs=0.5)
+        table = rules.forecast_table()
+        assert "offered_load{model=toy}" in table
+
+    def test_boot_seconds_and_per_worker_series(self):
+        reg = MetricsRegistry()
+        st = HistoryStore(reg)
+        rules = FleetRecordingRules(store=st, registry=reg)
+        rules.observe_fleet(self._fleet_stats(1), now=T0)
+        out = st.query(select="worker.boot_ready_seconds",
+                       labels={"worker": "0"}, range_s=60, now=T0)
+        assert out["series"][0]["points"] == [[T0, 4.2]]
+
+
+# ---------------------------------------------------------------------------
+# sampler: registry snapshot -> store; bit-exact model outputs on/off
+# ---------------------------------------------------------------------------
+class TestSampler:
+    def test_tick_ingests_snapshot(self):
+        reg = MetricsRegistry()
+        reg.counter("dl4jtpu_t_total", "h").inc(10)
+        reg.gauge("dl4jtpu_t_depth", "h").set(3)
+        st = HistoryStore(reg)
+        sampler = HistorySampler(reg, st, interval_s=60.0)
+        sampler.tick(now=T0)
+        reg.get("dl4jtpu_t_total").inc(10)
+        sampler.tick(now=T0 + 2)
+        out = st.query(select="dl4jtpu_t_total", range_s=60, now=T0 + 2)
+        assert out["series"][0]["points"] == [[T0 + 2, 5.0]]
+        assert sampler.stats()["ticks"] == 2
+
+    def test_pause_resume(self):
+        reg = MetricsRegistry()
+        reg.gauge("dl4jtpu_t_depth", "h").set(1)
+        st = HistoryStore(reg)
+        sampler = HistorySampler(reg, st, interval_s=60.0)
+        sampler.tick(now=T0)
+        sampler.pause()
+        assert sampler.paused
+        sampler.resume()
+        assert not sampler.paused
+
+    def test_model_outputs_bit_exact_sampler_on_vs_off(self):
+        """The sensor plane observes; it must never perturb the model.
+        Same net, same input: outputs with a sampler ticking between
+        calls are bit-identical to outputs with no sampler at all."""
+        net = MultiLayerNetwork(MultiLayerConfiguration(
+            layers=[DenseLayer(n_out=16, activation="relu"),
+                    OutputLayer(n_out=4, activation="softmax",
+                                loss="mcxent")],
+            input_type=InputType.feed_forward(8),
+            updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+            seed=7)).init()
+        x = np.linspace(-1, 1, 16, dtype=np.float32).reshape(2, 8)
+        off = np.asarray(net.output(x))
+        reg = get_registry()
+        sampler = HistorySampler(reg, HistoryStore(reg),
+                                 interval_s=60.0)
+        sampler.tick()
+        on = np.asarray(net.output(x))
+        sampler.tick()
+        assert np.array_equal(off, np.asarray(net.output(x)))
+        assert np.array_equal(off, on)
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_HISTORY", "0")
+        assert history_enabled() is False
+        monkeypatch.setenv("DL4JTPU_HISTORY", "1")
+        assert history_enabled() is True
+        monkeypatch.delenv("DL4JTPU_HISTORY")
+        assert history_enabled() is True  # default on
+
+
+# ---------------------------------------------------------------------------
+# memory bound (satellite: soak ingest stays within the byte budget)
+# ---------------------------------------------------------------------------
+class TestMemoryBound:
+    def test_soak_stays_within_documented_budget(self):
+        """>=1e5 samples across >=200 series: the footprint estimate
+        stays under the worst-case ``byte_budget`` the docs publish, and
+        ``dl4jtpu_history_bytes`` mirrors it."""
+        reg = MetricsRegistry()
+        st = HistoryStore(reg)
+        n_series, n_samples = 220, 100_100
+        per = n_samples // n_series + 1
+        i = 0
+        for k in range(per):
+            for s in range(n_series):
+                if i >= n_samples:
+                    break
+                st.record_gauge(f"soak.s{s:03d}", float(i),
+                                {"worker": str(s % 4)},
+                                now=T0 + k * 2.0)
+                i += 1
+        st._update_footprint()  # noqa: SLF001 - what ingest_* calls
+        stats = st.stats()
+        assert stats["samples_total"] >= 100_000
+        assert stats["series"] == n_series
+        assert 0 < stats["bytes"] <= stats["byte_budget"]
+        rows = reg.snapshot()["dl4jtpu_history_bytes"]["values"]
+        assert rows[0]["value"] == stats["bytes"]
+        # the budget itself is finite and documented (<100 MB default)
+        assert stats["byte_budget"] < 100 * 1024 * 1024
+
+    def test_annotation_ring_bounded(self):
+        st = _store(max_annotations=10)
+        for i in range(50):
+            st.annotate("fleet_rollout", now=T0 + i,
+                        record_flight=False, i=i)
+        anns = st.annotations()
+        assert len(anns) == 10
+        assert anns[0]["i"] == 40  # oldest dropped
+
+
+# ---------------------------------------------------------------------------
+# live fleet: scrape plane over real processes (slow tier)
+# ---------------------------------------------------------------------------
+def _seed_store(tmp_path):
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax",
+                            loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater="sgd", learning_rate=1e-2),
+        seed=7)).init()
+    store = CheckpointStore(str(tmp_path / "store"))
+    store.save(net)
+    save_bundle(store, build_bundle(
+        net, example=np.zeros((1, 8), np.float32), argmax=True,
+        max_batch=8))
+    return store, net
+
+
+@pytest.mark.slow
+class TestFleetScrapePlane:
+    @pytest.fixture()
+    def fleet(self, tmp_path):
+        _seed_store(tmp_path)
+        router = FleetRouter(
+            str(tmp_path / "store"), workers=2, poll_s=0.2,
+            scrape_s=0.5, history=True,
+            worker_args={"max_delay_ms": 0, "max_batch": 8}).start()
+        try:
+            yield router
+        finally:
+            router.stop()
+
+    def test_scrape_kill_respawn_and_http_query(self, fleet):
+        router = fleet
+        base = f"http://127.0.0.1:{router.port}"
+        probe = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+        for _ in range(12):
+            _post(base + "/predict", {"features": probe.tolist()})
+        # two synchronous ticks >=1s apart so every rate sensor has a
+        # baseline + one derived point
+        router.scrape_once()
+        time.sleep(1.1)
+        for _ in range(4):
+            _post(base + "/predict", {"features": probe.tolist()})
+        tick = router.scrape_once()
+        assert tick["scraped"] == 2
+        assert tick["sensors"].get("offered_load", 0) > 0
+
+        # every recording-rule series materialised in the store
+        names = set(router.history.series_names())
+        missing = set(RECORDING_RULES) - names
+        assert not missing, missing
+
+        # /api/history over HTTP: select + step + aggregation
+        out = _get(base + "/api/history?series=fleet.*&range_s=600"
+                   "&step=1&agg=max")
+        got = {s["name"] for s in out["series"]}
+        assert "fleet.offered_load" in got
+        assert out["agg"] == "max" and out["step"] == 1.0
+        # derived p99 agrees with the instantaneous exact p99 at the
+        # latest sample point (no traffic between stats and scrape)
+        fstats = _get(base + "/api/fleet")
+        router.scrape_once()
+        out = _get(base + "/api/history"
+                   "?series=fleet.latency_p99_seconds&range_s=600")
+        pts = [p for p in out["series"][0]["points"]
+               if p[1] is not None]
+        assert pts[-1][1] == pytest.approx(
+            fstats["latency_seconds"]["p99"])
+        # worker-labelled series carry {worker, model}
+        out = _get(base + "/api/history?series=worker.queue_depth"
+                   "&worker=0&range_s=600")
+        assert out["series"]
+        assert out["series"][0]["labels"]["model"] == router.model
+        # bad aggregation -> 400, never a stack trace
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/api/history?agg=median")
+        assert ei.value.code == 400
+
+        # SIGKILL worker 0: past the heartbeat cutoff its series gap out
+        victim = router.workers[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        cutoff = max(5.0 * router.poll_s, 2.0)
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and router.history.stats()["stale_series"] == 0):
+            time.sleep(cutoff / 4)
+            router.scrape_once()  # the background loop may also tick
+        assert router.history.stats()["stale_series"] >= 1
+        assert router.history.stats()["samples_total"] > 0
+        out = _get(base + "/api/history?series=worker.uptime_s"
+                   "&worker=0&range_s=600")
+        s0 = out["series"][0]
+        assert s0["stale"] is True
+        assert s0["points"][-1][1] is None  # the explicit gap
+
+        # after respawn the SAME worker label resumes with real points
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            snap = router.stats()["workers"][0]
+            if snap["ready"] and snap["respawns"] >= 1:
+                break
+            time.sleep(0.2)
+        assert snap["ready"], snap
+        deadline = time.monotonic() + 30
+        s0 = None
+        while time.monotonic() < deadline:
+            router.scrape_once()
+            out = _get(base + "/api/history?series=worker.uptime_s"
+                       "&worker=0&range_s=600")
+            s0 = out["series"][0]
+            if not s0["stale"]:
+                break
+            time.sleep(0.5)
+        assert s0["stale"] is False, s0
+        assert s0["points"][-1][1] is not None
+        # the respawn landed on the timeline as an annotation
+        kinds = {a["kind"] for a in out["annotations"]}
+        assert "fleet_respawn" in kinds
+
+        # boot->READY seconds observed for both slots
+        out = _get(base + "/api/history?series=worker.boot_ready_seconds"
+                   "&range_s=600")
+        workers_seen = {s["labels"].get("worker") for s in out["series"]}
+        assert {"0", "1"} <= workers_seen
+
+    def test_history_toggle(self, fleet):
+        router = fleet
+        base = f"http://127.0.0.1:{router.port}"
+        res = _post(base + "/history", {"enabled": False})
+        assert res["enabled"] is False
+        assert router._history_paused.is_set()  # noqa: SLF001
+        res = _post(base + "/history", {"enabled": True})
+        assert res["enabled"] is True
+        assert not router._history_paused.is_set()  # noqa: SLF001
