@@ -47,7 +47,22 @@ Two passes, run before anything compiles:
   ``--concurrency``, and the check.sh self-scan of serving/fleet/
   runtime/telemetry/streaming.
 
-Each finding carries a rule id (``DT0xx``-``DT4xx``), severity,
+- **Numerics pass** (`numerics`, DT5xx): dtype-flow + value-range
+  abstract interpretation over the same traced train step the IR pass
+  reads — one ``make_jaxpr``, two walks. Dtype-flow tracks effective
+  accumulation precision (DT500 low-precision dot/conv/reduce without an
+  f32 ``preferred_element_type``, DT501 low-precision scan/while carry
+  compounding across steps, DT502 optimizer updates below the declared
+  PrecisionPolicy compute dtype); interval abstract interpretation seeds
+  invars from declared input/initializer bounds and propagates
+  ``[lo, hi]`` per eqn (DT503 unguarded exp/log/div/sqrt/rsqrt domain
+  hazards, DT504 softmax not dominated by a subtract-max — structural,
+  DT505 advisory sub-f32 grad flow without a loss scale). Entry points:
+  ``net.analyze_ir(batch)["numerics"]`` (on by default),
+  ``conf.analyze(numerics=True)``, CLI ``--numerics``, and admission
+  (unseeded — clamp/structure evidence only).
+
+Each finding carries a rule id (``DT0xx``-``DT5xx``), severity,
 location and fix hint; rules live in a registry (`rules`) so later PRs add
 checks cheaply. Inline ``# dl4jtpu: ignore[DT0xx]`` pragmas suppress AST
 findings (`pragmas`); IR findings (no source line) suppress via
@@ -88,6 +103,12 @@ from .shard_flow import (
     compare_census,
     hlo_collective_census,
 )
+from .numerics import (
+    analyze_config_numerics,
+    check_jaxpr_numerics,
+    check_network_numerics,
+    network_numerics,
+)
 
 __all__ = [
     "Finding",
@@ -126,4 +147,8 @@ __all__ = [
     "check_runtime_package",
     "check_runtime_paths",
     "check_runtime_source",
+    "analyze_config_numerics",
+    "check_jaxpr_numerics",
+    "check_network_numerics",
+    "network_numerics",
 ]

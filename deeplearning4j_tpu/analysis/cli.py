@@ -8,7 +8,10 @@
   MultiLayerConfiguration / ComputationGraphConfiguration) and get the
   graph pass — plus the jaxpr-level DT2xx IR pass with ``--ir`` (the config
   is instantiated into its network class and the real train step is traced;
-  the per-config ``static_cost`` roofline report lands in the JSON output).
+  the per-config ``static_cost`` roofline report lands in the JSON output)
+  and the DT5xx numerics pass with ``--numerics`` (dtype-flow + value-range
+  abstract interpretation over the same traced step; with ``--ir`` both
+  passes share a single trace).
 
 ``--fail-on`` picks the exit-code threshold: exit 1 when any finding at
 or above that severity survives pragmas, else 0. ``--json`` emits a
@@ -78,25 +81,37 @@ def _parse_mesh(text: str):
 
 
 def _analyze_json_config(path: str, batch: int, timesteps: int,
-                         ir: bool, costs: list, layout=None) -> List[Finding]:
+                         ir: bool, costs: list, layout=None,
+                         numerics: bool = False) -> List[Finding]:
     from .graph_checks import check_config
 
     with open(path, "r", encoding="utf-8") as fh:
         d = json.load(fh)
     findings = check_config(d, batch=batch, timesteps_probe=timesteps,
                             source=path)
-    if ir:
+    if ir or numerics:
         from ..nn.conf.computation_graph import ComputationGraphConfiguration
         from ..nn.conf.multi_layer import MultiLayerConfiguration
-        from .ir_checks import analyze_config_ir
 
         conf = (ComputationGraphConfiguration.from_dict(d)
                 if "vertices" in d else MultiLayerConfiguration.from_dict(d))
+    if ir:
+        from .ir_checks import analyze_config_ir
+
+        # --ir --numerics shares one trace: the DT5xx pass rides the same
+        # jaxpr walk as DT2xx and lands in the same cost report
         ir_findings, cost = analyze_config_ir(
             conf, batch=batch, timesteps_probe=timesteps, source=path,
-            layout=layout)
+            layout=layout, numerics=numerics)
         findings += ir_findings
         costs.append({"source": path, **cost})
+    elif numerics:
+        from .numerics import analyze_config_numerics
+
+        num_findings, num_summary = analyze_config_numerics(
+            conf, batch=batch, timesteps_probe=timesteps, source=path)
+        findings += num_findings
+        costs.append({"source": path, "numerics": num_summary})
     return findings
 
 
@@ -136,6 +151,11 @@ def main(argv=None) -> int:
                     "--mesh data=2,fsdp=4,tp=2,bf16,zero1 — predicts the "
                     "collective census + communication roofline with no "
                     "devices attached")
+    ap.add_argument("--numerics", action="store_true",
+                    help="run the DT5xx numerics pass (dtype-flow + "
+                    "value-range abstract interpretation) on each .json "
+                    "config's traced train step; composes with --ir "
+                    "sharing a single trace")
     ap.add_argument("--concurrency", action="store_true",
                     help="run the DT4xx runtime-guard tier on .py inputs "
                     "(thread-entry/lock census, env hygiene, telemetry "
@@ -185,7 +205,8 @@ def main(argv=None) -> int:
             try:
                 findings += _analyze_json_config(path, args.batch,
                                                  args.timesteps, args.ir,
-                                                 costs, layout=layout)
+                                                 costs, layout=layout,
+                                                 numerics=args.numerics)
             except Exception as e:
                 print(f"error: could not analyze config {path}: {e}",
                       file=sys.stderr)
@@ -219,14 +240,23 @@ def main(argv=None) -> int:
             "counts": counts,
             "findings": [f.to_dict() for f in findings],
         }
-        if args.ir:
+        if args.ir or args.numerics:
             report["static_cost"] = costs
         print(json.dumps(report, indent=2))
     else:
         for f in findings:
             print(f.format_human())
         for cost in costs:
-            rl = cost["roofline"]
+            num = cost.get("numerics")
+            if num:
+                rules = num.get("rules") or {}
+                hits = ", ".join(f"{k}x{v}" for k, v in sorted(rules.items())) \
+                    or "clean"
+                print(f"{cost['source']}: numerics: {hits} "
+                      f"(seeded {num.get('invars_seeded', 0)} invars)")
+            rl = cost.get("roofline")
+            if rl is None:
+                continue
             print(f"{cost['source']}: static_cost: "
                   f"{cost['flops']:,} FLOPs/step, "
                   f"{cost['hbm_bytes']:,} HBM bytes/step, "

@@ -427,6 +427,8 @@ def check_network_ir(net, batch_or_struct=None, *,
                      ignore: Iterable[str] = (),
                      timesteps_probe: Optional[int] = None,
                      layout=None,
+                     numerics: bool = True,
+                     numerics_input_bound: Optional[float] = None,
                      source: str = IR_SOURCE) -> dict:
     """The DT2xx pass + static cost model over a net's real train step.
 
@@ -446,6 +448,13 @@ def check_network_ir(net, batch_or_struct=None, *,
     roofline's interconnect term (``DL4JTPU_ICI_GBPS``) is fed the
     predicted census so ``predicted_step_seconds`` covers the
     communication-bound regime.
+
+    ``numerics`` (default on): the DT5xx dtype-flow + value-range pass
+    (``analysis/numerics.py``) walks the SAME traced jaxpr — one
+    ``make_jaxpr``, two walks — seeding input/param/label invars at
+    ``numerics_input_bound`` (default ±1e3) and optimizer moments from
+    their structural invariants. The report gains a ``"numerics"``
+    summary block and the DT500-DT505 findings join the list.
     """
     import jax  # noqa: PLC0415
 
@@ -487,6 +496,16 @@ def check_network_ir(net, batch_or_struct=None, *,
         report["shard_flow"] = flow
         apply_roofline(cost, comm_bytes=cost["collectives"]["bytes"]
                        + flow["comm_bytes_per_step"])
+    if numerics:
+        from .numerics import (  # noqa: PLC0415
+            DEFAULT_INPUT_BOUND, network_numerics)
+
+        bound = (DEFAULT_INPUT_BOUND if numerics_input_bound is None
+                 else float(numerics_input_bound))
+        block = network_numerics(net, closed, args, source=source,
+                                 input_bound=bound)
+        findings += block["findings"]
+        report["numerics"] = block["summary"]
     ignore = frozenset(ignore)
     findings = [f for f in findings if f.rule_id not in ignore]
     report["findings"] = merge_findings(findings)
@@ -496,12 +515,16 @@ def check_network_ir(net, batch_or_struct=None, *,
 def analyze_config_ir(conf, *, batch: int = 4,
                       timesteps_probe: Optional[int] = None,
                       source: str = IR_SOURCE, layout=None,
+                      numerics: bool = False,
                       ignore: Iterable[str] = ()) -> Tuple[List[Finding], dict]:
     """Headless DT2xx entry for a config (the CLI ``--ir`` path): builds the
     matching network class, initializes it, and runs
     :func:`check_network_ir`. Returns ``(findings, static_cost)`` — with
     ``layout`` (e.g. the CLI ``--mesh`` flag's abstract MeshLayout) the
-    static_cost carries the DT3xx ``shard_flow`` census block too."""
+    static_cost carries the DT3xx ``shard_flow`` census block too.
+    ``numerics=True`` (the CLI ``--ir --numerics`` composition) adds the
+    DT5xx pass over the same trace and a ``"numerics"`` cost block —
+    default off so the ``ir``/``numerics`` flags stay independent."""
     if hasattr(conf, "vertices"):
         from ..nn.graph import ComputationGraph  # noqa: PLC0415
 
@@ -511,13 +534,17 @@ def analyze_config_ir(conf, *, batch: int = 4,
 
         net = MultiLayerNetwork(conf)
     report = check_network_ir(net, batch, timesteps_probe=timesteps_probe,
-                              source=source, ignore=ignore, layout=layout)
+                              source=source, ignore=ignore, layout=layout,
+                              numerics=numerics)
     cost = report["static_cost"]
-    if "shard_flow" in report:
+    if "shard_flow" in report or "numerics" in report:
         cost = dict(cost)
+    if "shard_flow" in report:
         cost["shard_flow"] = {
             k: v for k, v in report["shard_flow"].items()
             if k in ("census", "comm_bytes_per_step", "layout")}
+    if "numerics" in report:
+        cost["numerics"] = report["numerics"]
     return report["findings"], cost
 
 
@@ -638,6 +665,22 @@ def admission_check(jitted, compiled, args, *, kind: str = "aot") -> Tuple[
             apply_roofline(
                 cost, comm_bytes=cost["collectives"]["bytes"]
                 + cost["shard_flow"]["comm_bytes_per_step"])
+    except Exception:
+        pass
+
+    # DT5xx numerics at admission: same jaxpr, one extra host-side walk.
+    # No declared ranges/policy are available for an arbitrary executable,
+    # so invars stay unknown — hazard rules only fire on evidence the
+    # trace itself provides (literal clamps, structural softmax shape,
+    # low-precision accumulation dtypes); net.analyze_ir is the seeded,
+    # policy-aware entry. Failures degrade silently like the DT3xx block.
+    try:
+        from .numerics import check_jaxpr_numerics  # noqa: PLC0415
+
+        num_findings, num_summary = check_jaxpr_numerics(
+            closed, source=source)
+        findings += num_findings
+        cost["numerics"] = num_summary
     except Exception:
         pass
 

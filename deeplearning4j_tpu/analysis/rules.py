@@ -459,3 +459,72 @@ register_rule(Rule(
     "2*param_bytes; anything larger suggests resharding inside the step "
     "(check DT009 and with_sharding_constraint placement).",
 ))
+
+# ---------------------------------------------------------- numerics rules
+# DT5xx = numerics pass (pass 6 — analysis/numerics.py): dtype-flow +
+# value-range abstract interpretation over the traced train step.
+register_rule(Rule(
+    "DT500", "low-precision accumulation", "warning", "numerics",
+    "A dot_general/conv/reduce accumulates in bf16/f16: the MXU (and the "
+    "XLA reduce emitter) carry the running sum at the operand precision "
+    "when no wider preferred_element_type is requested, so every partial "
+    "product below the accumulator's ulp is silently dropped — at bf16's "
+    "8 mantissa bits a sum of ~256 same-sign terms stops growing.",
+    "Pass preferred_element_type=jnp.float32 to dot_general/conv (free on "
+    "the MXU: it accumulates in f32 natively); for reduces, cast the "
+    "input to f32 before the sum and round the result back.",
+))
+register_rule(Rule(
+    "DT501", "low-precision loop carry", "warning", "numerics",
+    "A scan/while carry is held in bf16/f16 and rewritten every "
+    "iteration: rounding error compounds once per step across the whole "
+    "trip (the LSTM-state / streaming-statistics drift shape) — after N "
+    "steps the carry has ~log2(N) fewer good bits than one rounding.",
+    "Keep an f32 island for the carry: upcast at loop entry, accumulate "
+    "in f32, round to the storage dtype once at loop exit (storage-dtype "
+    "params/moments under a declared PrecisionPolicy are exempt — their "
+    "per-step update already computes in f32).",
+))
+register_rule(Rule(
+    "DT502", "optimizer update below compute dtype", "warning", "numerics",
+    "Gradients or optimizer moments are combined in arithmetic below the "
+    "declared PrecisionPolicy compute dtype at an update site: a bf16 "
+    "`p + lr*u` drops any update smaller than ~0.4%% of the weight, so "
+    "small late-training gradients stop moving the model entirely.",
+    "Run the optimizer update in an f32 island (upcast grads/moments/"
+    "params, tx.update + apply_updates in f32, round back to the storage "
+    "dtype) — nn.updaters.optimizer_update does exactly this.",
+))
+register_rule(Rule(
+    "DT503", "unguarded domain hazard", "warning", "numerics",
+    "An exp/log/div/sqrt/rsqrt input's propagated value interval admits "
+    "overflow, log(<=0), sqrt of a negative, or a divide-through-zero "
+    "with no clamp between the producer and the hazard: one such element "
+    "turns the loss into inf/NaN and the Watchdog can only roll back "
+    "after the damage.",
+    "Clamp the input just before the hazard: jnp.clip(x, EPS, hi) for "
+    "log, jnp.maximum(d, EPS) for divisors, jnp.maximum(v, 0.0) before "
+    "sqrt/rsqrt; bound exp arguments (subtract-max, or clip the "
+    "logits/log-variance like the VAE's +/-10 window).",
+))
+register_rule(Rule(
+    "DT504", "softmax without subtract-max", "warning", "numerics",
+    "A softmax-shaped expression (exp(x) normalized by its own sum) is "
+    "not dominated by a subtract-max: exp overflows at x>~88 in f32 "
+    "(x>~11 in f16), so one hot logit makes the whole row inf/inf = NaN.",
+    "Use the stable form exp(x - max(x)) / sum(exp(x - max(x))) — "
+    "jax.nn.softmax/log_softmax and ops.softmax_xent_rows already do "
+    "this; a clamp that provably bounds the exponent also satisfies the "
+    "check.",
+))
+register_rule(Rule(
+    "DT505", "sub-f32 grad flow without loss scaling", "info", "numerics",
+    "Parameters (hence gradients, via the cast transpose) are stored "
+    "below f32 but no loss scale is configured: backward-pass values "
+    "smaller than the storage dtype's tiniest subnormal (~9e-41 for "
+    "bf16, ~6e-8 for f16) flush to zero before the optimizer sees them.",
+    "Set the policy knob: MeshLayout(params_dtype=..., loss_scale=...) / "
+    "PrecisionPolicy(loss_scale=...) / conf.loss_scale — a power-of-two "
+    "scale multiplies the loss before backward and is divided back out "
+    "in f32 before the update, bit-exact when nothing clips.",
+))

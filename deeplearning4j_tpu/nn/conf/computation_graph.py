@@ -48,6 +48,11 @@ class ComputationGraphConfiguration:
     # MultiLayerConfiguration.params_dtype — the weight-copy-bound lever
     # from the round-5 ResNet trace); None = f32 master + per-step cast
     params_dtype: Optional[str] = None
+    # loss scaling for sub-f32 grad flow (see
+    # MultiLayerConfiguration.loss_scale — power-of-two scales are
+    # bit-exact; PrecisionPolicy.apply_to_net defaults this to 4096.0
+    # under a sub-f32 params_dtype)
+    loss_scale: Optional[float] = None
 
     # ------------------------------------------------------------- topo order
     def topological_order(self) -> List[str]:
@@ -94,7 +99,8 @@ class ComputationGraphConfiguration:
             known[name] = self.vertices[name].get_output_type(*ins)
         return result
 
-    def analyze(self, ir: bool = False, concurrency: bool = False, **kw):
+    def analyze(self, ir: bool = False, concurrency: bool = False,
+                numerics: bool = False, **kw):
         """Run the dl4jtpu-check graph pass over this DAG; returns a merged,
         deduplicated, stable-sorted list of
         :class:`~deeplearning4j_tpu.analysis.Finding` with per-vertex
@@ -102,22 +108,32 @@ class ComputationGraphConfiguration:
         graph and runs the DT2xx jaxpr/IR pass over its real train step;
         ``concurrency=True`` additionally runs the DT4xx runtime-guard pass
         over the package's serving/fleet/runtime/telemetry/streaming
-        sources. See docs/static_analysis.md; keywords forward to
+        sources; ``numerics=True`` the DT5xx dtype-flow/value-range pass
+        over the traced step (``ir=True, numerics=True`` share one trace).
+        All requested passes compose through a single ``merge_findings``
+        call so cross-pass duplicates dedupe and the sort stays
+        deterministic. See docs/static_analysis.md; keywords forward to
         :func:`deeplearning4j_tpu.analysis.check_graph` /
-        :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
+        :func:`deeplearning4j_tpu.analysis.analyze_config_ir` /
+        :func:`deeplearning4j_tpu.analysis.analyze_config_numerics`."""
         from ...analysis import check_graph, merge_findings  # local: analysis is optional at runtime
 
         ignore = frozenset(kw.pop("ignore", ()))
-        findings = check_graph(self, **kw)
+        groups = [check_graph(self, **kw)]
         if ir:
             from ...analysis.ir_checks import analyze_config_ir
 
-            findings += analyze_config_ir(self, **kw)[0]
+            groups.append(analyze_config_ir(self, numerics=numerics, **kw)[0])
+        elif numerics:
+            from ...analysis.numerics import analyze_config_numerics
+
+            groups.append(analyze_config_numerics(self, **kw)[0])
         if concurrency:
             from ...analysis.runtime_checks import check_runtime_package
 
-            findings += check_runtime_package()
-        return merge_findings(f for f in findings if f.rule_id not in ignore)
+            groups.append(check_runtime_package())
+        return merge_findings(
+            f for g in groups for f in g if f.rule_id not in ignore)
 
     def output_types(self) -> List[InputType]:
         known: Dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))
@@ -142,6 +158,7 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "remat": self.remat,
             "params_dtype": self.params_dtype,
+            "loss_scale": self.loss_scale,
         }
 
     def to_json(self) -> str:
@@ -163,6 +180,7 @@ class ComputationGraphConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             remat=d.get("remat", False),
             params_dtype=d.get("params_dtype"),
+            loss_scale=d.get("loss_scale"),
         )
 
     @staticmethod

@@ -48,6 +48,13 @@ class MultiLayerConfiguration:
     # which loses tiny-update precision, so this is a perf lever to A/B,
     # not a silent default.
     params_dtype: Optional[str] = None
+    # loss scaling for sub-f32 grad flow (DT505): the loss is multiplied
+    # by this before backprop and gradients divided after, keeping small
+    # gradients above the bf16/f16 flush-to-zero floor while they transit
+    # the storage dtype. Keep it a power of two — the exponent shift is
+    # then bit-exact. PrecisionPolicy.apply_to_net fills in its default
+    # (4096.0) whenever params_dtype is sub-f32; None = no scaling.
+    loss_scale: Optional[float] = None
     # per-layer-index input preprocessors (reference: nn/conf/preprocessor/*);
     # stored as {"idx": {"@type": ...}} in JSON
     preprocessors: Dict[int, object] = field(default_factory=dict)
@@ -72,30 +79,41 @@ class MultiLayerConfiguration:
         return self.layers[-1].get_output_type(its[-1])
 
     # ---- static analysis ----------------------------------------------------
-    def analyze(self, ir: bool = False, concurrency: bool = False, **kw):
+    def analyze(self, ir: bool = False, concurrency: bool = False,
+                numerics: bool = False, **kw):
         """Run the dl4jtpu-check graph pass over this config; returns a
         merged, deduplicated, stable-sorted list of
         :class:`~deeplearning4j_tpu.analysis.Finding` (empty = clean).
         ``ir=True`` additionally builds the network and runs the DT2xx
         jaxpr/IR pass over its real train step; ``concurrency=True``
         additionally runs the DT4xx runtime-guard pass over the package's
-        serving/fleet/runtime/telemetry/streaming sources (see
-        docs/static_analysis.md); keywords forward to
+        serving/fleet/runtime/telemetry/streaming sources;
+        ``numerics=True`` the DT5xx dtype-flow/value-range pass over the
+        traced step (``ir=True, numerics=True`` share one trace). All
+        requested passes compose through a single ``merge_findings`` call
+        so cross-pass duplicates dedupe and the sort stays deterministic
+        (see docs/static_analysis.md); keywords forward to
         :func:`deeplearning4j_tpu.analysis.check_multi_layer` /
-        :func:`deeplearning4j_tpu.analysis.analyze_config_ir`."""
+        :func:`deeplearning4j_tpu.analysis.analyze_config_ir` /
+        :func:`deeplearning4j_tpu.analysis.analyze_config_numerics`."""
         from ...analysis import check_multi_layer, merge_findings  # local: analysis is optional at runtime
 
         ignore = frozenset(kw.pop("ignore", ()))
-        findings = check_multi_layer(self, **kw)
+        groups = [check_multi_layer(self, **kw)]
         if ir:
             from ...analysis.ir_checks import analyze_config_ir
 
-            findings += analyze_config_ir(self, **kw)[0]
+            groups.append(analyze_config_ir(self, numerics=numerics, **kw)[0])
+        elif numerics:
+            from ...analysis.numerics import analyze_config_numerics
+
+            groups.append(analyze_config_numerics(self, **kw)[0])
         if concurrency:
             from ...analysis.runtime_checks import check_runtime_package
 
-            findings += check_runtime_package()
-        return merge_findings(f for f in findings if f.rule_id not in ignore)
+            groups.append(check_runtime_package())
+        return merge_findings(
+            f for g in groups for f in g if f.rule_id not in ignore)
 
     # ---- JSON ---------------------------------------------------------------
     def to_dict(self) -> dict:
@@ -110,6 +128,7 @@ class MultiLayerConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "remat": self.remat,
             "params_dtype": self.params_dtype,
+            "loss_scale": self.loss_scale,
             "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
         }
 
@@ -131,6 +150,7 @@ class MultiLayerConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             remat=d.get("remat", False),
             params_dtype=d.get("params_dtype"),
+            loss_scale=d.get("loss_scale"),
             preprocessors={
                 int(k): preprocessor_from_dict(v)
                 for k, v in (d.get("preprocessors") or {}).items()

@@ -27,6 +27,8 @@ from ..multilayer import (
     _cast_params,
     _format_summary_table,
 )
+from ..updaters import (optimizer_update, scaled_loss, unscale_grads,
+                        unscale_loss)
 from .vertices import LayerVertex
 
 
@@ -301,17 +303,20 @@ class ComputationGraph:
         pytrees for StatsListener histograms, ``with_telemetry`` only the
         in-step-reduced metrics vector (see MultiLayerNetwork note)."""
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
 
         def step(params, opt_state, state, inputs, labels, rng, labels_masks, masks):
             def loss_of(p):
                 loss, new_state, _ = self._loss(
                     p, state, inputs, labels, rng, True, labels_masks, masks
                 )
-                return loss, new_state
+                return scaled_loss(loss, ls), new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            updates, new_opt, new_params = optimizer_update(
+                tx, grads, opt_state, params)
             if with_grad_stats:
                 return new_params, new_opt, new_state, loss, grads, updates
             if with_telemetry:
@@ -346,6 +351,7 @@ class ComputationGraph:
         from ..multilayer import MultiLayerNetwork
 
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
         constrain = MultiLayerNetwork._staged_out_constraint(self)
 
         def run(params, opt_state, state, rng, n_steps, n_batches,
@@ -385,11 +391,13 @@ class ComputationGraph:
                     loss, new_state, _ = self._loss(
                         p, st, inputs, labels, step_key, True, lms, masks
                     )
-                    return loss, new_state
+                    return scaled_loss(loss, ls), new_state
 
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-                updates, new_opt = tx.update(grads, opt, params)
-                new_params = optax.apply_updates(params, updates)
+                loss = unscale_loss(loss, ls)
+                grads = unscale_grads(grads, ls)
+                updates, new_opt, new_params = optimizer_update(
+                    tx, grads, opt, params)
                 losses = jax.lax.dynamic_update_index_in_dim(
                     losses, loss.astype(jnp.float32), i, 0)
                 if with_telemetry:
@@ -824,6 +832,7 @@ class ComputationGraph:
         invoked from ComputationGraph.fit; tbptt_back_length < fwd_length
         truncates the backward window like tbpttBackwardLength does)."""
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
         back_len = int(self.conf.tbptt_back_length or 0)
 
         def slice_t(arrs, sl):
@@ -861,13 +870,15 @@ class ComputationGraph:
                 loss, new_state, new_rnn = self._loss(
                     p, state_in, xs_g, ys_g, rng, True, lm_g, m_g, rnn_state=rnn_in
                 )
-                return loss, (new_state, new_rnn)
+                return scaled_loss(loss, ls), (new_state, new_rnn)
 
             (loss, (new_state, new_rnn)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            updates, new_opt, new_params = optimizer_update(
+                tx, grads, opt_state, params)
             # segment boundary = truncation boundary: h/c re-enter the next
             # call as constants
             new_rnn = jax.lax.stop_gradient(new_rnn)

@@ -71,10 +71,16 @@ def mcxent(labels, preout, activation="softmax", mask=None):
             from .. import ops as _ops  # noqa: PLC0415
 
             return _apply_mask(_ops.softmax_xent_rows(lab, preout), mask)
-        logp = jax.nn.log_softmax(preout, axis=-1)
+        # >=f32 compute for the unfused n-D path, matching the fused
+        # kernel's contract: log-sum-exp and the label reduction lose
+        # mantissa in bf16/f16 even though log_softmax subtracts the max
+        cdt = jnp.promote_types(preout.dtype, jnp.float32)
+        logp = jax.nn.log_softmax(preout.astype(cdt), axis=-1)
     else:
-        logp = jnp.log(jnp.clip(_activated(preout, activation), EPS, 1.0))
-    scores = -(labels * logp)
+        act = _activated(preout, activation)
+        cdt = jnp.promote_types(act.dtype, jnp.float32)
+        logp = jnp.log(jnp.clip(act.astype(cdt), EPS, 1.0))
+    scores = -(jnp.asarray(labels).astype(logp.dtype) * logp)
     return _apply_mask(_per_example(scores), mask)
 
 
@@ -164,7 +170,9 @@ def mape(labels, preout, activation="identity", mask=None):
 
 def msle(labels, preout, activation="identity", mask=None):
     out = _activated(preout, activation)
-    scores = (jnp.log1p(jnp.maximum(out, -1 + EPS)) - jnp.log1p(labels)) ** 2
+    # labels are clamped like predictions: log1p(x) for x <= -1 is -inf/nan
+    scores = (jnp.log1p(jnp.maximum(out, -1 + EPS))
+              - jnp.log1p(jnp.maximum(labels, -1 + EPS))) ** 2
     return _apply_mask(_per_example(scores) / labels.shape[-1], mask)
 
 

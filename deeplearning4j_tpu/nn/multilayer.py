@@ -29,6 +29,8 @@ import optax
 
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.inputs import InputType
+from .updaters import (optimizer_update, scaled_loss, unscale_grads,
+                       unscale_loss)
 
 
 def _cast_params(conf_dtype: str, params):
@@ -376,17 +378,20 @@ class MultiLayerNetwork:
         (telemetry.device.step_stats) — the grad norm is reduced INSIDE the
         step, so the full gradient pytree never leaves the program."""
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
 
         def step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
             def loss_of(p):
                 loss, new_state, _ = self._loss(
                     p, state, x, y, rng, True, labels_mask, features_mask
                 )
-                return loss, new_state
+                return scaled_loss(loss, ls), new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            updates, new_opt, new_params = optimizer_update(
+                tx, grads, opt_state, params)
             if with_grad_stats:
                 return new_params, new_opt, new_state, loss, grads, updates
             if with_telemetry:
@@ -430,6 +435,7 @@ class MultiLayerNetwork:
         saw new input shardings and paid one extra compile.
         """
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
         constrain = self._staged_out_constraint()
 
         def run(params, opt_state, state, rng, n_steps, n_batches, xs, ys,
@@ -457,11 +463,13 @@ class MultiLayerNetwork:
 
                 def loss_of(p):
                     loss, new_state, _ = self._loss(p, st, x, y, step_key, True, lm, fm)
-                    return loss, new_state
+                    return scaled_loss(loss, ls), new_state
 
                 (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-                updates, new_opt = tx.update(grads, opt, params)
-                new_params = optax.apply_updates(params, updates)
+                loss = unscale_loss(loss, ls)
+                grads = unscale_grads(grads, ls)
+                updates, new_opt, new_params = optimizer_update(
+                    tx, grads, opt, params)
                 losses = jax.lax.dynamic_update_index_in_dim(
                     losses, loss.astype(jnp.float32), i, 0)
                 if with_telemetry:
@@ -870,6 +878,7 @@ class MultiLayerNetwork:
 
     def _build_tbptt_step(self):
         tx = self._tx
+        ls = getattr(self.conf, "loss_scale", None)
         back_len = int(self.conf.tbptt_back_length or 0)
 
         def step(params, opt_state, state, rnn, x, y, rng, labels_mask, features_mask):
@@ -902,13 +911,15 @@ class MultiLayerNetwork:
                 loss, new_state, new_rnn = self._loss(
                     p, state_in, x_g, y_g, rng, True, lm_g, fm_g, rnn_state=rnn_in
                 )
-                return loss, (new_state, new_rnn)
+                return scaled_loss(loss, ls), (new_state, new_rnn)
 
             (loss, (new_state, new_rnn)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            updates, new_opt, new_params = optimizer_update(
+                tx, grads, opt_state, params)
             # Segment boundary IS the gradient-truncation boundary: the returned
             # h/c re-enter the next jit call as constants (reference:
             # MultiLayerNetwork.doTruncatedBPTT:1080 rnnUpdateStateWithTBPTTState).
@@ -1063,8 +1074,8 @@ class MultiLayerNetwork:
                 return layer.pretrain_loss(p, h, rng)
 
             loss, grads = jax.value_and_grad(loss_of)(lp)
-            updates, new_opt = tx.update(grads, opt, lp)
-            return _optax.apply_updates(lp, updates), new_opt, loss
+            _, new_opt, new_lp = optimizer_update(tx, grads, opt, lp)
+            return new_lp, new_opt, loss
 
         jstep = jax.jit(step)
         lp = self.params[layer_idx]

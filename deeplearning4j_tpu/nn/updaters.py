@@ -302,3 +302,101 @@ class UpdaterConfig:
             self.gradient_normalization, self.gradient_normalization_threshold
         )
         return optax.chain(norm, core)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision update island + loss scaling (DT502/DT505 contract)
+# ---------------------------------------------------------------------------
+
+def _is_low_float(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating) \
+        and jnp.dtype(x.dtype).itemsize < 4
+
+
+def _has_low_float(tree) -> bool:
+    return any(_is_low_float(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _to_f32(tree):
+    return jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32) if _is_low_float(l) else l, tree)
+
+
+def _like(tree, ref):
+    return jax.tree_util.tree_map(
+        lambda l, r: l.astype(r.dtype) if l.dtype != r.dtype else l,
+        tree, ref)
+
+
+def optimizer_update(tx: optax.GradientTransformation, grads, opt_state,
+                     params):
+    """``tx.update`` + ``apply_updates`` honoring the precision contract.
+
+    Under a sub-f32 storage policy (``PrecisionPolicy(params_dtype=
+    "bfloat16")``) params, grads and moments all arrive in the storage
+    dtype — but the update *arithmetic* (moment EMAs, bias correction,
+    ``p - lr*u``) belongs to the compute dtype: run in bf16 it rounds the
+    moment EMAs every step and silently drops updates smaller than one
+    bf16 ulp of the parameter (~0.8% at magnitude 1). This helper is the
+    single update site for every train-step variant: when any leaf is
+    sub-f32 it upcasts grads/opt_state/params to an f32 island, applies
+    the optimizer there, and casts the results back per-leaf — storage,
+    checkpoints and collectives stay in the declared dtype, accumulation
+    is exact in f32. With all-f32 trees it is exactly
+    ``tx.update`` + ``optax.apply_updates`` (no extra casts traced).
+
+    Returns ``(updates, new_opt_state, new_params)``; ``updates`` are in
+    compute precision for grad-stats consumers.
+    """
+    if not (_has_low_float(grads) or _has_low_float(opt_state)
+            or _has_low_float(params)):
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return updates, new_opt, optax.apply_updates(params, updates)
+    p32 = _to_f32(params)
+    updates, new_opt32 = tx.update(_to_f32(grads), _to_f32(opt_state), p32)
+    new_p32 = optax.apply_updates(p32, updates)
+    return updates, _like(new_opt32, opt_state), _like(new_p32, params)
+
+
+def scaled_loss(loss, loss_scale):
+    """Scale a loss for sub-f32 backprop (``None``/falsy scale: identity).
+
+    Multiplying the loss by a power-of-two ``loss_scale`` shifts every
+    gradient's exponent up before the backward pass casts cotangents to
+    the bf16/f16 storage dtype, keeping small gradients out of the
+    flush-to-zero range. Pair with :func:`unscale_grads` right after
+    ``value_and_grad`` so everything downstream (grad stats, telemetry,
+    the optimizer) sees true-magnitude gradients.
+    """
+    if not loss_scale:
+        return loss
+    return loss * jnp.asarray(loss_scale, dtype=loss.dtype)
+
+
+def unscale_loss(loss, loss_scale):
+    """Undo :func:`scaled_loss` on the reported loss value (exact for the
+    power-of-two scales the policy defaults to)."""
+    if not loss_scale:
+        return loss
+    return loss / jnp.asarray(loss_scale, dtype=loss.dtype)
+
+
+def unscale_grads(grads, loss_scale):
+    """Undo :func:`scaled_loss` on the gradient tree, in f32.
+
+    Sub-f32 leaves are upcast before the divide so the unscale itself
+    cannot re-flush: with a power-of-two scale the upcast + exponent
+    shift is bit-exact. No-op (returns ``grads`` untouched) when
+    ``loss_scale`` is falsy.
+    """
+    if not loss_scale:
+        return grads
+    inv = 1.0 / float(loss_scale)
+
+    def one(g):
+        if not jnp.issubdtype(g.dtype, jnp.floating):
+            return g
+        g32 = g.astype(jnp.float32) if _is_low_float(g) else g
+        return g32 * jnp.asarray(inv, dtype=g32.dtype)
+
+    return jax.tree_util.tree_map(one, grads)

@@ -146,8 +146,13 @@ def softmax_xent_rows(labels2d, preout2d):
         return fused_softmax_xent(preout2d, labels2d)
     import jax.numpy as jnp  # noqa: PLC0415
 
-    logp = jax.nn.log_softmax(preout2d, axis=-1)
-    return -jnp.sum(labels2d * logp, axis=-1)
+    # match the fused kernel's >=f32 compute contract (_sxent_compute_dt):
+    # log_softmax subtracts the row max, but in bf16/f16 the log-sum-exp and
+    # the label-weighted reduction still lose mantissa. The fused kernel
+    # returns per-row losses in the promoted dtype; mirror that here.
+    cdt = jnp.promote_types(preout2d.dtype, jnp.float32)
+    logp = jax.nn.log_softmax(preout2d.astype(cdt), axis=-1)
+    return -jnp.sum(labels2d.astype(cdt) * logp, axis=-1)
 
 
 # ------------------------------------------------------ selection wrappers

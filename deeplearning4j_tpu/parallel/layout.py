@@ -58,6 +58,24 @@ class PrecisionPolicy:
 
     params_dtype: Optional[str] = None  # None = keep the model's own dtype
     compute_dtype: str = "float32"
+    # loss scaling for sub-f32 grad flow: gradients transit the storage
+    # dtype (the cast transpose), so small cotangents flush to zero in
+    # bf16/f16. None = auto: DEFAULT_LOSS_SCALE under a sub-f32
+    # params_dtype, no scaling otherwise. Keep explicit values a power of
+    # two — the exponent shift is then bit-exact through scale/unscale.
+    loss_scale: Optional[float] = None
+
+    #: power-of-two default applied when ``params_dtype`` is sub-f32
+    DEFAULT_LOSS_SCALE = 4096.0
+
+    def effective_loss_scale(self) -> Optional[float]:
+        """The loss scale this policy implies (explicit, or the sub-f32
+        default, or None when storage is full precision)."""
+        if self.loss_scale:
+            return float(self.loss_scale)
+        if self.params_dtype in ("bfloat16", "float16"):
+            return self.DEFAULT_LOSS_SCALE
+        return None
 
     def apply_to_net(self, net) -> None:
         """Stamp the policy onto a net: conf carries it forward (JSON
@@ -69,6 +87,9 @@ class PrecisionPolicy:
         import jax.numpy as jnp
 
         net.conf.params_dtype = self.params_dtype
+        net.conf.loss_scale = self.effective_loss_scale()
+        # the compiled step closed over the old loss_scale/update island
+        net._train_step = None
         if net.params is None:
             return
 
@@ -87,7 +108,8 @@ class PrecisionPolicy:
 
     def describe(self) -> dict:
         return {"params_dtype": self.params_dtype,
-                "compute_dtype": self.compute_dtype}
+                "compute_dtype": self.compute_dtype,
+                "loss_scale": self.effective_loss_scale()}
 
 
 def _is_spec(x) -> bool:
@@ -108,7 +130,8 @@ class MeshLayout:
     def __init__(self, data: Optional[int] = None, fsdp: int = 1, tp: int = 1,
                  seq: int = 1, pipe: int = 1, *,
                  devices: Optional[Sequence] = None,
-                 params_dtype: Optional[str] = None, zero_stage: int = 3,
+                 params_dtype: Optional[str] = None,
+                 loss_scale: Optional[float] = None, zero_stage: int = 3,
                  roles: bool = False):
         import jax
         from jax.sharding import Mesh
@@ -132,10 +155,11 @@ class MeshLayout:
                                           "pipe"))
         self._init_axes({"data": data, "fsdp": fsdp, "tp": tp, "seq": seq,
                          "pipe": pipe},
-                        params_dtype=params_dtype, zero_stage=zero_stage,
-                        roles=roles)
+                        params_dtype=params_dtype, loss_scale=loss_scale,
+                        zero_stage=zero_stage, roles=roles)
 
     def _init_axes(self, sizes: dict, *, params_dtype: Optional[str],
+                   loss_scale: Optional[float] = None,
                    zero_stage: int, canonical: bool = True,
                    model_axis: Optional[str] = None,
                    expert_axis: Optional[str] = None,
@@ -179,7 +203,8 @@ class MeshLayout:
                     a for a in self._batch_axes if a != "seq")
             self._pipe_axis = "pipe" if "pipe" in self._axis_sizes else None
         self.zero_stage = int(zero_stage)
-        self.precision = PrecisionPolicy(params_dtype=params_dtype)
+        self.precision = PrecisionPolicy(params_dtype=params_dtype,
+                                         loss_scale=loss_scale)
         self.roles = bool(roles)
         # layer-semantics binding (MeshLayout.bind): path-suffix
         # (layer key, param name) -> (role, layer). None until bound.
@@ -191,6 +216,7 @@ class MeshLayout:
     def from_mesh(cls, mesh, model_axis: Optional[str] = None,
                   expert_axis: Optional[str] = None,
                   params_dtype: Optional[str] = None,
+                  loss_scale: Optional[float] = None,
                   zero_stage: int = 3) -> "MeshLayout":
         """Wrap an existing mesh (the legacy ParallelWrapper construction
         path): ``model_axis`` plays the tp role, ``expert_axis`` enables the
@@ -205,6 +231,7 @@ class MeshLayout:
                     f"{label} '{ax}' not in mesh axes {tuple(mesh.shape)}")
         self.mesh = mesh
         self._init_axes(dict(mesh.shape), params_dtype=params_dtype,
+                        loss_scale=loss_scale,
                         zero_stage=zero_stage, canonical=False,
                         model_axis=model_axis, expert_axis=expert_axis)
         return self
@@ -213,6 +240,7 @@ class MeshLayout:
     def abstract(cls, data: int = 1, fsdp: int = 1, tp: int = 1,
                  seq: int = 1, pipe: int = 1, *,
                  params_dtype: Optional[str] = None,
+                 loss_scale: Optional[float] = None,
                  zero_stage: int = 3, roles: bool = False) -> "MeshLayout":
         """A device-less layout: pure spec algebra (``param_spec``,
         ``batch_spec``, the sharding-flow pass) with NO jax mesh behind it —
@@ -223,8 +251,8 @@ class MeshLayout:
         self.mesh = None
         self._init_axes({"data": int(data), "fsdp": int(fsdp),
                          "tp": int(tp), "seq": int(seq), "pipe": int(pipe)},
-                        params_dtype=params_dtype, zero_stage=zero_stage,
-                        roles=roles)
+                        params_dtype=params_dtype, loss_scale=loss_scale,
+                        zero_stage=zero_stage, roles=roles)
         return self
 
     # ------------------------------------------------------------ geometry

@@ -729,10 +729,19 @@ class PipelinedTrainer:
         opt_shardings = jax.tree_util.tree_map(
             lo.sharding, self._opt_spec_tree)
 
+        ls = getattr(net.conf, "loss_scale", None)
+
         def step(pt, opt_state, xs_pad, y, rng):
-            loss, grads = jax.value_and_grad(loss_of)(pt, xs_pad, y, rng)
-            updates, new_opt = tx.update(grads, opt_state, pt)
-            new_pt = optax.apply_updates(pt, updates)
+            from ..nn.updaters import (  # noqa: PLC0415
+                optimizer_update, scaled_loss, unscale_grads, unscale_loss)
+
+            def scaled_loss_of(*a):
+                return scaled_loss(loss_of(*a), ls)
+
+            loss, grads = jax.value_and_grad(scaled_loss_of)(pt, xs_pad, y, rng)
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            _, new_opt, new_pt = optimizer_update(tx, grads, opt_state, pt)
             new_pt = jax.tree_util.tree_map(
                 jax.lax.with_sharding_constraint, new_pt, pt_shardings,
                 is_leaf=lambda x: not isinstance(x, dict))

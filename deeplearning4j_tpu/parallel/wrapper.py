@@ -404,19 +404,24 @@ class ParallelWrapper:
             lambda a: global_put(a, shard0), self._replica)
 
         tx = net._tx
+        ls = getattr(net.conf, "loss_scale", None)
 
         def one_step(params, opt_state, state, x, y, rng, labels_mask, features_mask):
+            from ..nn.updaters import (  # noqa: PLC0415
+                optimizer_update, scaled_loss, unscale_grads, unscale_loss)
+
             def loss_of(p):
                 loss, new_state, _ = net._loss(
                     p, state, x, y, rng, True, labels_mask, features_mask
                 )
-                return loss, new_state
+                return scaled_loss(loss, ls), new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            import optax
-
-            return optax.apply_updates(params, updates), new_opt, new_state, loss
+            loss = unscale_loss(loss, ls)
+            grads = unscale_grads(grads, ls)
+            _, new_opt, new_params = optimizer_update(
+                tx, grads, opt_state, params)
+            return new_params, new_opt, new_state, loss
 
         # vmap over the replica axis: every replica steps independently in one
         # XLA program; sharding over "data" keeps each on its own device.

@@ -191,6 +191,13 @@ def _register_builtins() -> None:
              "call", cost_hint="memory", contexts=(),
              doc="parameter storage dtype (parallel.PrecisionPolicy); "
                  "trial-scoped — changing a live net's dtype re-inits it"))
+    add(Knob("precision_loss_scale", (None, 1024.0, 4096.0, 16384.0), None,
+             "call", cost_hint="compute", contexts=(),
+             doc="loss scale for sub-f32 grad flow "
+                 "(PrecisionPolicy loss_scale=): None = the policy's "
+                 "power-of-two default (4096 under bf16/f16 storage, off "
+                 "at f32); keep it a power of two — the exponent shift is "
+                 "bit-exact through scale/unscale (DT505)"))
     add(Knob("pipe_microbatches", (2, 4, 8, 16), 4, "call",
              cost_hint="memory", contexts=(),
              doc="micro-batches per pipelined step (PipelinedTrainer "

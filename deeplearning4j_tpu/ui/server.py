@@ -554,12 +554,19 @@ class _Handler(BaseHTTPRequestHandler):
             shard_flow = {
                 label: rec["shard_flow"]
                 for label, rec in records.items() if rec.get("shard_flow")}
+            # numerics view: the DT5xx dtype-flow/value-range summary each
+            # admitted executable was screened with (rule hit counts +
+            # how many invars carried declared ranges)
+            numerics = {
+                label: rec["numerics"]
+                for label, rec in records.items() if rec.get("numerics")}
             return self._send(200, json.dumps({
                 "roofline": roofline_params(),
                 "cost_records": records,
                 "summary": cm.stats()["static_cost"],
                 "findings_total": counts,
                 "shard_flow": shard_flow,
+                "numerics": numerics,
                 "kernels": kernel_select.stats(),
             }, default=str).encode())
         if path == "/api/flightrecorder":

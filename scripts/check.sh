@@ -112,6 +112,96 @@ assert not bad, f"{len(bad)} DT2xx warning+ finding(s) in the repo's own steps"
 print("IR self-scan clean (both net classes, warning threshold)")
 PY
 
+echo "== dl4jtpu-numlint: DT5xx numerics self-scan (both net classes, f32 + bf16 storage) + overhead smoke"
+env JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE 20 acceptance: the dtype-flow + value-range pass over the repo's
+# OWN train steps. The f32 variants must be clean at warning level; the
+# bf16-storage variants must be clean OUTRIGHT — DT505 is info-severity
+# and would slip a warning gate, and it is exactly the rule the
+# PrecisionPolicy default loss scale is supposed to retire (the f32
+# update island retires DT502 the same way). Then the admission-overhead
+# smoke: a numerics-enabled analyze_ir trace must stay within 1.3x of
+# the DT2xx-only trace.
+import time
+
+from deeplearning4j_tpu import (ComputationGraph, ComputationGraphConfiguration,
+                                DenseLayer, InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, OutputLayer, UpdaterConfig)
+from deeplearning4j_tpu.analysis import SEVERITY_ORDER
+from deeplearning4j_tpu.analysis.ir_checks import check_network_ir
+from deeplearning4j_tpu.analysis.numerics import check_network_numerics
+from deeplearning4j_tpu.parallel.layout import PrecisionPolicy
+
+
+def mln():
+    return MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=128, activation="relu"),
+                DenseLayer(n_out=128, activation="relu"),
+                OutputLayer(n_out=16, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(128),
+        updater=UpdaterConfig(updater="adam", learning_rate=1e-3)))
+
+
+def graph():
+    return ComputationGraph(
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .add_layer("h", DenseLayer(n_out=64, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_out=8, activation="softmax",
+                                      loss="mcxent"), "h")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(32))
+        .build())
+
+
+for label, build, storage in (("mln/f32", mln, None),
+                              ("graph/f32", graph, None),
+                              ("mln/bf16", mln, "bfloat16"),
+                              ("graph/bf16", graph, "bfloat16")):
+    net = build().init()
+    if storage:
+        PrecisionPolicy(params_dtype=storage).apply_to_net(net)
+    block = check_network_numerics(net, 64)
+    bad = (block["findings"] if storage else
+           [f for f in block["findings"]
+            if SEVERITY_ORDER[f.severity] >= SEVERITY_ORDER["warning"]])
+    for f in bad:
+        print(f.format_human())
+    assert not bad, (label, f"{len(bad)} DT5xx finding(s)")
+    pol = block["summary"].get("policy") or {}
+    if storage:
+        assert pol.get("loss_scale"), (label, pol)
+    print(f"  {label}: clean ({block['summary']['eqns']} eqns, "
+          f"seeded {block['summary']['invars_seeded']} invars, "
+          f"policy {pol})")
+
+# overhead smoke: the DT5xx walk rides the same trace as DT2xx, so the
+# numerics-enabled analyze must stay within 1.3x of the DT2xx-only one
+# (best-of-3 each; a 50 ms absolute slack absorbs timer noise on tiny
+# CPU traces).
+net = mln().init()
+check_network_ir(net, 64, numerics=False)  # warm import paths once
+check_network_ir(net, 64, numerics=True)
+
+
+def best(numerics, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        check_network_ir(net, 64, numerics=numerics)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+base, full = best(False), best(True)
+ratio = full / base
+assert ratio <= 1.3 or full - base < 0.05, (
+    f"numerics-enabled analyze_ir {full:.3f}s is {ratio:.2f}x the "
+    f"DT2xx-only {base:.3f}s (budget 1.3x)")
+print(f"numerics self-scan OK: 4/4 variants clean, overhead "
+      f"{ratio:.2f}x ({base * 1e3:.0f} -> {full * 1e3:.0f} ms)")
+PY
+
 echo "== roofline smoke: static cost model on the bench MLP"
 env JAX_PLATFORMS=cpu python - <<'PY'
 # the bench MLP's predicted FLOPs must match the closed form and the

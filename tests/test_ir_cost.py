@@ -159,7 +159,7 @@ class TestAnalyzeIr:
     def test_mln_report_structure_and_clean(self):
         net = _mln().init()
         rep = net.analyze_ir(32)
-        assert set(rep) == {"findings", "static_cost"}
+        assert set(rep) == {"findings", "static_cost", "numerics"}
         assert all(isinstance(f, Finding) for f in rep["findings"])
         # the repo's own step must be clean at warning level (DT206
         # "memory-bound" is info by design for tiny CPU-probe nets)

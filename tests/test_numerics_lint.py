@@ -1,0 +1,438 @@
+"""DT5xx numerics lint: every shipped rule fires on a seeded violation and
+stays silent on its clean twin; findings suppress via ``ignore=``; the CLI
+``--numerics`` mode routes exit codes; scans are deterministic and
+deduplicated; and every firing fixture is backed by *execution* ground
+truth — the flagged program measurably degrades (NaN/inf or >1e-2 error
+vs an f64 oracle) while the clean twin does not.
+
+Fixture map (ISSUE 20 acceptance):
+- DT500: bf16 dot_general with K>=32 and no f32 ``preferred_element_type``
+  / clean twin passes ``preferred_element_type=float32``; also the generic
+  ``lax.reduce``-with-add and ``cumsum`` accumulation forms
+- DT501: bf16 scan carry across >= DT501_MIN_STEPS steps / clean twin
+  carries f32
+- DT502: parameter-lineage update arithmetic lands in bf16 under a
+  declared f32 compute policy / clean twin updates in f32
+- DT503: ``log``/``div``/``exp`` whose seeded input interval admits
+  log(<=0), divide-through-zero, or exp overflow / clean twins clamp
+  (``clip``/``maximum``) or bound the exponent
+- DT504: softmax computed as exp(x)/sum(exp(x)) without subtracting the
+  row max / clean twin uses ``jax.nn.softmax`` (structurally stabilized)
+- DT505: net stores sub-f32 params with no ``conf.loss_scale`` declared /
+  clean twin carries the PrecisionPolicy default scale
+
+The loss-fix accuracy tests (satellite 1) prove the shipped fixes to the
+unfused softmax-xent paths move the bf16 result toward the f64 oracle.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+    OutputLayer,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.analysis import RULES, merge_findings
+from deeplearning4j_tpu.analysis.cli import main as cli_main
+from deeplearning4j_tpu.analysis.numerics import (
+    DT500_MIN_REDUCE,
+    DT501_MIN_STEPS,
+    check_jaxpr_numerics,
+    check_network_numerics,
+)
+from deeplearning4j_tpu.nn import losses
+from deeplearning4j_tpu.parallel.layout import PrecisionPolicy
+
+
+def _shell(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _lint(fn, shells, **kw):
+    closed = jax.make_jaxpr(fn)(*shells)
+    findings, summary = check_jaxpr_numerics(closed, **kw)
+    return {f.rule_id for f in findings}, findings, summary
+
+
+def _mln(updater="adam"):
+    return MultiLayerNetwork(MultiLayerConfiguration(
+        layers=[DenseLayer(n_out=16, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+        input_type=InputType.feed_forward(8),
+        updater=UpdaterConfig(updater=updater, learning_rate=1e-3)))
+
+
+# ------------------------------------------------------------- fixtures
+# Each rule id maps to a (firing, clean) pair of (fn, shells, kwargs);
+# the sweep test asserts the firing twin hits EXACTLY its rule and the
+# clean twin hits nothing, so a fixture cannot silently drift onto a
+# different DT5xx rule.
+
+K = max(64, DT500_MIN_REDUCE * 2)
+STEPS = DT501_MIN_STEPS * 2
+BF, F32 = jnp.bfloat16, jnp.float32
+
+
+def _dot_lo(x, w):
+    return jnp.dot(x, w)
+
+
+def _dot_hi(x, w):
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+def _reduce_lo(x):
+    return jax.lax.reduce(x, jnp.asarray(0, x.dtype),
+                          jax.lax.add, dimensions=(0,))
+
+
+def _cumsum_lo(x):
+    return jnp.cumsum(x)
+
+
+def _scan(dtype):
+    def fn(c0, xs):
+        def body(c, x):
+            return c * jnp.asarray(0.5, c.dtype) + x, c
+        return jax.lax.scan(body, c0, xs)
+    return fn
+
+
+def _upd(p, g):
+    return p - jnp.asarray(0.01, p.dtype) * g
+
+
+_FIXTURES = {
+    "DT500": (
+        (_dot_lo, [_shell((8, K), BF), _shell((K, 8), BF)], {}),
+        (_dot_hi, [_shell((8, K), BF), _shell((K, 8), BF)], {}),
+    ),
+    "DT501": (
+        (_scan(BF), [_shell((), BF), _shell((STEPS,), BF)], {}),
+        (_scan(F32), [_shell((), F32), _shell((STEPS,), F32)], {}),
+    ),
+    "DT502": (
+        (_upd, [_shell((8,), BF), _shell((8,), BF)],
+         dict(in_lineage=["param", None], compute_dtype="float32")),
+        (_upd, [_shell((8,), F32), _shell((8,), F32)],
+         dict(in_lineage=["param", None], compute_dtype="float32")),
+    ),
+    "DT503": (
+        (lambda x: jnp.log(x), [_shell((8,), F32)],
+         dict(in_ranges=[(-1.0, 1.0)])),
+        (lambda x: jnp.log(jnp.clip(x, 1e-7, 1.0)), [_shell((8,), F32)],
+         dict(in_ranges=[(-1.0, 1.0)])),
+    ),
+    "DT504": (
+        (lambda x: (lambda e: e / jnp.sum(e, -1, keepdims=True))(jnp.exp(x)),
+         [_shell((4, 8), F32)], dict(in_ranges=[(-1e3, 1e3)])),
+        (lambda x: jax.nn.softmax(x, axis=-1),
+         [_shell((4, 8), F32)], dict(in_ranges=[(-1e3, 1e3)])),
+    ),
+    # DT505 is net-level (params + conf, not one jaxpr) — tested below.
+}
+
+
+class TestFiringAndClean:
+    @pytest.mark.parametrize("rule", sorted(_FIXTURES))
+    def test_firing_fixture_hits_exactly_its_rule(self, rule):
+        fn, shells, kw = _FIXTURES[rule][0]
+        ids, findings, _ = _lint(fn, shells, **kw)
+        assert ids == {rule}, f"{rule} firing fixture hit {ids}"
+        assert all(f.rule_id in RULES for f in findings)
+
+    @pytest.mark.parametrize("rule", sorted(_FIXTURES))
+    def test_clean_twin_is_silent(self, rule):
+        fn, shells, kw = _FIXTURES[rule][1]
+        ids, _, _ = _lint(fn, shells, **kw)
+        assert ids == set(), f"{rule} clean twin hit {ids}"
+
+    def test_dt500_generic_reduce_and_cumsum(self):
+        ids, _, _ = _lint(_reduce_lo, [_shell((K,), BF)])
+        assert ids == {"DT500"}
+        ids, _, _ = _lint(_cumsum_lo, [_shell((K,), BF)])
+        assert ids == {"DT500"}
+        # f32 accumulation of the same programs is clean
+        ids, _, _ = _lint(_reduce_lo, [_shell((K,), F32)])
+        assert ids == set()
+        ids, _, _ = _lint(_cumsum_lo, [_shell((K,), F32)])
+        assert ids == set()
+
+    def test_dt503_div_and_exp_forms(self):
+        ids, _, _ = _lint(lambda a, b: a / b,
+                          [_shell((8,), F32)] * 2,
+                          in_ranges=[(0.0, 1.0), (-1.0, 1.0)])
+        assert ids == {"DT503"}
+        ids, _, _ = _lint(lambda a, b: a / jnp.maximum(b, 1e-6),
+                          [_shell((8,), F32)] * 2,
+                          in_ranges=[(0.0, 1.0), (-1.0, 1.0)])
+        assert ids == set()
+        ids, _, _ = _lint(lambda x: jnp.exp(x), [_shell((8,), F32)],
+                          in_ranges=[(-1e3, 1e3)])
+        assert ids == {"DT503"}
+        ids, _, _ = _lint(lambda x: jnp.exp(jnp.clip(x, -30.0, 30.0)),
+                          [_shell((8,), F32)], in_ranges=[(-1e3, 1e3)])
+        assert ids == set()
+
+    def test_dt501_short_trip_is_exempt(self):
+        fn = _scan(BF)
+        short = DT501_MIN_STEPS - 1
+        ids, _, _ = _lint(fn, [_shell((), BF), _shell((short,), BF)])
+        assert "DT501" not in ids
+
+    def test_dt505_net_level_firing_and_clean(self):
+        net = _mln().init()
+        PrecisionPolicy(params_dtype="bfloat16").apply_to_net(net)
+        # clean: the policy stamped its power-of-two default scale
+        assert net.conf.loss_scale == PrecisionPolicy.DEFAULT_LOSS_SCALE
+        rep = check_network_numerics(net)
+        assert "DT505" not in {f.rule_id for f in rep["findings"]}
+        # firing: same storage dtype, scale knob cleared
+        net.conf.loss_scale = None
+        net._train_step = None
+        rep = check_network_numerics(net)
+        ids = {f.rule_id for f in rep["findings"]}
+        assert "DT505" in ids
+        # the f32 update island keeps the rest of the step clean even here
+        assert ids == {"DT505"}
+        dt505 = [f for f in rep["findings"] if f.rule_id == "DT505"]
+        assert dt505[0].severity == "info"
+        assert "loss_scale" in dt505[0].hint
+
+
+class TestRegistrySweep:
+    def test_numerics_scope_is_exactly_dt500_to_dt505(self):
+        scoped = {rid for rid, r in RULES.items() if r.scope == "numerics"}
+        assert scoped == {"DT500", "DT501", "DT502", "DT503", "DT504",
+                          "DT505"}
+
+    def test_every_jaxpr_rule_has_a_fixture_pair(self):
+        jaxpr_rules = {rid for rid, r in RULES.items()
+                       if r.scope == "numerics"} - {"DT505"}
+        assert set(_FIXTURES) == jaxpr_rules
+        for rid, (firing, clean) in _FIXTURES.items():
+            assert firing[0] is not clean[0] or firing[1] != clean[1]
+
+    def test_rule_metadata_complete(self):
+        for rid in ("DT500", "DT501", "DT502", "DT503", "DT504", "DT505"):
+            r = RULES[rid]
+            assert r.title and r.hint
+            assert r.severity in ("info", "warning", "error")
+
+
+class TestSuppressionAndCli:
+    def test_ignore_drops_rule(self):
+        fn, shells, kw = _FIXTURES["DT503"][0]
+        ids, _, _ = _lint(fn, shells, ignore=("DT503",), **kw)
+        assert ids == set()
+
+    def test_analyze_ir_ignore_passthrough(self):
+        net = _mln().init()
+        PrecisionPolicy(params_dtype="bfloat16").apply_to_net(net)
+        net.conf.loss_scale = None
+        net._train_step = None
+        rep = net.analyze_ir(8, ignore=("DT505",))
+        assert "DT505" not in {f.rule_id for f in rep["findings"]}
+
+    def test_cli_numerics_exit_codes(self, tmp_path, capsys):
+        conf = _mln().conf
+        conf.params_dtype = "bfloat16"
+        conf.loss_scale = None
+        firing = tmp_path / "firing.json"
+        firing.write_text(conf.to_json())
+        conf.loss_scale = 4096.0
+        clean = tmp_path / "clean.json"
+        clean.write_text(conf.to_json())
+
+        # DT505 is info severity: trips --fail-on info, not warning
+        rc = cli_main([str(firing), "--numerics", "--fail-on", "info",
+                       "--json"])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert "DT505" in {f["rule_id"] for f in rep["findings"]}
+        assert rep["static_cost"][0]["numerics"]["rules"].get("DT505") == 1
+
+        rc = cli_main([str(firing), "--numerics", "--fail-on", "warning"])
+        capsys.readouterr()
+        assert rc == 0
+
+        rc = cli_main([str(clean), "--numerics", "--fail-on", "info",
+                       "--json"])
+        rep = json.loads(capsys.readouterr().out)
+        assert "DT505" not in {f["rule_id"] for f in rep["findings"]}
+
+    def test_cli_ir_and_numerics_compose(self, tmp_path, capsys):
+        p = tmp_path / "conf.json"
+        p.write_text(_mln().conf.to_json())
+        rc = cli_main([str(p), "--ir", "--numerics", "--fail-on", "warning",
+                       "--json"])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        cost = rep["static_cost"][0]
+        assert "numerics" in cost and "flops" in cost  # one shared trace
+
+
+class TestDeterminism:
+    def test_same_program_same_findings(self):
+        fn, shells, kw = _FIXTURES["DT504"][0]
+        _, a, _ = _lint(fn, shells, **kw)
+        _, b, _ = _lint(fn, shells, **kw)
+        assert [f.to_dict() for f in a] == [f.to_dict() for f in b]
+
+    def test_findings_dedupe_and_aggregate(self):
+        def twice(x):
+            return jnp.log(x) + jnp.log(x * jnp.asarray(2.0, x.dtype))
+        _, findings, _ = _lint(twice, [_shell((8,), F32)],
+                               in_ranges=[(-1.0, 1.0)])
+        # two hazardous log sites aggregate into ONE DT503 finding with a
+        # site count, and merging is idempotent
+        assert len([f for f in findings if f.rule_id == "DT503"]) == 1
+        assert "2 site(s)" in findings[0].message
+        assert merge_findings(list(findings) + list(findings)) == \
+            merge_findings(findings)
+
+
+class TestGroundTruth:
+    """Satellite: every flagged fixture EXECUTES worse than its twin —
+    NaN/inf or >1e-2 error against an f64 oracle, on CPU,
+    deterministically."""
+
+    def test_dt500_low_precision_accumulation_overflows(self):
+        x = jnp.full((16, 2048), 16.0, jnp.float16)
+        w = jnp.full((2048, 16), 16.0, jnp.float16)
+        flagged = jnp.dot(x, w)  # true sum 524288 > f16 max 65504
+        clean = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        assert bool(jnp.isinf(flagged).all())
+        assert float(clean[0, 0]) == 16.0 * 16.0 * 2048
+        # and the lint agrees with the execution evidence
+        ids, _, _ = _lint(lambda a, b: jnp.dot(a, b),
+                          [_shell((16, 2048), jnp.float16)] * 0 +
+                          [_shell((16, 2048), jnp.float16),
+                           _shell((2048, 16), jnp.float16)])
+        assert "DT500" in ids
+
+    def test_dt501_low_precision_carry_stalls(self):
+        def body(c, _):
+            return c + jnp.asarray(1e-3, c.dtype), None
+
+        def run(dtype):
+            c, _ = jax.lax.scan(body, jnp.asarray(1.0, dtype), None,
+                                length=1000)
+            return float(c)
+
+        oracle = 1.0 + 1e-3 * 1000  # exact in f64
+        flagged, clean = run(jnp.bfloat16), run(jnp.float32)
+        assert abs(flagged - oracle) / oracle > 1e-2  # stalls at 1.0
+        assert abs(clean - oracle) / oracle < 1e-3
+        ids, _, _ = _lint(
+            lambda c0: jax.lax.scan(body, c0, None, length=1000)[0],
+            [_shell((), BF)])
+        assert "DT501" in ids
+
+    def test_dt502_low_precision_updates_vanish(self):
+        def train(dtype, steps=256):
+            p = jnp.asarray(1.0, dtype)
+            upd = jnp.asarray(1e-3, dtype)
+            for _ in range(steps):
+                p = p + upd
+            return float(p)
+
+        oracle = 1.0 + 1e-3 * 256
+        flagged, clean = train(jnp.bfloat16), train(jnp.float32)
+        assert abs(flagged - oracle) / oracle > 1e-2  # 1e-3 < bf16 ulp at 1
+        assert abs(clean - oracle) / oracle < 1e-3
+        ids, _, _ = _lint(_upd, [_shell((8,), BF), _shell((8,), BF)],
+                          in_lineage=["param", None],
+                          compute_dtype="float32")
+        assert "DT502" in ids
+
+    def test_dt503_log_and_div_produce_nonfinite(self):
+        x = jnp.asarray([0.0, 0.5], jnp.float32)
+        flagged = jnp.log(x)
+        clean = jnp.log(jnp.clip(x, 1e-7, 1.0))
+        assert not bool(jnp.isfinite(flagged).all())
+        assert bool(jnp.isfinite(clean).all())
+        den = jnp.asarray([0.0, 2.0], jnp.float32)
+        flagged = jnp.asarray(1.0) / den
+        clean = jnp.asarray(1.0) / jnp.maximum(den, 1e-6)
+        assert not bool(jnp.isfinite(flagged).all())
+        assert bool(jnp.isfinite(clean).all())
+
+    def test_dt504_naive_softmax_overflows(self):
+        logits = jnp.asarray([100.0, 0.0, -50.0], jnp.float32)
+        flagged = jnp.exp(logits) / jnp.sum(jnp.exp(logits))
+        clean = jax.nn.softmax(logits)
+        assert not bool(jnp.isfinite(flagged).all())
+        oracle = np.exp(np.asarray(logits, np.float64) - 100.0)
+        oracle /= oracle.sum()
+        assert np.allclose(np.asarray(clean, np.float64), oracle, atol=1e-6)
+
+    def test_dt505_unscaled_tiny_grads_flush_scaled_survive(self):
+        g, S = 1e-8, 4096.0  # g below f16's smallest denormal ~5.96e-8
+        flagged = float(jnp.asarray(g, jnp.float32).astype(jnp.float16))
+        scaled = float((jnp.asarray(g, jnp.float32) * S)
+                       .astype(jnp.float16).astype(jnp.float32) / S)
+        assert flagged == 0.0  # 100% error: the gradient is gone
+        assert abs(scaled - g) / g < 1e-2
+
+
+class TestLossFixAccuracy:
+    """Satellite: the shipped fixes to the unfused loss paths move the
+    bf16 result toward the f64 oracle (before/after on the same inputs)."""
+
+    @staticmethod
+    def _oracle_rows(pre, lab):
+        p = np.asarray(pre, np.float64)
+        l = np.asarray(lab, np.float64)
+        m = p.max(-1, keepdims=True)
+        logp = (p - m) - np.log(np.exp(p - m).sum(-1, keepdims=True))
+        return -(l * logp).sum(-1)
+
+    def test_softmax_xent_rows_bf16_toward_oracle(self):
+        from deeplearning4j_tpu import ops
+
+        rng = np.random.RandomState(7)
+        pre = jnp.asarray(rng.randn(32, 64) * 8, jnp.bfloat16)
+        lab = jax.nn.one_hot(jnp.asarray(rng.randint(0, 64, 32)), 64,
+                             dtype=jnp.bfloat16)
+        oracle = self._oracle_rows(pre, lab)
+        # "before": the pre-fix unfused formula at data precision
+        before = -jnp.sum(lab * jax.nn.log_softmax(pre, axis=-1), axis=-1)
+        after = ops.softmax_xent_rows(lab, pre)
+        err_before = np.abs(np.asarray(before, np.float64) - oracle).max()
+        err_after = np.abs(np.asarray(after, np.float64) - oracle).max()
+        assert err_after < err_before
+        assert err_after < 1e-4
+        # parity with the fused kernel's output contract: promoted dtype
+        assert after.dtype == jnp.float32
+
+    def test_mcxent_nd_fallback_bf16_toward_oracle(self):
+        rng = np.random.RandomState(11)
+        pre = jnp.asarray(rng.randn(4, 6, 64) * 8, jnp.bfloat16)
+        lab = jax.nn.one_hot(jnp.asarray(rng.randint(0, 64, (4, 6))), 64,
+                             dtype=jnp.bfloat16)
+        p = np.asarray(pre, np.float64)
+        l = np.asarray(lab, np.float64)
+        m = p.max(-1, keepdims=True)
+        logp = (p - m) - np.log(np.exp(p - m).sum(-1, keepdims=True))
+        oracle = float((-(l * logp)).sum(-1).reshape(4, -1).sum(-1).mean())
+        before = float(jnp.mean(jnp.sum(
+            (-(lab * jax.nn.log_softmax(pre, -1))).reshape(4, -1), -1)))
+        after = float(losses.mcxent(lab, pre, "softmax"))
+        assert abs(after - oracle) < abs(before - oracle)
+        assert abs(after - oracle) < 1e-3 * max(1.0, abs(oracle))
+
+    def test_msle_negative_labels_finite(self):
+        labels = jnp.asarray([[-2.0, 0.5]], jnp.float32)
+        preds = jnp.asarray([[0.5, 0.5]], jnp.float32)
+        out = losses.msle(labels, preds, "identity")
+        assert bool(jnp.isfinite(out))  # pre-fix: log1p(-2) -> nan
